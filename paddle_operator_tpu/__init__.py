@@ -1,0 +1,20 @@
+"""paddle_operator_tpu — a TPU-native distributed training job framework.
+
+Two planes, one repo:
+
+* **Control plane** (`api/`, `k8s/`, `controllers/`, `elastic/`): a Kubernetes
+  operator managing a ``TpuJob`` CRD — the TPU-native redesign of the reference
+  paddle-operator (reference: ``controllers/paddlejob_controller.go``,
+  ``api/v1/paddlejob_types.go``).  Jobs declare ps/worker/heter role sets; the
+  reconcile loop materialises pods (with ``google.com/tpu`` resources and
+  ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` env on TPU node pools), per-pod
+  headless services, a global-env ConfigMap barrier, Volcano PodGroup gang
+  scheduling sized to the full TPU slice, and etcd-style elastic membership.
+
+* **Data plane** (`models/`, `ops/`, `parallel/`, `launch`): the in-container
+  training runtime the reference leaves to external Paddle images — rebuilt
+  TPU-first on JAX/XLA: SPMD over `jax.sharding.Mesh`, bf16 matmuls on the MXU,
+  XLA collectives over ICI, elastic restart from checkpoints.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,31 @@
+"""API layer: the TpuJob CRD — types, constants, validation, CRD manifest.
+
+Reference equivalents: ``api/v1/paddlejob_types.go`` (types + helpers),
+``api/v1/groupversion_info.go`` (scheme), the generated CRD yaml under
+``config/crd/bases/``.
+"""
+
+from .types import (  # noqa: F401
+    GROUP,
+    VERSION,
+    API_VERSION,
+    KIND,
+    PLURAL,
+    SHORT_NAME,
+    RES_PS,
+    RES_WORKER,
+    RES_HETER,
+    RESOURCE_ORDER,
+    TRAINING_ROLE,
+    LABEL_RES_NAME,
+    LABEL_RES_TYPE,
+    ANNOT_RESOURCE,
+    Phase,
+    Mode,
+    Intranet,
+    CleanPodPolicy,
+    ElasticStatus,
+    Device,
+    TpuJob,
+    new_tpujob,
+)

@@ -1,0 +1,289 @@
+"""TpuJob CRD types and helpers (reference: api/v1/paddlejob_types.go).
+
+The job object itself is a plain dict in k8s JSON shape; :class:`TpuJob` is a
+typed view over it providing the role/spec/status accessors the reconciler
+needs (reference: ``GetSpecs/GetStatuses/GetResourceOrder/SetStatus``,
+paddlejob_types.go:234-268).
+
+New relative to the reference: ``spec.device`` (cpu|gpu|tpu) and ``spec.tpu``
+(accelerator + slice topology) — the TPU-native mode where pods request
+``google.com/tpu`` on GKE TPU node pools and rendezvous via
+``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` over ICI instead of NCCL ports.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+GROUP = "batch.tpujob.dev"
+VERSION = "v1"
+API_VERSION = "%s/%s" % (GROUP, VERSION)
+KIND = "TpuJob"
+PLURAL = "tpujobs"
+SHORT_NAME = "tj"
+
+# label keys (reference: paddlejob_types.go:29-35)
+LABEL_RES_NAME = "tpujob-res-name"
+LABEL_RES_TYPE = "tpujob-res-type"
+ANNOT_RESOURCE = "tpujob-resource"
+
+# role names (reference: paddlejob_types.go:37-41)
+RES_PS = "ps"
+RES_WORKER = "worker"
+RES_HETER = "heter"
+RESOURCE_ORDER = [RES_PS, RES_WORKER, RES_HETER]
+
+# role -> env role string (reference: paddlejob_types.go:43-48)
+TRAINING_ROLE = {RES_PS: "PSERVER", RES_WORKER: "TRAINER", RES_HETER: "HETER"}
+
+
+class Phase:
+    """Job phases (reference: paddlejob_types.go:64-79)."""
+
+    STARTING = "Starting"
+    PENDING = "Pending"
+    SCALING = "Scaling"
+    ABORTING = "Aborting"
+    ABORTED = "Aborted"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    COMPLETING = "Completing"
+    COMPLETED = "Completed"
+    TERMINATING = "Terminating"
+    TERMINATED = "Terminated"
+    FAILED = "Failed"
+    SUCCEED = "Succeed"
+    UNKNOWN = "Unknown"
+
+    ALL = [
+        STARTING, PENDING, SCALING, ABORTING, ABORTED, RUNNING, RESTARTING,
+        COMPLETING, COMPLETED, TERMINATING, TERMINATED, FAILED, SUCCEED, UNKNOWN,
+    ]
+
+
+class Mode:
+    """Job modes (reference: paddlejob_types.go:50-59)."""
+
+    PS = "PS"
+    COLLECTIVE = "Collective"
+    SINGLE = "Single"
+
+
+class Intranet:
+    """Pod intercommunication modes (reference: paddlejob_types.go:104-110).
+
+    On TPU (device=tpu) only host discovery matters — ICI needs no k8s port
+    plumbing — so PodIP is the default and Service exists for stable DNS names.
+    """
+
+    POD_IP = "PodIP"
+    SERVICE = "Service"
+    HOST = "Host"
+
+
+class CleanPodPolicy:
+    """(reference: paddlejob_types.go:81-92)"""
+
+    ALWAYS = "Always"
+    NEVER = "Never"
+    ON_FAILURE = "OnFailure"
+    ON_COMPLETION = "OnCompletion"
+
+
+class ElasticStatus:
+    """(reference: paddlejob_types.go:94-102)"""
+
+    NONE = "NONE"
+    DOING = "DOING"
+    DONE = "DONE"
+    ERROR = "ERROR"
+
+
+class Device:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+# chips per TPU-VM host by accelerator generation — used to derive the number
+# of worker pods (hosts) covering a slice topology.
+TPU_CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
+
+# GKE node selector values per generation.
+TPU_GKE_ACCELERATOR = {
+    "v4": "tpu-v4-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v6e": "tpu-v6e-slice",
+}
+
+
+def topology_chips(topology: str) -> int:
+    """'4x8' -> 32; '2x2x2' -> 8."""
+    dims = [int(d) for d in topology.lower().split("x")]
+    return math.prod(dims)
+
+
+class TpuJob:
+    """Typed view over a TpuJob dict object."""
+
+    def __init__(self, obj: dict):
+        self.obj = obj
+
+    # -- metadata ----------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.obj["metadata"]["name"]
+
+    @property
+    def namespace(self) -> str:
+        return self.obj["metadata"].get("namespace", "default")
+
+    @property
+    def metadata(self) -> dict:
+        return self.obj.setdefault("metadata", {})
+
+    @property
+    def spec(self) -> dict:
+        return self.obj.setdefault("spec", {})
+
+    @property
+    def status(self) -> dict:
+        return self.obj.setdefault("status", {})
+
+    @status.setter
+    def status(self, value: dict) -> None:
+        self.obj["status"] = value
+
+    # -- spec accessors ----------------------------------------------------
+
+    def get_specs(self) -> Dict[str, Optional[dict]]:
+        """role -> ResourceSpec dict or None (reference: GetSpecs :234-240)."""
+        return {r: self.spec.get(r) for r in RESOURCE_ORDER}
+
+    def get_statuses(self) -> Dict[str, Optional[dict]]:
+        return {r: self.status.get(r) for r in RESOURCE_ORDER}
+
+    def get_resource_order(self) -> List[str]:
+        return list(RESOURCE_ORDER)
+
+    def set_status(self, res_type: str, status: Optional[dict]) -> None:
+        if res_type in RESOURCE_ORDER and status is not None:
+            self.status[res_type] = status
+
+    @property
+    def device(self) -> str:
+        return self.spec.get("device", Device.CPU)
+
+    @property
+    def tpu(self) -> dict:
+        return self.spec.get("tpu") or {}
+
+    @property
+    def intranet(self) -> str:
+        return self.spec.get("intranet", "")
+
+    @property
+    def elastic(self) -> Optional[int]:
+        return self.spec.get("elastic")
+
+    @property
+    def clean_pod_policy(self) -> str:
+        return self.spec.get("cleanPodPolicy", "")
+
+    @property
+    def scheduling_policy(self) -> Optional[dict]:
+        return self.spec.get("schedulingPolicy")
+
+    @property
+    def with_gloo(self) -> Optional[int]:
+        return self.spec.get("withGloo")
+
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase", "")
+
+    @property
+    def mode(self) -> str:
+        return self.status.get("mode", "")
+
+    # -- TPU topology ------------------------------------------------------
+
+    def tpu_chips_per_host(self) -> int:
+        tpu = self.tpu
+        if "chipsPerHost" in tpu:
+            return int(tpu["chipsPerHost"])
+        accel = tpu.get("accelerator", "v5e")
+        return TPU_CHIPS_PER_HOST.get(accel, 8)
+
+    def tpu_hosts(self) -> int:
+        """Number of TPU-VM hosts covering the slice topology."""
+        tpu = self.tpu
+        if "topology" in tpu:
+            chips = topology_chips(tpu["topology"])
+            return max(1, chips // self.tpu_chips_per_host())
+        worker = self.spec.get(RES_WORKER)
+        return worker["replicas"] if worker else 1
+
+    def validate(self) -> List[str]:
+        """Return a list of human-readable spec problems (empty = valid)."""
+        errs = []
+        if not any(self.spec.get(r) for r in RESOURCE_ORDER):
+            errs.append("at least one of spec.ps/worker/heter must be set")
+        for r in RESOURCE_ORDER:
+            rs = self.spec.get(r)
+            if rs is None:
+                continue
+            if rs.get("replicas", 0) < 0:
+                errs.append("spec.%s.replicas must be >= 0" % r)
+            tmpl_spec = (rs.get("template") or {}).get("spec") or {}
+            if not tmpl_spec.get("containers"):
+                errs.append("spec.%s.template.spec.containers must be non-empty" % r)
+        if self.device not in (Device.CPU, Device.GPU, Device.TPU):
+            errs.append("spec.device must be cpu|gpu|tpu")
+        if self.device == Device.TPU:
+            if self.intranet == Intranet.HOST:
+                errs.append("intranet=Host is not supported for device=tpu")
+            tpu = self.tpu
+            if tpu.get("topology"):
+                hosts = self.tpu_hosts()
+                worker = self.spec.get(RES_WORKER) or {}
+                if worker and worker.get("replicas") not in (None, hosts):
+                    errs.append(
+                        "spec.worker.replicas (%s) must equal hosts in slice "
+                        "topology %s (%d hosts x %d chips); a TPU slice is "
+                        "all-or-nothing" % (
+                            worker.get("replicas"), tpu["topology"], hosts,
+                            self.tpu_chips_per_host(),
+                        )
+                    )
+            if tpu.get("accelerator") and tpu["accelerator"] not in TPU_CHIPS_PER_HOST:
+                errs.append(
+                    "spec.tpu.accelerator must be one of %s"
+                    % sorted(TPU_CHIPS_PER_HOST)
+                )
+        if self.intranet and self.intranet not in (
+            Intranet.POD_IP, Intranet.SERVICE, Intranet.HOST
+        ):
+            errs.append("spec.intranet must be PodIP|Service|Host")
+        if self.clean_pod_policy and self.clean_pod_policy not in (
+            CleanPodPolicy.ALWAYS, CleanPodPolicy.NEVER,
+            CleanPodPolicy.ON_FAILURE, CleanPodPolicy.ON_COMPLETION,
+        ):
+            errs.append("spec.cleanPodPolicy must be Always|Never|OnFailure|OnCompletion")
+        return errs
+
+
+def new_tpujob(
+    name: str,
+    namespace: str = "default",
+    spec: Optional[dict] = None,
+) -> dict:
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec or {},
+    }

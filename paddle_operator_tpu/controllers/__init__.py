@@ -1,0 +1,8 @@
+"""Controller layer: reconcile loop, constructors, elastic sync, host ports.
+
+Reference equivalents: ``controllers/paddlejob_controller.go`` (reconciler),
+``controllers/paddlejob_helper.go`` (pure constructors + state machine),
+``controllers/paddlejob_elastic.go`` (etcd np sync).
+"""
+
+from .reconciler import TpuJobReconciler  # noqa: F401

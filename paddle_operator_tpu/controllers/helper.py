@@ -1,0 +1,505 @@
+"""Pure helpers: job state machine and child-object constructors.
+
+Reference: ``controllers/paddlejob_helper.go`` end to end. Everything here is
+a pure function of (job, child pods) — deterministic and unit-testable, which
+is exactly the property the reference's helpers have and its test suite never
+exploited.
+
+TPU-native additions relative to the reference:
+
+* device=tpu pods request ``google.com/tpu`` and carry GKE TPU node selectors
+  derived from ``spec.tpu`` (accelerator + slice topology).
+* env is ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` / coordinator address for
+  ``jax.distributed.initialize`` — no NCCL ports, no 20-port services.
+* the global ConfigMap barrier carries the full ordered hostname list so every
+  host calls ``jax.distributed.initialize`` with an identical world view.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from ..api import types as api
+from ..k8s import objects as k8s
+
+# reference: paddlejob_controller.go:49-55
+TRAIN_PORT = 2379          # base intra-job port (PADDLE_PORT parity)
+PORTS_PER_POD = 20         # TRAINER_PORTS_NUM / HOST_PORT_NUM parity
+HOST_PORT_ANNOTATION = "host-port"
+FINALIZER = "finalizers.tpujob.dev"
+
+# reference: paddlejob_helper.go:30-41
+SCHEDULER_VOLCANO = "volcano"
+PODGROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+VOLCANO_TASK_KEY = "volcano.sh/task-spec"
+VOLCANO_JOB_NAME_KEY = "volcano.sh/job-name"
+VOLCANO_JOB_VERSION_KEY = "volcano.sh/job-version"
+VOLCANO_QUEUE_KEY = "volcano.sh/queue-name"
+
+COORD_CONTAINER_NAME = "coord-tpujob"
+COORD_CONTAINER_CPU = "10m"
+COORD_CONTAINER_MEM = "10Mi"
+COORD_CONTAINER_CMD = [
+    "sh", "-c",
+    "while true; do if [ -f goon ]; then exit 0; else sleep 0.1; fi; done",
+]
+
+TPU_RESOURCE = "google.com/tpu"
+GKE_TPU_ACCEL_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
+
+
+# ---------------------------------------------------------------------------
+# naming (reference: paddlejob_helper.go:201-213)
+# ---------------------------------------------------------------------------
+
+def gen_res_name(job_name: str, res_type: str, idx: int) -> str:
+    return "%s-%s-%d" % (job_name, res_type, idx)
+
+
+def extract_name_index(name: str):
+    """'job-worker-3' -> ('worker', 3); unparsable -> ('', 0)."""
+    parts = name.split("-")
+    try:
+        return parts[-2], int(parts[-1])
+    except (IndexError, ValueError):
+        return "", 0
+
+
+# ---------------------------------------------------------------------------
+# pod / role-status predicates (reference: paddlejob_helper.go:43-173)
+# ---------------------------------------------------------------------------
+
+def is_pod_created(spec: Optional[dict], status: Optional[dict]) -> bool:
+    if spec is None:
+        return True
+    return status is not None and len(status.get("refs", [])) == spec["replicas"]
+
+
+def is_all_pods_created(job: api.TpuJob) -> bool:
+    specs, statuses = job.get_specs(), job.get_statuses()
+    return all(is_pod_created(specs[r], statuses[r]) for r in specs)
+
+
+def is_all_pods_ready(job: api.TpuJob, child_pods: List[dict]) -> bool:
+    """All pods exist and have IPs — the ConfigMap-barrier precondition."""
+    if not is_all_pods_created(job):
+        return False
+    return all(k8s.pod_ip(p) for p in child_pods)
+
+
+def _cnt(status: Optional[dict], key: str) -> int:
+    return (status or {}).get(key, 0)
+
+
+def is_failed(status):
+    return _cnt(status, "failed") > 0
+
+
+def is_pending(status):
+    return _cnt(status, "pending") > 0
+
+
+def is_starting(status):
+    return _cnt(status, "starting") > 0
+
+
+def is_running(spec, status):
+    return spec is None or (status is not None and spec["replicas"] == _cnt(status, "running"))
+
+
+def is_completed(spec, status):
+    return spec is None or (status is not None and spec["replicas"] == _cnt(status, "succeeded"))
+
+
+def is_pod_real_running(pod: dict) -> bool:
+    """PodRunning with every (init)container ready (reference :134-151)."""
+    if k8s.pod_phase(pod) != "Running":
+        return False
+    for c in k8s.container_statuses(pod, init=True):
+        if not c.get("ready"):
+            return False
+    statuses = k8s.container_statuses(pod)
+    if not statuses:
+        return False
+    for c in statuses:
+        if not c.get("ready") or "running" not in (c.get("state") or {}):
+            return False
+    return True
+
+
+def is_coord_container_running(pod: dict) -> bool:
+    """Pending pod whose coordination init container is live (reference :162-173)."""
+    if k8s.pod_phase(pod) != "Pending":
+        return False
+    for c in k8s.container_statuses(pod, init=True):
+        if c.get("name") == COORD_CONTAINER_NAME and "running" in (c.get("state") or {}):
+            return True
+    return False
+
+
+def is_all_coord_containers_running(child_pods: List[dict]) -> bool:
+    return all(is_coord_container_running(p) for p in child_pods)
+
+
+# ---------------------------------------------------------------------------
+# phase & mode state machine (reference: paddlejob_helper.go:92-199)
+# ---------------------------------------------------------------------------
+
+def get_job_phase(job: api.TpuJob) -> str:
+    """Sticky-final phase derivation, identical semantics to the reference."""
+    if job.phase == api.Phase.COMPLETED:
+        return api.Phase.COMPLETED
+    if job.phase == api.Phase.FAILED:
+        return api.Phase.FAILED
+
+    specs, statuses = job.get_specs(), job.get_statuses()
+    # priority across roles: Failed > Starting > Pending
+    if any(is_failed(s) for s in statuses.values()):
+        return api.Phase.FAILED
+    if any(is_starting(s) for s in statuses.values()):
+        return api.Phase.STARTING
+    if any(is_pending(s) for s in statuses.values()):
+        return api.Phase.PENDING
+
+    if all(is_running(specs[r], statuses[r]) for r in statuses):
+        return api.Phase.RUNNING
+    if all(is_completed(specs[r], statuses[r]) for r in statuses):
+        return api.Phase.COMPLETED
+
+    if job.phase == "":
+        return api.Phase.PENDING
+    return job.phase
+
+
+def get_job_mode(job: api.TpuJob) -> str:
+    if job.spec.get(api.RES_PS) is not None:
+        return api.Mode.PS
+    worker = job.spec.get(api.RES_WORKER)
+    if worker is not None and worker.get("replicas", 0) > 1:
+        return api.Mode.COLLECTIVE
+    return api.Mode.SINGLE
+
+
+def get_start_time(job: api.TpuJob) -> Optional[str]:
+    if not job.status.get("startTime") and job.phase == api.Phase.RUNNING:
+        return k8s.now_iso()
+    return job.status.get("startTime")
+
+
+def get_completion_time(job: api.TpuJob) -> Optional[str]:
+    if not job.status.get("completionTime") and job.phase in (
+        api.Phase.COMPLETED, api.Phase.FAILED
+    ):
+        return k8s.now_iso()
+    return job.status.get("completionTime")
+
+
+# ---------------------------------------------------------------------------
+# env & ConfigMap construction (reference: paddlejob_helper.go:215-279)
+# ---------------------------------------------------------------------------
+
+def endpoints_to_hosts(eps: List[str]) -> str:
+    return ",".join(e.split(":")[0] for e in eps)
+
+
+def construct_configmap(job: api.TpuJob, child_pods: List[dict]) -> Optional[dict]:
+    """Build the global-env ConfigMap once every pod has an IP.
+
+    Returns None if any pod lacks a well-formed IP (reference :226-227 returns
+    nil on malformed PodIP) — callers requeue.
+    """
+    resources: Dict[str, List[str]] = {}
+    specs = job.get_specs()
+    for res_type, spec in specs.items():
+        if spec is not None:
+            resources[res_type] = [""] * spec["replicas"]
+
+    for pod in child_pods:
+        ip = k8s.pod_ip(pod)
+        if len(ip.split(".")) != 4:
+            return None
+        res_type, idx = extract_name_index(pod["metadata"]["name"])
+        if res_type not in resources or idx >= len(resources[res_type]):
+            continue
+        if job.intranet == api.Intranet.SERVICE:
+            resources[res_type][idx] = "%s:%d" % (pod["metadata"]["name"], TRAIN_PORT)
+        else:
+            resources[res_type][idx] = "%s:%d" % (ip, TRAIN_PORT)
+
+    if job.intranet == api.Intranet.HOST:
+        port = job.metadata.get("annotations", {}).get(HOST_PORT_ANNOTATION, str(TRAIN_PORT))
+    else:
+        port = str(TRAIN_PORT)
+
+    cm = k8s.new_object(
+        "v1", "ConfigMap", job.name, job.namespace,
+        labels={api.LABEL_RES_NAME: job.name}, annotations={},
+    )
+    data = {
+        "TRAINER_PORTS_NUM": str(PORTS_PER_POD),
+        "PADDLE_PORT": port,
+    }
+
+    if specs[api.RES_PS] is not None:
+        data["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(resources[api.RES_PS])
+    if specs[api.RES_WORKER] is not None:
+        data["PADDLE_TRAINER_ENDPOINTS"] = ",".join(resources[api.RES_WORKER])
+        data["PADDLE_TRAINERS"] = endpoints_to_hosts(resources[api.RES_WORKER])
+        data["PADDLE_TRAINERS_NUM"] = str(specs[api.RES_WORKER]["replicas"])
+    if specs[api.RES_HETER] is not None:
+        data["PADDLE_HETER_ENDPOINTS"] = ",".join(resources[api.RES_HETER])
+
+    with_gloo = job.with_gloo
+    if with_gloo and with_gloo > 0 and resources.get(api.RES_PS):
+        data["PADDLE_WITH_GLOO"] = str(with_gloo)
+        data["PADDLE_GLOO_RENDEZVOUS"] = "3"
+        data["PADDLE_GLOO_HTTP_ENDPOINT"] = resources[api.RES_PS][0].replace(
+            ":%d" % TRAIN_PORT, ":%d" % (TRAIN_PORT + PORTS_PER_POD - 2), 1
+        )
+
+    if job.device == api.Device.TPU and specs[api.RES_WORKER] is not None:
+        # TPU multi-host bring-up: every host must see the identical ordered
+        # host list; worker-0 is the jax.distributed coordinator.
+        hosts = endpoints_to_hosts(resources[api.RES_WORKER])
+        data["TPU_WORKER_HOSTNAMES"] = hosts
+        data["TPUJOB_NUM_WORKERS"] = str(specs[api.RES_WORKER]["replicas"])
+        data["TPUJOB_COORDINATOR"] = resources[api.RES_WORKER][0]
+
+    cm["data"] = data
+    return cm
+
+
+# ---------------------------------------------------------------------------
+# pod construction (reference: paddlejob_helper.go:281-394)
+# ---------------------------------------------------------------------------
+
+def construct_pod(job: api.TpuJob, res_type: str, idx: int) -> dict:
+    name = gen_res_name(job.name, res_type, idx)
+    spec = job.get_specs()[res_type]
+    template = copy.deepcopy(spec.get("template") or {})
+
+    pod = k8s.new_object("v1", "Pod", name, job.namespace)
+    pod["metadata"].update(copy.deepcopy(template.get("metadata") or {}))
+    pod["metadata"]["name"] = name
+    pod["metadata"]["namespace"] = job.namespace
+    pod["spec"] = copy.deepcopy(template.get("spec") or {})
+
+    labels = pod["metadata"].setdefault("labels", {})
+    labels[api.LABEL_RES_NAME] = name
+    labels[api.LABEL_RES_TYPE] = res_type
+    annots = pod["metadata"].setdefault("annotations", {})
+    annots[api.ANNOT_RESOURCE] = res_type
+
+    # stable per-pod DNS: hostname + subdomain (headless svc of same name)
+    pod["spec"]["hostname"] = name
+    pod["spec"]["subdomain"] = name
+
+    containers = pod["spec"].setdefault("containers", [{}])
+    c0 = containers[0]
+    env = c0.setdefault("env", [])
+
+    if job.intranet == api.Intranet.SERVICE:
+        env.append({"name": "POD_IP", "value": name})
+    else:
+        env.append({
+            "name": "POD_IP",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+        })
+    env.append({"name": "PADDLE_TRAINER_ID", "value": str(idx)})
+    env.append({"name": "TRAINING_ROLE", "value": api.TRAINING_ROLE[res_type]})
+    env.append({"name": "PADDLE_TRAINING_ROLE", "value": api.TRAINING_ROLE[res_type]})
+
+    if job.device == api.Device.TPU:
+        _tpu_ify_pod(job, pod, res_type, idx)
+
+    if job.elastic is not None:
+        env.append({
+            "name": "PADDLE_ELASTIC_JOB_ID",
+            "value": "%s-%s" % (job.namespace, job.name),
+        })
+        worker = job.spec.get(api.RES_WORKER) or {"replicas": 1}
+        env.append({"name": "PADDLE_ELASTIC_NP", "value": str(worker["replicas"])})
+        env.append({"name": "PADDLE_ELASTIC_TIMEOUT", "value": "60"})
+        env.append({"name": "TPUJOB_ELASTIC_NP", "value": str(worker["replicas"])})
+    else:
+        # global-env barrier: container can't start until the ConfigMap exists
+        c0.setdefault("envFrom", []).append(
+            {"configMapRef": {"name": job.name}}
+        )
+
+    if job.intranet == api.Intranet.SERVICE:
+        c0.setdefault("ports", []).append({"containerPort": TRAIN_PORT})
+    elif job.intranet == api.Intranet.HOST:
+        pod["spec"]["hostNetwork"] = True
+
+    if job.elastic is not None:
+        pod["spec"]["restartPolicy"] = "OnFailure"
+    elif not pod["spec"].get("restartPolicy"):
+        if res_type == api.RES_WORKER and job.intranet == api.Intranet.SERVICE:
+            pod["spec"]["restartPolicy"] = "OnFailure"
+        else:
+            pod["spec"]["restartPolicy"] = "Never"
+
+    return pod
+
+
+def _tpu_ify_pod(job: api.TpuJob, pod: dict, res_type: str, idx: int) -> None:
+    """Inject the TPU data-plane wiring: chips, node selectors, TPU env.
+
+    Replaces the reference's NCCL/port machinery (paddlejob_helper.go:432-455
+    services + host ports) — ICI is wired by the TPU runtime; we only need
+    host discovery + a deterministic worker id.
+    """
+    c0 = pod["spec"]["containers"][0]
+    env = c0.setdefault("env", [])
+    tpu = job.tpu
+
+    if res_type == api.RES_WORKER:
+        chips = job.tpu_chips_per_host()
+        res = c0.setdefault("resources", {})
+        for kind in ("requests", "limits"):
+            bucket = res.setdefault(kind, {})
+            bucket.setdefault(TPU_RESOURCE, str(chips))
+
+        sel = pod["spec"].setdefault("nodeSelector", {})
+        accel = tpu.get("accelerator", "v5e")
+        sel.setdefault(
+            GKE_TPU_ACCEL_SELECTOR,
+            api.TPU_GKE_ACCELERATOR.get(accel, api.TPU_GKE_ACCELERATOR["v5e"]),
+        )
+        if tpu.get("topology"):
+            sel.setdefault(GKE_TPU_TOPOLOGY_SELECTOR, tpu["topology"])
+
+        env.append({"name": "TPU_WORKER_ID", "value": str(idx)})
+        env.append({"name": "TPUJOB_WORKER_ID", "value": str(idx)})
+        # TPU_WORKER_HOSTNAMES / TPUJOB_COORDINATOR arrive via the ConfigMap
+        # barrier (non-elastic) or the membership store (elastic).
+
+
+def construct_service_for_pod(pod: dict, device: str = api.Device.CPU) -> dict:
+    """Headless per-pod Service (reference: paddlejob_helper.go:432-455).
+
+    CPU/GPU parity keeps the reference's 20-port block; TPU jobs expose only
+    the coordinator port — ICI carries the collectives, not k8s networking.
+    """
+    name = pod["metadata"]["name"]
+    n_ports = 1 if device == api.Device.TPU else PORTS_PER_POD
+    ports = [
+        {"name": "p-%d" % i, "port": TRAIN_PORT + i} for i in range(n_ports)
+    ]
+    svc = k8s.new_object("v1", "Service", name, pod["metadata"].get("namespace", "default"))
+    svc["spec"] = {
+        "ports": ports,
+        "selector": {api.LABEL_RES_NAME: name},
+        "clusterIP": "None",
+    }
+    return svc
+
+
+def gen_coordinate_init_container(image: str) -> dict:
+    """Busybox gate container released by the operator (reference :379-394)."""
+    return {
+        "name": COORD_CONTAINER_NAME,
+        "image": image,
+        "imagePullPolicy": "IfNotPresent",
+        "command": list(COORD_CONTAINER_CMD),
+        "resources": {
+            "requests": {"cpu": COORD_CONTAINER_CPU, "memory": COORD_CONTAINER_MEM}
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Volcano gang scheduling (reference: paddlejob_helper.go:457-549)
+# ---------------------------------------------------------------------------
+
+def without_volcano(job: api.TpuJob) -> bool:
+    """True if any role pins a non-volcano scheduler explicitly."""
+    for spec in job.get_specs().values():
+        if spec is None:
+            continue
+        sched = ((spec.get("template") or {}).get("spec") or {}).get("schedulerName", "")
+        if sched and sched != SCHEDULER_VOLCANO:
+            return True
+    return False
+
+
+def get_total_replicas(job: api.TpuJob) -> int:
+    return sum(
+        spec["replicas"] for spec in job.get_specs().values() if spec is not None
+    )
+
+
+def _parse_quantity(q) -> float:
+    """Parse a k8s resource quantity into a float of base units."""
+    s = str(q)
+    suffixes = {
+        "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    }
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "m", "k", "M", "G", "T", "P"):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * suffixes[suf]
+    return float(s)
+
+
+def _format_quantity(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    # express sub-unit quantities in millis
+    return "%dm" % round(v * 1000)
+
+
+def add_resource_lists(total: Dict[str, float], res: Dict[str, str]) -> None:
+    for name, q in res.items():
+        total[name] = total.get(name, 0.0) + _parse_quantity(q)
+
+
+def get_pg_min_resources(job: api.TpuJob) -> Dict[str, str]:
+    """Sum container requests (falling back to limits) across all replicas."""
+    total: Dict[str, float] = {}
+    for spec in job.get_specs().values():
+        if spec is None:
+            continue
+        for _ in range(spec["replicas"]):
+            for c in ((spec.get("template") or {}).get("spec") or {}).get("containers", []):
+                res = c.get("resources") or {}
+                if res.get("requests"):
+                    add_resource_lists(total, res["requests"])
+                elif res.get("limits"):
+                    add_resource_lists(total, res["limits"])
+        # device=tpu chips are injected at pod-construction time, so account
+        # for them here too: the PodGroup must reserve the FULL slice.
+        if job.device == api.Device.TPU and spec is job.spec.get(api.RES_WORKER):
+            total[TPU_RESOURCE] = total.get(TPU_RESOURCE, 0.0) + (
+                spec["replicas"] * job.tpu_chips_per_host()
+            )
+    return {k: _format_quantity(v) for k, v in sorted(total.items())}
+
+
+def construct_podgroup(job: api.TpuJob) -> dict:
+    """Volcano PodGroup sized to the whole job — for TPU, the whole slice.
+
+    A multi-host TPU job is all-or-nothing at the slice level: partial
+    placement deadlocks XLA init, so minMember always covers every host.
+    """
+    pg = k8s.new_object(
+        "scheduling.volcano.sh/v1beta1", "PodGroup", job.name, job.namespace
+    )
+    pg["spec"] = {
+        "minMember": get_total_replicas(job),
+        "minResources": get_pg_min_resources(job),
+    }
+    sp = job.scheduling_policy
+    if sp:
+        if sp.get("minAvailable") is not None:
+            pg["spec"]["minMember"] = sp["minAvailable"]
+        if sp.get("queue"):
+            pg["spec"]["queue"] = sp["queue"]
+        if sp.get("priorityClass"):
+            pg["spec"]["priorityClassName"] = sp["priorityClass"]
+        if sp.get("minResources"):
+            pg["spec"]["minResources"] = dict(sp["minResources"])
+    return pg

@@ -1,0 +1,123 @@
+"""Host-port block allocator for intranet=Host jobs.
+
+Reference: the in-process HostPortMap allocator
+(``paddlejob_controller.go:407-458``) plus the legacy standalone
+``third_party/hostport-allocator``. Each Host-network job gets a block of
+PORTS_PER_POD consecutive host ports from a configured range, recorded in the
+job's ``host-port`` annotation and reclaimed on finalize.
+
+The allocation core prefers the native C++ implementation
+(``native/hostport.cpp`` via ctypes) with a pure-Python fallback with
+identical semantics; both are covered by the same tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, Optional
+
+from .helper import PORTS_PER_POD
+
+_NATIVE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_native", "libhostport.so",
+)
+
+
+class PortRangeAllocator:
+    """Block allocator over [start, end) stepping by PORTS_PER_POD.
+
+    Semantics (matching allocNewPort, paddlejob_controller.go:438-458):
+    wrap-around cursor, skip blocks already held, fail when range exhausted.
+    Thread-safe; reconstructible after controller restart via mark_used().
+    """
+
+    def __init__(self, start: int = 35000, end: int = 65000,
+                 block: int = PORTS_PER_POD):
+        if end - start < block:
+            raise ValueError("port range smaller than one block")
+        self.start, self.end, self.block = start, end, block
+        self._lock = threading.Lock()
+        self._used: Dict[int, bool] = {}
+        self._cursor = start
+        self._native = _load_native()
+        if self._native is not None:
+            self._handle = self._native.hp_new(start, end, block)
+
+    def alloc(self) -> Optional[int]:
+        """Allocate a fresh block; returns its base port or None if full."""
+        with self._lock:
+            if self._native is not None:
+                port = self._native.hp_alloc(self._handle)
+                if port < 0:
+                    return None
+                self._used[port] = True
+                return port
+            if len(self._used) * self.block > self.end - self.start:
+                return None
+            for _ in range((self.end - self.start) // self.block + 1):
+                port = self._cursor
+                nxt = port + self.block
+                self._cursor = nxt if nxt + self.block <= self.end else self.start
+                if port not in self._used:
+                    self._used[port] = True
+                    return port
+            return None
+
+    def mark_used(self, port: int) -> bool:
+        """Record a block observed in an annotation (controller restart path).
+
+        Returns False if the block was already recorded.
+        """
+        with self._lock:
+            if port in self._used:
+                return False
+            self._used[port] = True
+            if self._native is not None:
+                self._native.hp_mark_used(self._handle, port)
+            return True
+
+    def release(self, port: int) -> bool:
+        with self._lock:
+            if port not in self._used:
+                return False
+            del self._used[port]
+            if self._native is not None:
+                self._native.hp_release(self._handle, port)
+            return True
+
+    def is_used(self, port: int) -> bool:
+        with self._lock:
+            return port in self._used
+
+    @property
+    def used_count(self) -> int:
+        with self._lock:
+            return len(self._used)
+
+
+_native_lib = None
+_native_tried = False
+
+
+def _load_native():
+    global _native_lib, _native_tried
+    if _native_tried:
+        return _native_lib
+    _native_tried = True
+    try:
+        lib = ctypes.CDLL(_NATIVE_PATH)
+        lib.hp_new.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.hp_new.restype = ctypes.c_void_p
+        lib.hp_alloc.argtypes = [ctypes.c_void_p]
+        lib.hp_alloc.restype = ctypes.c_int
+        lib.hp_mark_used.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.hp_mark_used.restype = ctypes.c_int
+        lib.hp_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.hp_release.restype = ctypes.c_int
+        _native_lib = lib
+    except OSError:
+        _native_lib = None
+    return _native_lib

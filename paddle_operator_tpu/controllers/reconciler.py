@@ -1,0 +1,436 @@
+"""The TpuJob reconcile loop.
+
+Reference: ``controllers/paddlejob_controller.go:101-333`` — the same
+level-triggered shape: derive status from child pods, then converge the world
+one mutation per pass (create/delete at most one object, then let the next
+event-driven pass continue). TPU-native behavior differences are called out
+inline.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..api import types as api
+from ..elastic.store import KVStore
+from ..elastic.sync import sync_np
+from ..k8s import objects as k8s
+from ..k8s.client import EventRecorder, KubeClient
+from ..k8s.errors import ApiError, ConflictError, NotFoundError
+from . import helper
+from .hostport import PortRangeAllocator
+
+log = logging.getLogger("tpujob.reconciler")
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: Optional[float] = None
+
+    @property
+    def needs_requeue(self) -> bool:
+        return self.requeue or self.requeue_after is not None
+
+
+class TpuJobReconciler:
+    """Reconciles TpuJob objects against the cluster state."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        recorder: Optional[EventRecorder] = None,
+        scheduling: str = "",
+        init_image: str = "docker.io/library/busybox:1",
+        port_allocator: Optional[PortRangeAllocator] = None,
+        kv_store: Optional[KVStore] = None,
+    ):
+        self.client = client
+        self.recorder = recorder or EventRecorder(client, "tpujob-controller")
+        self.scheduling = scheduling
+        self.init_image = init_image
+        self.ports = port_allocator
+        self.kv = kv_store
+
+    # ------------------------------------------------------------------
+    # main loop
+    # ------------------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        try:
+            obj = self.client.get(api.KIND, namespace, name)
+        except NotFoundError:
+            return Result()
+        job = api.TpuJob(obj)
+
+        log.info(
+            "reconcile %s/%s version=%s phase=%s",
+            namespace, name,
+            job.metadata.get("resourceVersion"), job.phase,
+        )
+
+        errs = job.validate()
+        if errs:
+            self.recorder.event(
+                job.obj, "Warning", "InvalidSpec", "; ".join(errs)
+            )
+            return Result()
+
+        if self._finalize(job):
+            return Result(requeue_after=1.0)
+        if job.metadata.get("deletionTimestamp"):
+            return Result()
+
+        child_pods = self.client.list_owned("Pod", job.obj)
+
+        # -- status derivation (reference :122-131) ---------------------
+        old_status = k8s.deep_copy(job.status)
+        self._sync_current_status(job, child_pods)
+        if job.status != old_status:
+            try:
+                self.client.update_status(job.obj)
+            except ConflictError:
+                return Result(requeue_after=1.0)
+            except NotFoundError:
+                return Result()
+
+        # -- volcano gang gate (reference :133-157) ---------------------
+        if self.scheduling == helper.SCHEDULER_VOLCANO and not helper.without_volcano(job):
+            gate = self._ensure_podgroup(job)
+            if gate is not None:
+                return gate
+
+        specs = job.get_specs()
+
+        # -- scale-down: drop pods beyond replicas (reference :161-168) -
+        for pod in child_pods:
+            res_type, idx = helper.extract_name_index(pod["metadata"]["name"])
+            if specs.get(res_type) is not None and idx >= specs[res_type]["replicas"]:
+                self._delete_resource(job, pod)
+                return Result(requeue=True)
+
+        # -- per-pod headless services (reference :170-191) -------------
+        svcs: List[dict] = []
+        if job.intranet == api.Intranet.SERVICE:
+            svcs = self.client.list_owned("Service", job.obj)
+            have = {s["metadata"]["name"] for s in svcs}
+            for pod in child_pods:
+                if pod["metadata"]["name"] in have:
+                    continue
+                svc = helper.construct_service_for_pod(pod, job.device)
+                k8s.set_controller_reference(job.obj, svc)
+                self._create_resource(job, svc)
+                return Result()
+
+        # -- host-port block (reference :192-196) -----------------------
+        if job.intranet == api.Intranet.HOST:
+            if self._alloc_host_port(job):
+                return Result(requeue_after=1.0)
+
+        # -- elastic np sync (reference :209-219) -----------------------
+        if job.elastic is not None and self.kv is not None:
+            try:
+                np = sync_np(self.kv, job)
+            except Exception as e:  # store unreachable — surface and retry
+                log.error("elastic sync failed: %s", e)
+                return Result(requeue=True)
+            if np is not None:
+                self.recorder.event(
+                    job.obj, "Normal", "Scaled", "scaled replicas to %s" % np
+                )
+                return Result(requeue=True)
+
+        # -- clean-pod policy on terminal phases (reference :221-232) ---
+        policy = job.clean_pod_policy
+        if job.phase == api.Phase.FAILED and policy in (
+            api.CleanPodPolicy.ALWAYS, api.CleanPodPolicy.ON_FAILURE
+        ):
+            self._clean_one(job, child_pods, svcs)
+            return Result()
+        if job.phase == api.Phase.COMPLETED and policy in (
+            "", api.CleanPodPolicy.ALWAYS, api.CleanPodPolicy.ON_COMPLETION
+        ):
+            self._clean_one(job, child_pods, svcs)
+            return Result()
+
+        # -- create missing pods, one per pass (reference :234-287) -----
+        statuses = job.get_statuses()
+        for res in job.get_resource_order():
+            if specs.get(res) is None:
+                continue
+            if not helper.is_pod_created(specs[res], statuses.get(res)):
+                for i in range(specs[res]["replicas"]):
+                    if self._create_pod(job, res, i):
+                        return Result()
+
+        # -- global-env ConfigMap barrier (reference :289-306) ----------
+        if job.elastic is None and helper.is_all_pods_ready(job, child_pods):
+            try:
+                self.client.get("ConfigMap", job.namespace, job.name)
+            except NotFoundError:
+                cm = helper.construct_configmap(job, child_pods)
+                if cm is None:
+                    return Result(requeue=True)
+                k8s.set_controller_reference(job.obj, cm)
+                try:
+                    self._create_resource(job, cm)
+                except ConflictError:
+                    return Result(requeue=True)
+                return Result()
+
+        # -- ordered startup release (reference :308-330) ---------------
+        if job.phase == api.Phase.STARTING and self.init_image:
+            return self._coordinate_startup(job, child_pods, specs, statuses)
+
+        return Result()
+
+    # ------------------------------------------------------------------
+    # pieces
+    # ------------------------------------------------------------------
+
+    def _sync_current_status(self, job: api.TpuJob, child_pods: List[dict]) -> None:
+        """reference: syncCurrentStatus (paddlejob_controller.go:335-381)."""
+        new_status = {
+            "phase": helper.get_job_phase(job),
+            "mode": helper.get_job_mode(job),
+        }
+        if job.status.get("startTime"):
+            new_status["startTime"] = job.status["startTime"]
+        if job.status.get("completionTime"):
+            new_status["completionTime"] = job.status["completionTime"]
+
+        per_role = {}
+        for pod in child_pods:
+            res_type = pod["metadata"].get("annotations", {}).get(api.ANNOT_RESOURCE)
+            if not res_type:
+                continue
+            ss = per_role.setdefault(
+                res_type,
+                {"pending": 0, "starting": 0, "running": 0,
+                 "failed": 0, "succeeded": 0, "unknown": 0, "refs": []},
+            )
+            phase = k8s.pod_phase(pod)
+            if phase == "Pending":
+                if helper.is_coord_container_running(pod):
+                    ss["starting"] += 1
+                else:
+                    ss["pending"] += 1
+            elif phase == "Running":
+                if helper.is_pod_real_running(pod):
+                    ss["running"] += 1
+                else:
+                    ss["starting"] += 1
+            elif phase == "Failed":
+                ss["failed"] += 1
+            elif phase == "Succeeded":
+                ss["succeeded"] += 1
+            else:
+                ss["unknown"] += 1
+            ss["refs"].append({
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "name": pod["metadata"]["name"],
+                "namespace": pod["metadata"].get("namespace", "default"),
+                "uid": pod["metadata"].get("uid", ""),
+            })
+
+        job.status = new_status
+        for res_type, ss in per_role.items():
+            ss = {k: v for k, v in ss.items() if v or k == "refs"}
+            job.set_status(res_type, ss)
+        # recompute phase/times against the fresh per-role statuses
+        job.status["phase"] = helper.get_job_phase(job)
+        start = helper.get_start_time(job)
+        if start:
+            job.status["startTime"] = start
+        done = helper.get_completion_time(job)
+        if done:
+            job.status["completionTime"] = done
+        job.status["observedGeneration"] = job.metadata.get("generation", 1)
+
+    def _ensure_podgroup(self, job: api.TpuJob) -> Optional[Result]:
+        """Volcano gate: create PodGroup, block pod creation until it is
+        Running/Inqueue; delete it on terminal phases."""
+        try:
+            pg = self.client.get("PodGroup", job.namespace, job.name)
+            exists = True
+        except NotFoundError:
+            pg, exists = None, False
+
+        if job.phase in (api.Phase.FAILED, api.Phase.COMPLETED):
+            if exists:
+                self._delete_resource(job, pg)
+                return Result(requeue=True)
+            return None
+        if not exists:
+            pg = helper.construct_podgroup(job)
+            k8s.set_controller_reference(job.obj, pg)
+            try:
+                self._create_resource(job, pg)
+            except ApiError as e:
+                log.error("create podgroup failed: %s", e)
+            return Result(requeue=True)
+        pg_phase = (pg.get("status") or {}).get("phase")
+        if pg_phase not in ("Running", "Inqueue"):
+            return Result(requeue=True)
+        return None
+
+    def _create_pod(self, job: api.TpuJob, res_type: str, idx: int) -> bool:
+        name = helper.gen_res_name(job.name, res_type, idx)
+        try:
+            self.client.get("Pod", job.namespace, name)
+            return False
+        except NotFoundError:
+            pass
+        pod = helper.construct_pod(job, res_type, idx)
+
+        if self.init_image:
+            pod["spec"].setdefault("initContainers", []).append(
+                helper.gen_coordinate_init_container(self.init_image)
+            )
+
+        if self.scheduling == helper.SCHEDULER_VOLCANO and not helper.without_volcano(job):
+            pod["spec"]["schedulerName"] = helper.SCHEDULER_VOLCANO
+            annots = pod["metadata"].setdefault("annotations", {})
+            annots[helper.PODGROUP_ANNOTATION] = job.name
+            annots[helper.VOLCANO_TASK_KEY] = res_type
+            annots[helper.VOLCANO_JOB_NAME_KEY] = job.name
+            annots[helper.VOLCANO_JOB_VERSION_KEY] = str(
+                job.status.get("observedGeneration", 0)
+            )
+            sp = job.scheduling_policy
+            annots[helper.VOLCANO_QUEUE_KEY] = (sp or {}).get("queue", "")
+
+        if job.elastic is not None and self.kv is not None:
+            eps = ",".join(self.kv.endpoints())
+            env = pod["spec"]["containers"][0].setdefault("env", [])
+            env.append({"name": "PADDLE_ELASTIC_SERVER", "value": eps})
+            env.append({"name": "TPUJOB_ELASTIC_SERVER", "value": eps})
+
+        k8s.set_controller_reference(job.obj, pod)
+        try:
+            self._create_resource(job, pod)
+        except ApiError as e:
+            log.error("create pod failed: %s", e)
+        return True
+
+    def _coordinate_startup(self, job, child_pods, specs, statuses) -> Result:
+        """Release roles in order (ps → worker → heter) by exec'ing the gate
+        file into coord containers (reference :308-330)."""
+        order = job.get_resource_order()
+        for i, res in enumerate(order):
+            st = statuses.get(res)
+            if st is None or specs.get(res) is None:
+                continue
+            if st.get("running", 0) < specs[res]["replicas"]:
+                if (
+                    i == 0
+                    and st.get("running", 0) == 0
+                    and not helper.is_all_coord_containers_running(child_pods)
+                ):
+                    return Result(requeue_after=1.0)
+                for pod in child_pods:
+                    annot = pod["metadata"].get("annotations", {})
+                    if annot.get(api.ANNOT_RESOURCE) != res:
+                        continue
+                    if helper.is_coord_container_running(pod):
+                        try:
+                            self.client.exec_in_pod(
+                                job.namespace, pod["metadata"]["name"],
+                                helper.COORD_CONTAINER_NAME, ["touch", "goon"],
+                            )
+                        except Exception as e:
+                            log.warning("exec release failed: %s", e)
+                return Result(requeue_after=1.0)
+        return Result()
+
+    def _alloc_host_port(self, job: api.TpuJob) -> bool:
+        """reference: allocHostPortForJob (:407-435). True → requeue."""
+        if self.ports is None:
+            return False
+        annots = job.metadata.setdefault("annotations", {})
+        if helper.HOST_PORT_ANNOTATION in annots:
+            port = int(annots[helper.HOST_PORT_ANNOTATION])
+            if self.ports.is_used(port):
+                return False
+            if not job.metadata.get("deletionTimestamp"):
+                # controller restarted: re-learn the allocation
+                self.ports.mark_used(port)
+                return True
+            return False
+        port = self.ports.alloc()
+        if port is None:
+            self.recorder.event(
+                job.obj, "Warning", "PortExhausted", "host port range exhausted"
+            )
+            return False
+        annots[helper.HOST_PORT_ANNOTATION] = str(port)
+        try:
+            self.client.update(job.obj)
+        except ApiError as e:
+            log.error("persist host-port failed: %s", e)
+            self.ports.release(port)
+        return True
+
+    def _finalize(self, job: api.TpuJob) -> bool:
+        """Finalizer add/remove + host-port reclamation (reference :460-489)."""
+        meta = job.metadata
+        finalizers = meta.get("finalizers", [])
+        if not meta.get("deletionTimestamp"):
+            if helper.FINALIZER not in finalizers:
+                meta.setdefault("finalizers", []).append(helper.FINALIZER)
+                try:
+                    self.client.update(job.obj)
+                except ApiError:
+                    return True
+            return False
+        if helper.FINALIZER in finalizers:
+            if job.intranet == api.Intranet.HOST and self.ports is not None:
+                port = meta.get("annotations", {}).get(helper.HOST_PORT_ANNOTATION)
+                if port is not None and self.ports.is_used(int(port)):
+                    self.ports.release(int(port))
+                    return True
+            meta["finalizers"] = [f for f in finalizers if f != helper.FINALIZER]
+            try:
+                self.client.update(job.obj)
+            except ApiError:
+                return True
+        return False
+
+    def _clean_one(self, job: api.TpuJob, pods: List[dict], svcs: List[dict]) -> None:
+        """Delete one child per pass (reference cleanOne :198-207)."""
+        for pod in pods:
+            self._delete_resource(job, pod)
+            return
+        for svc in svcs:
+            self._delete_resource(job, svc)
+            return
+
+    def _create_resource(self, job: api.TpuJob, obj: dict) -> None:
+        kind, name = obj.get("kind", ""), obj["metadata"]["name"]
+        try:
+            self.client.create(obj)
+        except ApiError as e:
+            self.recorder.event(
+                job.obj, "Warning", "Create", "create failed %s %s" % (kind, name)
+            )
+            raise
+        self.recorder.event(job.obj, "Normal", "Created", "created %s %s" % (kind, name))
+
+    def _delete_resource(self, job: api.TpuJob, obj: dict) -> None:
+        if obj["metadata"].get("deletionTimestamp"):
+            return
+        kind, name = obj.get("kind", ""), obj["metadata"]["name"]
+        ns = obj["metadata"].get("namespace", "default")
+        try:
+            self.client.delete(kind, ns, name)
+        except NotFoundError:
+            return
+        except ApiError:
+            self.recorder.event(
+                job.obj, "Warning", "Delete", "delete failed %s %s" % (kind, name)
+            )
+            raise
+        self.recorder.event(job.obj, "Normal", "Deleted", "deleted %s %s" % (kind, name))

@@ -1,0 +1,16 @@
+"""Elastic membership: the etcd-equivalent KV store and sync protocol.
+
+Reference: ``controllers/paddlejob_elastic.go`` publishes the desired world
+size ("np") to etcd key ``/paddle/{ns}-{name}/np``; the in-container launcher
+watches it and resizes. Here the same protocol is expressed against a small
+KV interface with three backends: an in-memory store (tests), an HTTP JSON
+store served by :mod:`paddle_operator_tpu.elastic.server` (self-hosted, no
+etcd dependency), and a real etcd v3 gateway if one is present.
+
+On TPU, "elastic" means whole-slice restart from checkpoint — a collective
+job cannot shrink below the mesh it was compiled for — so alongside ``np``
+the store carries a membership *epoch* that workers use to agree on restarts.
+"""
+
+from .store import KVStore, MemoryKVStore, HttpKVStore  # noqa: F401
+from .sync import sync_np, np_key, epoch_key  # noqa: F401

@@ -1,0 +1,43 @@
+"""Controller-side elastic sync (reference: controllers/paddlejob_elastic.go).
+
+Publishes the desired world size to the membership store when it changes, and
+bumps the membership epoch so TPU workers restart collectively from the last
+checkpoint (a TPU mesh cannot shrink in place — see SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import types as api
+from .store import KVStore
+
+
+def np_key(namespace: str, name: str) -> str:
+    """Reference key shape: /paddle/{ns}-{name}/np (paddlejob_elastic.go:46)."""
+    return "/tpujob/%s-%s/np" % (namespace, name)
+
+
+def epoch_key(namespace: str, name: str) -> str:
+    return "/tpujob/%s-%s/epoch" % (namespace, name)
+
+
+def sync_np(store: KVStore, job: api.TpuJob) -> Optional[str]:
+    """Write worker replica count if changed; returns new np string or None.
+
+    Mirrors syncNP semantics (paddlejob_elastic.go:41-55): only Collective
+    jobs participate; compare-then-put. Additionally bumps the epoch on
+    change so the in-pod launcher can coordinate a whole-slice restart.
+    """
+    if job.mode != api.Mode.COLLECTIVE:
+        return None
+    worker = job.spec.get(api.RES_WORKER)
+    if worker is None:
+        return None
+    np = str(worker["replicas"])
+    key = np_key(job.namespace, job.name)
+    if store.compare_and_put(key, np):
+        cur = store.get(epoch_key(job.namespace, job.name))
+        store.put(epoch_key(job.namespace, job.name), str(int(cur or "0") + 1))
+        return np
+    return None

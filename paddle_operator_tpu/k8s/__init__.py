@@ -1,0 +1,18 @@
+"""Minimal Kubernetes machinery: object helpers, clients, controller runtime.
+
+Objects are plain nested dicts in exact Kubernetes JSON shape — the Python-
+idiomatic equivalent of the reference's generated Go structs + deepcopy
+(reference: ``api/v1/zz_generated.deepcopy.go``); ``copy.deepcopy`` is the
+deepcopy, JSON round-trip is the serde.
+"""
+
+from .objects import (  # noqa: F401
+    new_object,
+    object_key,
+    set_controller_reference,
+    get_controller_of,
+    owner_matches,
+    now_iso,
+)
+from .errors import ApiError, NotFoundError, AlreadyExistsError, ConflictError  # noqa: F401
+from .fake import FakeKubeClient  # noqa: F401

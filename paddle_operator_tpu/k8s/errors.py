@@ -1,0 +1,41 @@
+"""API error taxonomy mirroring k8s apimachinery StatusReason semantics."""
+
+
+class ApiError(Exception):
+    """Base class for Kubernetes API errors."""
+
+    reason = "Unknown"
+    code = 500
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+
+class NotFoundError(ApiError):
+    reason = "NotFound"
+    code = 404
+
+
+class AlreadyExistsError(ApiError):
+    reason = "AlreadyExists"
+    code = 409
+
+
+class ConflictError(ApiError):
+    """Optimistic-concurrency failure (stale resourceVersion)."""
+
+    reason = "Conflict"
+    code = 409
+
+
+class InvalidError(ApiError):
+    reason = "Invalid"
+    code = 422
+
+
+def ignore_not_found(exc: Exception) -> None:
+    """Re-raise unless the error is NotFound (client.IgnoreNotFound analog)."""
+    if isinstance(exc, NotFoundError):
+        return None
+    raise exc

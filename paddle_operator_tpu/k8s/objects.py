@@ -1,0 +1,143 @@
+"""Helpers over plain-dict Kubernetes objects.
+
+Every object is a nested dict in canonical k8s JSON shape::
+
+    {"apiVersion": "v1", "kind": "Pod",
+     "metadata": {"name": ..., "namespace": ..., "labels": {...}, ...},
+     "spec": {...}, "status": {...}}
+
+This module provides the small amount of typed machinery the controllers need:
+construction, keys, owner references (reference:
+``paddlejob_controller.go:520-532`` indexerFunc / SetControllerReference).
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import uuid
+from typing import Optional, Tuple
+
+
+def now_iso() -> str:
+    """RFC3339 timestamp like metav1.Now()."""
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .replace(microsecond=0)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+def new_object(
+    api_version: str,
+    kind: str,
+    name: str,
+    namespace: str = "default",
+    labels: Optional[dict] = None,
+    annotations: Optional[dict] = None,
+) -> dict:
+    obj = {
+        "apiVersion": api_version,
+        "kind": kind,
+        "metadata": {"name": name, "namespace": namespace},
+    }
+    if labels is not None:
+        obj["metadata"]["labels"] = dict(labels)
+    if annotations is not None:
+        obj["metadata"]["annotations"] = dict(annotations)
+    return obj
+
+
+def meta(obj: dict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def labels(obj: dict) -> dict:
+    return meta(obj).setdefault("labels", {})
+
+
+def annotations(obj: dict) -> dict:
+    return meta(obj).setdefault("annotations", {})
+
+
+def object_key(obj: dict) -> Tuple[str, str]:
+    m = meta(obj)
+    return (m.get("namespace", "default"), m.get("name", ""))
+
+
+def gvk(obj: dict) -> Tuple[str, str]:
+    return (obj.get("apiVersion", ""), obj.get("kind", ""))
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def set_controller_reference(owner: dict, obj: dict) -> None:
+    """Make `owner` the controlling owner of `obj` (ctrl.SetControllerReference)."""
+    om = meta(owner)
+    ref = {
+        "apiVersion": owner.get("apiVersion", ""),
+        "kind": owner.get("kind", ""),
+        "name": om.get("name", ""),
+        "uid": om.get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+    refs = meta(obj).setdefault("ownerReferences", [])
+    for existing in refs:
+        if existing.get("controller"):
+            raise ValueError(
+                "object %s already has a controlling owner" % meta(obj).get("name")
+            )
+    refs.append(ref)
+
+
+def get_controller_of(obj: dict) -> Optional[dict]:
+    """metav1.GetControllerOf analog."""
+    for ref in meta(obj).get("ownerReferences", []) or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def owner_matches(obj: dict, api_version: str, kind: str, name: str) -> bool:
+    """The owner-index predicate (reference: paddlejob_controller.go:520-532)."""
+    ref = get_controller_of(obj)
+    if ref is None:
+        return False
+    return (
+        ref.get("apiVersion") == api_version
+        and ref.get("kind") == kind
+        and ref.get("name") == name
+    )
+
+
+def match_labels(obj: dict, selector: Optional[dict]) -> bool:
+    if not selector:
+        return True
+    obj_labels = meta(obj).get("labels", {}) or {}
+    return all(obj_labels.get(k) == v for k, v in selector.items())
+
+
+def deep_copy(obj: dict) -> dict:
+    """DeepCopy analog."""
+    return copy.deepcopy(obj)
+
+
+# ---------------------------------------------------------------------------
+# Pod-status convenience predicates shared by controllers and the pod simulator
+# ---------------------------------------------------------------------------
+
+def pod_phase(pod: dict) -> str:
+    return (pod.get("status") or {}).get("phase", "")
+
+
+def pod_ip(pod: dict) -> str:
+    return (pod.get("status") or {}).get("podIP", "")
+
+
+def container_statuses(pod: dict, init: bool = False) -> list:
+    key = "initContainerStatuses" if init else "containerStatuses"
+    return (pod.get("status") or {}).get(key, []) or []

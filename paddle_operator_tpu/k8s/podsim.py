@@ -1,0 +1,167 @@
+"""PodSimulator — a kubelet model for hermetic controller tests.
+
+envtest has no kubelet, so the reference's suite can never exercise pod IPs,
+container states, the ConfigMap barrier, or exec-based startup ordering
+(SURVEY.md §4). This simulator closes that gap: it advances Pod objects in a
+FakeKubeClient through a faithful lifecycle:
+
+  created → Pending (no IP) → Pending+IP, coord init container Running
+         → [blocked until operator exec-releases the coord container]
+         → [blocked until every envFrom ConfigMap exists — the barrier,
+            surfacing as CreateContainerConfigError like faq.md:22-28]
+         → Running (all containers ready) → Succeeded/Failed on demand
+
+It also plays the Volcano scheduler for PodGroups (phase Pending → Inqueue/
+Running) and handles the operator's exec calls ("touch goon").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .errors import NotFoundError
+from .fake import FakeKubeClient
+
+
+class PodSimulator:
+    def __init__(self, client: FakeKubeClient, auto_admit_podgroups: bool = True,
+                 coord_container_name: str = "coord-tpujob"):
+        self.client = client
+        self.coord_name = coord_container_name
+        self.auto_admit_podgroups = auto_admit_podgroups
+        self._released: Dict[str, bool] = {}  # pod name -> coord released
+        self._desired: Dict[str, str] = {}    # pod name -> Succeeded/Failed
+        self._ip_seq = 0
+        client.exec_handler = self._handle_exec
+
+    # -- operator exec channel -----------------------------------------
+
+    def _handle_exec(self, namespace, pod_name, container, command):
+        if container == self.coord_name and list(command) == ["touch", "goon"]:
+            self._released[pod_name] = True
+        return ""
+
+    # -- test controls -------------------------------------------------
+
+    def finish(self, pod_name: str, succeeded: bool = True) -> None:
+        self._desired[pod_name] = "Succeeded" if succeeded else "Failed"
+
+    def finish_all(self, succeeded: bool = True) -> None:
+        for pod in self.client.all_objects("Pod"):
+            self.finish(pod["metadata"]["name"], succeeded)
+
+    # -- lifecycle engine ----------------------------------------------
+
+    def step(self) -> bool:
+        """Advance every pod/podgroup one lifecycle notch. True if changed."""
+        changed = False
+        if self.auto_admit_podgroups:
+            for pg in self.client.all_objects("PodGroup"):
+                if (pg.get("status") or {}).get("phase") not in ("Running", "Inqueue"):
+                    self.client.patch_status(
+                        "PodGroup", pg["metadata"]["namespace"],
+                        pg["metadata"]["name"], {"phase": "Running"},
+                    )
+                    changed = True
+        for pod in self.client.all_objects("Pod"):
+            if self._step_pod(pod):
+                changed = True
+        return changed
+
+    def _step_pod(self, pod: dict) -> bool:
+        name = pod["metadata"]["name"]
+        ns = pod["metadata"].get("namespace", "default")
+        status = pod.get("status") or {}
+        phase = status.get("phase", "")
+        desired = self._desired.get(name)
+
+        if phase in ("Succeeded", "Failed"):
+            return False
+
+        new_status = dict(status)
+
+        if not phase:
+            new_status["phase"] = "Pending"
+            self._write(ns, name, new_status)
+            return True
+
+        if not status.get("podIP"):
+            self._ip_seq += 1
+            new_status["podIP"] = "10.1.%d.%d" % (self._ip_seq // 250, self._ip_seq % 250 + 1)
+            self._write(ns, name, new_status)
+            return True
+
+        has_coord = any(
+            c.get("name") == self.coord_name
+            for c in pod["spec"].get("initContainers", [])
+        )
+        coord_released = self._released.get(name, False) or not has_coord
+
+        if phase == "Pending":
+            if has_coord and not coord_released:
+                running = [
+                    {"name": self.coord_name, "ready": False,
+                     "state": {"running": {}}}
+                ]
+                if new_status.get("initContainerStatuses") != running:
+                    new_status["initContainerStatuses"] = running
+                    self._write(ns, name, new_status)
+                    return True
+                return False
+            if not self._config_env_ready(pod):
+                waiting = [
+                    {"name": c.get("name", "main"), "ready": False,
+                     "state": {"waiting": {"reason": "CreateContainerConfigError"}}}
+                    for c in pod["spec"].get("containers", [])
+                ]
+                if new_status.get("containerStatuses") != waiting:
+                    new_status["containerStatuses"] = waiting
+                    self._write(ns, name, new_status)
+                    return True
+                return False
+            # everything unblocked: go Running
+            new_status["phase"] = "Running"
+            if has_coord:
+                new_status["initContainerStatuses"] = [
+                    {"name": self.coord_name, "ready": True,
+                     "state": {"terminated": {"exitCode": 0}}}
+                ]
+            new_status["containerStatuses"] = [
+                {"name": c.get("name", "main"), "ready": True,
+                 "state": {"running": {}}}
+                for c in pod["spec"].get("containers", [])
+            ]
+            self._write(ns, name, new_status)
+            return True
+
+        if phase == "Running" and desired:
+            new_status["phase"] = desired
+            new_status["containerStatuses"] = [
+                {"name": c.get("name", "main"), "ready": False,
+                 "state": {"terminated": {
+                     "exitCode": 0 if desired == "Succeeded" else 1}}}
+                for c in pod["spec"].get("containers", [])
+            ]
+            self._write(ns, name, new_status)
+            return True
+
+        return False
+
+    def _config_env_ready(self, pod: dict) -> bool:
+        """The ConfigMap barrier: envFrom references must all resolve."""
+        ns = pod["metadata"].get("namespace", "default")
+        for c in pod["spec"].get("containers", []):
+            for ef in c.get("envFrom", []) or []:
+                ref = (ef.get("configMapRef") or {}).get("name")
+                if ref:
+                    try:
+                        self.client.get("ConfigMap", ns, ref)
+                    except NotFoundError:
+                        return False
+        return True
+
+    def _write(self, ns: str, name: str, status: dict) -> None:
+        try:
+            self.client.patch_status("Pod", ns, name, status)
+        except NotFoundError:
+            pass

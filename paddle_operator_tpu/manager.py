@@ -1,0 +1,129 @@
+"""Operator entrypoint: ``python -m paddle_operator_tpu.manager``.
+
+Reference: ``main.go`` — flag surface kept 1:1 where it still makes sense
+(--namespace --scheduling --init-image --port-range --leader-elect
+--metrics-bind-address --health-probe-bind-address) with --membership-server
+replacing --etcd-server (same role: elastic world-size rendezvous; accepts any
+HTTP KV endpoint incl. the bundled elastic server).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .api import types as api
+from .controllers.hostport import PortRangeAllocator
+from .controllers.reconciler import TpuJobReconciler
+from .elastic.store import connect as kv_connect
+from .k8s.client import HttpKubeClient
+from .k8s.runtime import Manager
+
+
+def _serve(bind: str, handler_cls) -> ThreadingHTTPServer:
+    host, _, port = bind.rpartition(":")
+    srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler_cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="TpuJob operator manager")
+    ap.add_argument("--namespace", default="", help="namespace to watch ('' = all)")
+    ap.add_argument("--scheduling", default="", help="gang scheduler, e.g. volcano")
+    ap.add_argument("--init-image", default="docker.io/library/busybox:1",
+                    help="image for the coordination init container")
+    ap.add_argument("--membership-server", "--etcd-server", dest="membership",
+                    default="", help="elastic membership endpoint(s)")
+    ap.add_argument("--port-range", default="35000,65000")
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--metrics-bind-address", default=":8080")
+    ap.add_argument("--health-probe-bind-address", default=":8081")
+    ap.add_argument("--kube-api", default=None, help="apiserver URL override")
+    ap.add_argument("--insecure-skip-tls-verify", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    log = logging.getLogger("tpujob.manager")
+
+    client = HttpKubeClient(
+        base_url=args.kube_api, insecure=args.insecure_skip_tls_verify
+    )
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+
+    start, end = (int(p) for p in args.port_range.split(","))
+    kv = kv_connect(args.membership) if args.membership else None
+
+    reconciler = TpuJobReconciler(
+        client,
+        scheduling=args.scheduling,
+        init_image=args.init_image,
+        port_allocator=PortRangeAllocator(start, end),
+        kv_store=kv,
+    )
+    mgr = Manager(
+        client,
+        leader_election=args.leader_elect,
+        namespace=args.namespace or None,
+    )
+    mgr.add_controller(
+        "tpujob", reconciler.reconcile,
+        for_kind=api.KIND,
+        owns=["Pod", "Service", "ConfigMap", "PodGroup"],
+        owner_api_version=api.API_VERSION, owner_kind=api.KIND,
+    )
+
+    class Probes(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"ok"
+            if self.path not in ("/healthz", "/readyz"):
+                self.send_response(404)
+            else:
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    class Metrics(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = mgr.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    _serve(args.health_probe_bind_address, Probes)
+    _serve(args.metrics_bind_address, Metrics)
+
+    log.info("starting manager (scheduling=%r, membership=%r)",
+             args.scheduling, args.membership)
+    mgr.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU platform.
+
+Must run before any jax import so sharding/multichip tests exercise real
+`jax.sharding.Mesh` semantics without TPU hardware (the driver's
+dryrun_multichip uses the same trick).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

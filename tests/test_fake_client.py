@@ -1,0 +1,129 @@
+"""Tests for the in-memory apiserver (FakeKubeClient) semantics."""
+
+import pytest
+
+from paddle_operator_tpu.k8s import (
+    AlreadyExistsError, ConflictError, FakeKubeClient, NotFoundError,
+    new_object, set_controller_reference,
+)
+
+
+def pod(name, ns="default"):
+    p = new_object("v1", "Pod", name, ns)
+    p["spec"] = {"containers": [{"name": "main", "image": "img"}]}
+    return p
+
+
+def test_create_get_roundtrip():
+    c = FakeKubeClient()
+    c.create(pod("a"))
+    got = c.get("Pod", "default", "a")
+    assert got["metadata"]["name"] == "a"
+    assert got["metadata"]["uid"]
+    assert got["metadata"]["resourceVersion"]
+
+
+def test_create_duplicate_rejected():
+    c = FakeKubeClient()
+    c.create(pod("a"))
+    with pytest.raises(AlreadyExistsError):
+        c.create(pod("a"))
+
+
+def test_get_missing_raises():
+    c = FakeKubeClient()
+    with pytest.raises(NotFoundError):
+        c.get("Pod", "default", "nope")
+
+
+def test_update_conflict_on_stale_rv():
+    c = FakeKubeClient()
+    c.create(pod("a"))
+    first = c.get("Pod", "default", "a")
+    second = c.get("Pod", "default", "a")
+    first["metadata"]["labels"] = {"x": "1"}
+    c.update(first)
+    second["metadata"]["labels"] = {"x": "2"}
+    with pytest.raises(ConflictError):
+        c.update(second)
+
+
+def test_update_status_subresource_isolated():
+    c = FakeKubeClient()
+    c.create(pod("a"))
+    obj = c.get("Pod", "default", "a")
+    obj["status"] = {"phase": "Running"}
+    c.update_status(obj)
+    # spec update must not clobber status
+    obj2 = c.get("Pod", "default", "a")
+    assert obj2["status"]["phase"] == "Running"
+    obj2["metadata"]["labels"] = {"y": "1"}
+    c.update(obj2)
+    assert c.get("Pod", "default", "a")["status"]["phase"] == "Running"
+
+
+def test_finalizer_blocks_deletion():
+    c = FakeKubeClient()
+    p = pod("a")
+    p["metadata"]["finalizers"] = ["keep.me"]
+    c.create(p)
+    c.delete("Pod", "default", "a")
+    got = c.get("Pod", "default", "a")  # still there
+    assert got["metadata"]["deletionTimestamp"]
+    got["metadata"]["finalizers"] = []
+    c.update(got)
+    with pytest.raises(NotFoundError):
+        c.get("Pod", "default", "a")
+
+
+def test_owner_gc_cascades():
+    c = FakeKubeClient()
+    owner = new_object("batch.tpujob.dev/v1", "TpuJob", "job1")
+    owner = c.create(owner)
+    child = pod("job1-worker-0")
+    set_controller_reference(owner, child)
+    c.create(child)
+    c.delete("TpuJob", "default", "job1")
+    with pytest.raises(NotFoundError):
+        c.get("Pod", "default", "job1-worker-0")
+
+
+def test_list_with_labels_and_namespace():
+    c = FakeKubeClient()
+    a = pod("a")
+    a["metadata"]["labels"] = {"app": "x"}
+    c.create(a)
+    b = pod("b", ns="other")
+    b["metadata"]["labels"] = {"app": "x"}
+    c.create(b)
+    c.create(pod("c"))
+    assert len(c.list("Pod")) == 3
+    assert len(c.list("Pod", namespace="default")) == 2
+    assert len(c.list("Pod", label_selector={"app": "x"})) == 2
+    assert len(c.list("Pod", namespace="other", label_selector={"app": "x"})) == 1
+
+
+def test_watch_callbacks_fire():
+    c = FakeKubeClient()
+    events = []
+    c.add_watch_callback("Pod", None, lambda t, o: events.append((t, o["metadata"]["name"])))
+    c.create(pod("a"))
+    obj = c.get("Pod", "default", "a")
+    obj["metadata"]["labels"] = {"z": "1"}
+    c.update(obj)
+    c.delete("Pod", "default", "a")
+    assert events == [("ADDED", "a"), ("MODIFIED", "a"), ("DELETED", "a")]
+
+
+def test_generation_bumps_on_spec_change_only():
+    c = FakeKubeClient()
+    c.create(pod("a"))
+    obj = c.get("Pod", "default", "a")
+    assert obj["metadata"]["generation"] == 1
+    obj["spec"]["containers"][0]["image"] = "img2"
+    c.update(obj)
+    assert c.get("Pod", "default", "a")["metadata"]["generation"] == 2
+    obj = c.get("Pod", "default", "a")
+    obj["status"] = {"phase": "Running"}
+    c.update_status(obj)
+    assert c.get("Pod", "default", "a")["metadata"]["generation"] == 2

@@ -1,0 +1,399 @@
+"""Unit tests for the pure constructors and the job state machine.
+
+These cover what the reference left untested (SURVEY.md §4): phase
+derivation, pod/env construction, ConfigMap content, PodGroup sizing.
+"""
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import helper
+
+
+def make_job(spec=None, status=None, name="wide-and-deep", namespace="default"):
+    obj = api.new_tpujob(name, namespace, spec or {})
+    if status:
+        obj["status"] = status
+    return api.TpuJob(obj)
+
+
+def role_spec(replicas=1, image="img", resources=None):
+    c = {"name": "main", "image": image}
+    if resources:
+        c["resources"] = resources
+    return {"replicas": replicas, "template": {"spec": {"containers": [c]}}}
+
+
+# ---------------------------------------------------------------------------
+# naming
+# ---------------------------------------------------------------------------
+
+def test_gen_res_name_roundtrip():
+    name = helper.gen_res_name("job1", "worker", 3)
+    assert name == "job1-worker-3"
+    assert helper.extract_name_index(name) == ("worker", 3)
+
+
+def test_extract_name_index_unparsable():
+    assert helper.extract_name_index("nodigits") == ("", 0)
+
+
+# ---------------------------------------------------------------------------
+# mode derivation (reference: paddlejob_helper.go:191-199)
+# ---------------------------------------------------------------------------
+
+def test_mode_ps():
+    job = make_job({"ps": role_spec(2), "worker": role_spec(2)})
+    assert helper.get_job_mode(job) == api.Mode.PS
+
+
+def test_mode_collective():
+    job = make_job({"worker": role_spec(4)})
+    assert helper.get_job_mode(job) == api.Mode.COLLECTIVE
+
+
+def test_mode_single():
+    job = make_job({"worker": role_spec(1)})
+    assert helper.get_job_mode(job) == api.Mode.SINGLE
+
+
+# ---------------------------------------------------------------------------
+# phase machine (reference: paddlejob_helper.go:92-132)
+# ---------------------------------------------------------------------------
+
+def test_phase_sticky_final():
+    job = make_job({"worker": role_spec(2)}, status={"phase": api.Phase.COMPLETED})
+    assert helper.get_job_phase(job) == api.Phase.COMPLETED
+    job = make_job({"worker": role_spec(2)}, status={"phase": api.Phase.FAILED})
+    assert helper.get_job_phase(job) == api.Phase.FAILED
+
+
+def test_phase_any_failed_pod_fails_job():
+    job = make_job(
+        {"worker": role_spec(2)},
+        status={"phase": api.Phase.RUNNING,
+                "worker": {"running": 1, "failed": 1, "refs": []}},
+    )
+    assert helper.get_job_phase(job) == api.Phase.FAILED
+
+
+def test_phase_priority_starting_over_pending():
+    job = make_job(
+        {"worker": role_spec(3)},
+        status={"worker": {"starting": 1, "pending": 2, "refs": []}},
+    )
+    assert helper.get_job_phase(job) == api.Phase.STARTING
+
+
+def test_phase_all_running():
+    job = make_job(
+        {"ps": role_spec(1), "worker": role_spec(2)},
+        status={
+            "ps": {"running": 1, "refs": []},
+            "worker": {"running": 2, "refs": []},
+        },
+    )
+    assert helper.get_job_phase(job) == api.Phase.RUNNING
+
+
+def test_phase_all_succeeded_completes():
+    job = make_job(
+        {"worker": role_spec(2)},
+        status={"phase": api.Phase.RUNNING,
+                "worker": {"succeeded": 2, "refs": []}},
+    )
+    assert helper.get_job_phase(job) == api.Phase.COMPLETED
+
+
+def test_phase_empty_is_pending():
+    job = make_job({"worker": role_spec(2)})
+    assert helper.get_job_phase(job) == api.Phase.PENDING
+
+
+def test_phase_keeps_current_when_mixed():
+    # 1 running, 1 succeeded: neither all-running nor all-succeeded
+    job = make_job(
+        {"worker": role_spec(2)},
+        status={"phase": api.Phase.RUNNING,
+                "worker": {"running": 1, "succeeded": 1, "refs": []}},
+    )
+    assert helper.get_job_phase(job) == api.Phase.RUNNING
+
+
+# ---------------------------------------------------------------------------
+# pod construction (reference: paddlejob_helper.go:281-377)
+# ---------------------------------------------------------------------------
+
+def test_construct_pod_basic_env_and_identity():
+    job = make_job({"ps": role_spec(2), "worker": role_spec(2)})
+    pod = helper.construct_pod(job, "worker", 1)
+    assert pod["metadata"]["name"] == "wide-and-deep-worker-1"
+    assert pod["metadata"]["labels"][api.LABEL_RES_TYPE] == "worker"
+    assert pod["metadata"]["annotations"][api.ANNOT_RESOURCE] == "worker"
+    assert pod["spec"]["hostname"] == "wide-and-deep-worker-1"
+    assert pod["spec"]["subdomain"] == "wide-and-deep-worker-1"
+    env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+    assert env["PADDLE_TRAINER_ID"] == "1"
+    assert env["TRAINING_ROLE"] == "TRAINER"
+    assert env["PADDLE_TRAINING_ROLE"] == "TRAINER"
+    # non-elastic jobs block on the global-env ConfigMap
+    assert {"configMapRef": {"name": "wide-and-deep"}} in (
+        pod["spec"]["containers"][0]["envFrom"]
+    )
+    assert pod["spec"]["restartPolicy"] == "Never"
+
+
+def test_construct_pod_ps_role_env():
+    job = make_job({"ps": role_spec(2), "worker": role_spec(2)})
+    pod = helper.construct_pod(job, "ps", 0)
+    env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+    assert env["TRAINING_ROLE"] == "PSERVER"
+
+
+def test_construct_pod_service_intranet():
+    job = make_job({"worker": role_spec(2), "intranet": "Service"})
+    pod = helper.construct_pod(job, "worker", 0)
+    env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+    # POD_IP is the service name, not the fieldRef
+    assert env["POD_IP"] == "wide-and-deep-worker-0"
+    ports = pod["spec"]["containers"][0]["ports"]
+    assert {"containerPort": helper.TRAIN_PORT} in ports
+    # Service-intranet workers restart on failure
+    assert pod["spec"]["restartPolicy"] == "OnFailure"
+
+
+def test_construct_pod_host_intranet():
+    job = make_job({"worker": role_spec(2), "intranet": "Host"})
+    pod = helper.construct_pod(job, "worker", 0)
+    assert pod["spec"]["hostNetwork"] is True
+
+
+def test_construct_pod_elastic_env():
+    job = make_job({"worker": role_spec(3), "elastic": 1}, name="ers")
+    pod = helper.construct_pod(job, "worker", 2)
+    env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+    assert env["PADDLE_ELASTIC_JOB_ID"] == "default-ers"
+    assert env["PADDLE_ELASTIC_NP"] == "3"
+    assert env["PADDLE_ELASTIC_TIMEOUT"] == "60"
+    assert pod["spec"]["restartPolicy"] == "OnFailure"
+    # elastic pods do NOT use the ConfigMap barrier
+    assert "envFrom" not in pod["spec"]["containers"][0]
+
+
+def test_construct_pod_tpu_worker():
+    job = make_job({
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": "4x8"},
+        "worker": role_spec(4),
+    }, name="bert")
+    pod = helper.construct_pod(job, "worker", 2)
+    c0 = pod["spec"]["containers"][0]
+    assert c0["resources"]["requests"]["google.com/tpu"] == "8"
+    assert c0["resources"]["limits"]["google.com/tpu"] == "8"
+    sel = pod["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x8"
+    env = {e["name"]: e.get("value") for e in c0["env"]}
+    assert env["TPU_WORKER_ID"] == "2"
+
+
+def test_construct_pod_tpu_ps_gets_no_chips():
+    job = make_job({
+        "device": "tpu", "tpu": {"accelerator": "v5e"},
+        "ps": role_spec(1), "worker": role_spec(2),
+    })
+    pod = helper.construct_pod(job, "ps", 0)
+    res = pod["spec"]["containers"][0].get("resources", {})
+    assert "google.com/tpu" not in res.get("requests", {})
+
+
+def test_construct_pod_preserves_template():
+    tmpl = role_spec(2)
+    tmpl["template"]["metadata"] = {"labels": {"app": "x"}}
+    tmpl["template"]["spec"]["restartPolicy"] = "Always"
+    job = make_job({"worker": tmpl})
+    pod = helper.construct_pod(job, "worker", 0)
+    assert pod["metadata"]["labels"]["app"] == "x"
+    assert pod["spec"]["restartPolicy"] == "Always"
+
+
+# ---------------------------------------------------------------------------
+# ConfigMap construction (reference: paddlejob_helper.go:215-279)
+# ---------------------------------------------------------------------------
+
+def running_pod(name, ip):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "status": {"phase": "Running", "podIP": ip},
+    }
+
+
+def test_configmap_ps_mode():
+    job = make_job({"ps": role_spec(2), "worker": role_spec(2), "withGloo": 1})
+    pods = [
+        running_pod("wide-and-deep-ps-0", "10.0.0.1"),
+        running_pod("wide-and-deep-ps-1", "10.0.0.2"),
+        running_pod("wide-and-deep-worker-0", "10.0.0.3"),
+        running_pod("wide-and-deep-worker-1", "10.0.0.4"),
+    ]
+    cm = helper.construct_configmap(job, pods)
+    d = cm["data"]
+    assert d["PADDLE_PSERVERS_IP_PORT_LIST"] == "10.0.0.1:2379,10.0.0.2:2379"
+    assert d["PADDLE_TRAINER_ENDPOINTS"] == "10.0.0.3:2379,10.0.0.4:2379"
+    assert d["PADDLE_TRAINERS"] == "10.0.0.3,10.0.0.4"
+    assert d["PADDLE_TRAINERS_NUM"] == "2"
+    assert d["PADDLE_PORT"] == "2379"
+    assert d["TRAINER_PORTS_NUM"] == "20"
+    assert d["PADDLE_WITH_GLOO"] == "1"
+    assert d["PADDLE_GLOO_RENDEZVOUS"] == "3"
+    # gloo endpoint = PS-0 at port 2379+20-2
+    assert d["PADDLE_GLOO_HTTP_ENDPOINT"] == "10.0.0.1:2397"
+
+
+def test_configmap_service_intranet_uses_names():
+    job = make_job({"worker": role_spec(2), "intranet": "Service"})
+    pods = [
+        running_pod("wide-and-deep-worker-0", "10.0.0.3"),
+        running_pod("wide-and-deep-worker-1", "10.0.0.4"),
+    ]
+    cm = helper.construct_configmap(job, pods)
+    assert cm["data"]["PADDLE_TRAINER_ENDPOINTS"] == (
+        "wide-and-deep-worker-0:2379,wide-and-deep-worker-1:2379"
+    )
+
+
+def test_configmap_nil_on_missing_ip():
+    job = make_job({"worker": role_spec(2)})
+    pods = [
+        running_pod("wide-and-deep-worker-0", "10.0.0.3"),
+        running_pod("wide-and-deep-worker-1", ""),
+    ]
+    assert helper.construct_configmap(job, pods) is None
+
+
+def test_configmap_tpu_collective():
+    job = make_job({
+        "device": "tpu", "tpu": {"accelerator": "v5e", "topology": "4x8"},
+        "worker": role_spec(4),
+    }, name="bert")
+    pods = [running_pod("bert-worker-%d" % i, "10.0.0.%d" % (i + 1)) for i in range(4)]
+    cm = helper.construct_configmap(job, pods)
+    d = cm["data"]
+    assert d["TPU_WORKER_HOSTNAMES"] == "10.0.0.1,10.0.0.2,10.0.0.3,10.0.0.4"
+    assert d["TPUJOB_NUM_WORKERS"] == "4"
+    assert d["TPUJOB_COORDINATOR"] == "10.0.0.1:2379"
+
+
+def test_configmap_heter_endpoints():
+    job = make_job({"worker": role_spec(1), "heter": role_spec(1)})
+    pods = [
+        running_pod("wide-and-deep-worker-0", "10.0.0.1"),
+        running_pod("wide-and-deep-heter-0", "10.0.0.2"),
+    ]
+    cm = helper.construct_configmap(job, pods)
+    assert cm["data"]["PADDLE_HETER_ENDPOINTS"] == "10.0.0.2:2379"
+
+
+# ---------------------------------------------------------------------------
+# services (reference: paddlejob_helper.go:432-455)
+# ---------------------------------------------------------------------------
+
+def test_service_for_pod_cpu_has_port_block():
+    pod = running_pod("j-worker-0", "10.0.0.1")
+    svc = helper.construct_service_for_pod(pod, api.Device.CPU)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert len(svc["spec"]["ports"]) == helper.PORTS_PER_POD
+    assert svc["spec"]["selector"] == {api.LABEL_RES_NAME: "j-worker-0"}
+
+
+def test_service_for_pod_tpu_single_port():
+    pod = running_pod("j-worker-0", "10.0.0.1")
+    svc = helper.construct_service_for_pod(pod, api.Device.TPU)
+    assert len(svc["spec"]["ports"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Volcano PodGroup (reference: paddlejob_helper.go:457-549)
+# ---------------------------------------------------------------------------
+
+def test_podgroup_min_member_sums_roles():
+    job = make_job({"ps": role_spec(2), "worker": role_spec(3)})
+    pg = helper.construct_podgroup(job)
+    assert pg["spec"]["minMember"] == 5
+
+
+def test_podgroup_min_resources_sums_requests():
+    job = make_job({
+        "worker": role_spec(2, resources={"requests": {"cpu": "500m", "memory": "1Gi"}}),
+    })
+    pg = helper.construct_podgroup(job)
+    assert pg["spec"]["minResources"]["cpu"] == "1"
+    assert pg["spec"]["minResources"]["memory"] == str(2 * 2**30)
+
+
+def test_podgroup_tpu_covers_full_slice():
+    job = make_job({
+        "device": "tpu", "tpu": {"accelerator": "v5e", "topology": "4x8"},
+        "worker": role_spec(4),
+    })
+    pg = helper.construct_podgroup(job)
+    assert pg["spec"]["minMember"] == 4
+    assert pg["spec"]["minResources"]["google.com/tpu"] == "32"
+
+
+def test_podgroup_scheduling_policy_overrides():
+    job = make_job({
+        "worker": role_spec(3),
+        "schedulingPolicy": {
+            "minAvailable": 2, "queue": "q1", "priorityClass": "high",
+            "minResources": {"cpu": "10"},
+        },
+    })
+    pg = helper.construct_podgroup(job)
+    assert pg["spec"]["minMember"] == 2
+    assert pg["spec"]["queue"] == "q1"
+    assert pg["spec"]["priorityClassName"] == "high"
+    assert pg["spec"]["minResources"] == {"cpu": "10"}
+
+
+def test_without_volcano_when_other_scheduler_pinned():
+    spec = role_spec(2)
+    spec["template"]["spec"]["schedulerName"] = "default-scheduler"
+    job = make_job({"worker": spec})
+    assert helper.without_volcano(job) is True
+    job2 = make_job({"worker": role_spec(2)})
+    assert helper.without_volcano(job2) is False
+
+
+# ---------------------------------------------------------------------------
+# validation & TPU topology
+# ---------------------------------------------------------------------------
+
+def test_validate_topology_host_mismatch():
+    job = make_job({
+        "device": "tpu", "tpu": {"accelerator": "v5e", "topology": "4x8"},
+        "worker": role_spec(3),  # should be 4 hosts
+    })
+    errs = job.validate()
+    assert any("must equal hosts" in e for e in errs)
+
+
+def test_validate_tpu_rejects_host_network():
+    job = make_job({
+        "device": "tpu", "intranet": "Host", "worker": role_spec(2),
+    })
+    assert any("intranet=Host" in e for e in job.validate())
+
+
+def test_validate_ok():
+    job = make_job({
+        "device": "tpu", "tpu": {"accelerator": "v5e", "topology": "2x4"},
+        "worker": role_spec(1),
+    })
+    assert job.validate() == []
+
+
+def test_topology_chips():
+    assert api.topology_chips("4x8") == 32
+    assert api.topology_chips("2x2x2") == 8

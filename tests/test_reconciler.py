@@ -1,0 +1,283 @@
+"""End-to-end reconcile tests against the hermetic harness.
+
+Covers the reference suite's single scenario (PS job pod-ref convergence +
+rescale, paddlejob_controller_test.go:78-112) and everything it could not
+reach: the ConfigMap barrier, TPU collective jobs, Volcano gating, cleanup
+policies, elastic np sync, host-port allocation, finalization.
+"""
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import helper
+from paddle_operator_tpu.elastic.sync import epoch_key, np_key
+from paddle_operator_tpu.testing import OperatorHarness
+
+
+def role_spec(replicas, resources=None):
+    c = {"name": "main", "image": "img"}
+    if resources:
+        c["resources"] = resources
+    return {"replicas": replicas, "template": {"spec": {"containers": [c]}}}
+
+
+def ps_job(name="wide-and-deep", ps=3, workers=2, intranet="Service"):
+    return api.new_tpujob(name, spec={
+        "ps": role_spec(ps), "worker": role_spec(workers), "intranet": intranet,
+    })
+
+
+def tpu_job(name="bert", workers=4, topology="4x8", elastic=None):
+    spec = {
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": topology},
+        "worker": role_spec(workers),
+    }
+    if elastic is not None:
+        spec["elastic"] = elastic
+    return api.new_tpujob(name, spec=spec)
+
+
+# ---------------------------------------------------------------------------
+# the reference's envtest scenario, reproduced
+# ---------------------------------------------------------------------------
+
+def test_ps_job_converges_and_rescales():
+    h = OperatorHarness()
+    h.create_job(ps_job())
+    h.converge()
+
+    job = h.get_job("wide-and-deep")
+    assert job.mode == api.Mode.PS
+    assert len(job.status["ps"]["refs"]) == 3
+    assert len(job.status["worker"]["refs"]) == 2
+    assert len(h.pods()) == 5
+    # per-pod headless services for Service intranet
+    assert len(h.services()) == 5
+
+    # rescale (3,2) -> (1,4) and reconverge
+    def mutate(obj):
+        obj["spec"]["ps"]["replicas"] = 1
+        obj["spec"]["worker"]["replicas"] = 4
+    h.update_job_spec("wide-and-deep", mutate)
+    h.converge()
+
+    job = h.get_job("wide-and-deep")
+    assert len(job.status["ps"]["refs"]) == 1
+    assert len(job.status["worker"]["refs"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# beyond envtest: full lifecycle with kubelet simulation
+# ---------------------------------------------------------------------------
+
+def test_ps_job_reaches_running_through_barrier():
+    h = OperatorHarness()
+    h.create_job(ps_job())
+    h.converge()
+
+    job = h.get_job("wide-and-deep")
+    assert job.phase == api.Phase.RUNNING
+    # the barrier ConfigMap exists and carries endpoints
+    cms = h.configmaps()
+    assert len(cms) == 1
+    data = cms[0]["data"]
+    assert data["PADDLE_TRAINERS_NUM"] == "2"
+    assert len(data["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")) == 3
+    # startup ordering released ps before worker (exec calls recorded)
+    released = [c[1] for c in h.client.exec_calls]
+    ps_release = [i for i, n in enumerate(released) if "-ps-" in n]
+    worker_release = [i for i, n in enumerate(released) if "-worker-" in n]
+    assert ps_release and worker_release
+    assert max(ps_release) < min(worker_release)
+
+
+def test_job_completes_and_cleans_pods():
+    h = OperatorHarness()
+    h.create_job(ps_job(name="done", ps=1, workers=1))
+    h.converge()
+    h.sim.finish_all(succeeded=True)
+    h.converge()
+    job = h.get_job("done")
+    assert job.phase == api.Phase.COMPLETED
+    assert job.status.get("completionTime")
+    # default cleanPodPolicy cleans pods on completion
+    assert h.pods() == []
+
+
+def test_failed_pod_fails_job_and_policy_keeps_pods():
+    h = OperatorHarness()
+    job = ps_job(name="failing", ps=1, workers=1)
+    job["spec"]["cleanPodPolicy"] = "Never"
+    h.create_job(job)
+    h.converge()
+    h.sim.finish("failing-worker-0", succeeded=False)
+    h.converge()
+    got = h.get_job("failing")
+    assert got.phase == api.Phase.FAILED
+    assert len(h.pods()) == 2  # Never policy: nothing deleted
+
+
+def test_clean_on_failure_policy():
+    h = OperatorHarness()
+    job = ps_job(name="cof", ps=1, workers=1)
+    job["spec"]["cleanPodPolicy"] = "OnFailure"
+    h.create_job(job)
+    h.converge()
+    h.sim.finish("cof-worker-0", succeeded=False)
+    h.converge()
+    assert h.get_job("cof").phase == api.Phase.FAILED
+    assert h.pods() == []
+
+
+# ---------------------------------------------------------------------------
+# TPU collective mode
+# ---------------------------------------------------------------------------
+
+def test_tpu_collective_job_full_bringup():
+    h = OperatorHarness()
+    h.create_job(tpu_job())
+    h.converge()
+
+    job = h.get_job("bert")
+    assert job.mode == api.Mode.COLLECTIVE
+    assert job.phase == api.Phase.RUNNING
+
+    pods = h.pods()
+    assert len(pods) == 4
+    for pod in pods:
+        c0 = pod["spec"]["containers"][0]
+        assert c0["resources"]["requests"]["google.com/tpu"] == "8"
+        assert pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x8"
+
+    cm = h.configmaps()[0]
+    hostnames = cm["data"]["TPU_WORKER_HOSTNAMES"].split(",")
+    assert len(hostnames) == 4
+    assert cm["data"]["TPUJOB_NUM_WORKERS"] == "4"
+    assert cm["data"]["TPUJOB_COORDINATOR"].endswith(":%d" % helper.TRAIN_PORT)
+
+
+def test_tpu_invalid_topology_rejected():
+    h = OperatorHarness()
+    h.create_job(tpu_job(workers=3))  # 4x8 slice needs 4 hosts
+    h.converge()
+    assert h.pods() == []
+    events = h.client.events_for("bert")
+    assert any(e["reason"] == "InvalidSpec" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# Volcano gang scheduling
+# ---------------------------------------------------------------------------
+
+def test_volcano_gates_pod_creation():
+    h = OperatorHarness(scheduling="volcano", auto_admit_podgroups=False)
+    h.create_job(tpu_job(name="gang"))
+    h.converge(max_ticks=6)
+    # PodGroup created, but pods held until it is admitted
+    pgs = h.podgroups()
+    assert len(pgs) == 1
+    assert pgs[0]["spec"]["minMember"] == 4
+    assert pgs[0]["spec"]["minResources"]["google.com/tpu"] == "32"
+    assert h.pods() == []
+
+    h.client.patch_status("PodGroup", "default", "gang", {"phase": "Running"})
+    h.converge()
+    assert len(h.pods()) == 4
+    # pods carry volcano wiring
+    annots = h.pods()[0]["metadata"]["annotations"]
+    assert annots[helper.PODGROUP_ANNOTATION] == "gang"
+    assert h.pods()[0]["spec"]["schedulerName"] == "volcano"
+
+
+def test_volcano_podgroup_deleted_on_completion():
+    h = OperatorHarness(scheduling="volcano")
+    h.create_job(ps_job(name="vdone", ps=1, workers=1))
+    h.converge()
+    assert len(h.podgroups()) == 1
+    h.sim.finish_all(succeeded=True)
+    h.converge()
+    assert h.podgroups() == []
+
+
+# ---------------------------------------------------------------------------
+# elastic
+# ---------------------------------------------------------------------------
+
+def test_elastic_np_published_and_scaled():
+    h = OperatorHarness()
+    h.create_job(tpu_job(name="ers", elastic=1))
+    h.converge()
+
+    assert h.kv.get(np_key("default", "ers")) == "4"
+    assert h.kv.get(epoch_key("default", "ers")) == "1"
+
+    pods = h.pods()
+    assert len(pods) == 4
+    env = {e["name"]: e.get("value") for e in pods[0]["spec"]["containers"][0]["env"]}
+    assert env["PADDLE_ELASTIC_JOB_ID"] == "default-ers"
+    # no ConfigMap barrier for elastic jobs
+    assert h.configmaps() == []
+
+    # scale up: np + epoch advance, extra pod created
+    def mutate(obj):
+        obj["spec"]["worker"]["replicas"] = 8
+        obj["spec"]["tpu"]["topology"] = "8x8"
+    h.update_job_spec("ers", mutate)
+    h.converge()
+    assert h.kv.get(np_key("default", "ers")) == "8"
+    assert h.kv.get(epoch_key("default", "ers")) == "2"
+    assert len(h.pods()) == 8
+    events = h.client.events_for("ers")
+    assert any(e["reason"] == "Scaled" for e in events)
+
+
+def test_elastic_scale_down_deletes_excess():
+    h = OperatorHarness()
+    h.create_job(tpu_job(name="ers2", workers=8, topology="8x8", elastic=1))
+    h.converge()
+    assert len(h.pods()) == 8
+
+    def mutate(obj):
+        obj["spec"]["worker"]["replicas"] = 4
+        obj["spec"]["tpu"]["topology"] = "4x8"
+    h.update_job_spec("ers2", mutate)
+    h.converge()
+    assert len(h.pods()) == 4
+    assert h.kv.get(np_key("default", "ers2")) == "4"
+
+
+# ---------------------------------------------------------------------------
+# host-port allocation
+# ---------------------------------------------------------------------------
+
+def test_host_intranet_allocates_port_block():
+    h = OperatorHarness()
+    h.create_job(ps_job(name="hosty", ps=1, workers=2, intranet="Host"))
+    h.converge()
+    job = h.get_job("hosty")
+    port = int(job.metadata["annotations"][helper.HOST_PORT_ANNOTATION])
+    assert 35000 <= port < 65000
+    assert h.reconciler.ports.is_used(port)
+    # pods run host network; ConfigMap advertises the allocated port
+    assert all(p["spec"].get("hostNetwork") for p in h.pods())
+    cm = h.configmaps()[0]
+    assert cm["data"]["PADDLE_PORT"] == str(port)
+
+
+def test_finalize_releases_port_and_finalizer():
+    h = OperatorHarness()
+    h.create_job(ps_job(name="gone", ps=1, workers=1, intranet="Host"))
+    h.converge()
+    job = h.get_job("gone")
+    port = int(job.metadata["annotations"][helper.HOST_PORT_ANNOTATION])
+    assert helper.FINALIZER in job.metadata["finalizers"]
+
+    h.client.delete(api.KIND, "default", "gone")
+    h.converge()
+    assert not h.reconciler.ports.is_used(port)
+    # job fully removed once the finalizer cleared; children GC'd
+    from paddle_operator_tpu.k8s.errors import NotFoundError
+    with pytest.raises(NotFoundError):
+        h.client.get(api.KIND, "default", "gone")
+    assert h.pods() == []
