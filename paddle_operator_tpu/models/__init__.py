@@ -1,0 +1,11 @@
+"""Model zoo covering the reference's acceptance workloads (BASELINE.json):
+
+* ResNet-50 — collective-mode image classification (deploy/examples/resnet.yaml)
+* BERT — multi-host collective transformer (v5e-32 config)
+* wide_and_deep / deepfm — PS-mode CTR models (deploy/examples/*.yaml)
+
+All models are (init, apply) pure functions over dict pytrees, bf16 compute,
+built from `paddle_operator_tpu.ops.nn`.
+"""
+
+from . import resnet, bert, wide_deep, deepfm  # noqa: F401

@@ -1,0 +1,136 @@
+"""BERT encoder for TPU: bf16 MXU compute, GSPMD-shardable param layout.
+
+The multi-host collective flagship (BASELINE.json config #5: BERT-base on
+v5e-32). Parameter axes are laid out so `parallel.sharding` can map:
+attention/MLP hidden dims onto the `tp` mesh axis, batch onto `dp`, and
+sequence onto `sp` activation constraints, with per-layer `jax.checkpoint`
+(remat) trading FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn
+
+BASE_CONFIG = dict(
+    vocab_size=30522, hidden=768, layers=12, heads=12, mlp_dim=3072,
+    max_seq=512, type_vocab=2,
+)
+
+TINY_CONFIG = dict(
+    vocab_size=1024, hidden=128, layers=2, heads=4, mlp_dim=256,
+    max_seq=128, type_vocab=2,
+)
+
+
+def init(key, config: Optional[dict] = None) -> Dict:
+    cfg = dict(BASE_CONFIG, **(config or {}))
+    h, mlp = cfg["hidden"], cfg["mlp_dim"]
+    keys = iter(jax.random.split(key, 16 + 8 * cfg["layers"]))
+
+    params: Dict = {
+        "embed": {
+            "tok": nn.embedding_init(next(keys), cfg["vocab_size"], h),
+            "pos": nn.embedding_init(next(keys), cfg["max_seq"], h),
+            "type": nn.embedding_init(next(keys), cfg["type_vocab"], h),
+            "ln": nn.layernorm_init(h),
+        },
+        "layers": [],
+        "pooler": nn.dense_init(next(keys), h, h),
+        "mlm": {
+            "transform": nn.dense_init(next(keys), h, h),
+            "ln": nn.layernorm_init(h),
+            "decoder": nn.dense_init(next(keys), h, cfg["vocab_size"]),
+        },
+    }
+    for _ in range(cfg["layers"]):
+        params["layers"].append({
+            "attn": nn.mha_init(next(keys), h, cfg["heads"]),
+            "ln1": nn.layernorm_init(h),
+            "mlp": {
+                "fc1": nn.dense_init(next(keys), h, mlp),
+                "fc2": nn.dense_init(next(keys), mlp, h),
+            },
+            "ln2": nn.layernorm_init(h),
+        })
+    return params
+
+
+def _encoder_layer(layer, x, mask, dtype):
+    y = nn.mha(layer["attn"], x, mask, dtype=dtype)
+    x = nn.layernorm(layer["ln1"], x + y, dtype=dtype)
+    y = nn.dense(layer["mlp"]["fc1"], x, dtype=dtype)
+    y = nn.gelu(y)
+    y = nn.dense(layer["mlp"]["fc2"], y, dtype=dtype)
+    return nn.layernorm(layer["ln2"], x + y, dtype=dtype)
+
+
+def encode(params, input_ids, type_ids=None, attention_mask=None,
+           dtype=jnp.bfloat16, remat: bool = False):
+    """input_ids: [B, S] -> hidden states [B, S, H]."""
+    b, s = input_ids.shape
+    x = nn.embedding(params["embed"]["tok"], input_ids, dtype)
+    pos = jnp.arange(s)[None, :]
+    x = x + nn.embedding(params["embed"]["pos"], pos, dtype)
+    if type_ids is None:
+        type_ids = jnp.zeros_like(input_ids)
+    x = x + nn.embedding(params["embed"]["type"], type_ids, dtype)
+    x = nn.layernorm(params["embed"]["ln"], x, dtype=dtype)
+
+    mask = None
+    if attention_mask is not None:
+        mask = attention_mask[:, None, None, :].astype(bool)
+
+    layer_fn = _encoder_layer
+    if remat:
+        layer_fn = jax.checkpoint(_encoder_layer, static_argnums=(3,))
+    for layer in params["layers"]:
+        x = layer_fn(layer, x, mask, dtype)
+    return x
+
+
+def mlm_logits(params, hidden, dtype=jnp.bfloat16):
+    y = nn.dense(params["mlm"]["transform"], hidden, dtype)
+    y = nn.gelu(y)
+    y = nn.layernorm(params["mlm"]["ln"], y, dtype=dtype)
+    return nn.dense(params["mlm"]["decoder"], y, dtype=jnp.float32)
+
+
+def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False):
+    """Masked-LM loss. batch = {input_ids, labels, [type_ids, attention_mask,
+    loss_mask]}; labels [B,S] with ignored positions marked by loss_mask=0."""
+    hidden = encode(
+        params, batch["input_ids"], batch.get("type_ids"),
+        batch.get("attention_mask"), dtype=dtype, remat=remat,
+    )
+    logits = mlm_logits(params, hidden, dtype)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    labels = batch["labels"]
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        mask = jnp.ones_like(labels, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    loss = -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    acc = jnp.sum(
+        (jnp.argmax(logits, -1) == labels).astype(jnp.float32) * mask
+    ) / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss, {"accuracy": acc}
+
+
+def synthetic_batch(key, batch_size: int, seq_len: int = 128,
+                    vocab_size: int = 30522, mask_rate: float = 0.15):
+    k1, k2, k3 = jax.random.split(key, 3)
+    ids = jax.random.randint(k1, (batch_size, seq_len), 0, vocab_size)
+    labels = jax.random.randint(k2, (batch_size, seq_len), 0, vocab_size)
+    loss_mask = (jax.random.uniform(k3, (batch_size, seq_len)) < mask_rate)
+    return {
+        "input_ids": ids,
+        "labels": labels,
+        "loss_mask": loss_mask.astype(jnp.float32),
+        "attention_mask": jnp.ones((batch_size, seq_len), jnp.int32),
+    }

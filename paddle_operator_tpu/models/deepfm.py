@@ -1,0 +1,66 @@
+"""DeepFM CTR model — the reference's second PS-mode workload
+(deploy/examples/deepfm.yaml): FM first+second order terms + deep MLP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn
+from .wide_deep import DEFAULT_CONFIG, _fold_slots
+
+
+def init(key, config: Optional[dict] = None) -> Dict:
+    cfg = dict(DEFAULT_CONFIG, **(config or {}))
+    keys = iter(jax.random.split(key, 8 + len(cfg["hidden"])))
+    vocab = cfg["num_slots"] * cfg["vocab_per_slot"]
+    params: Dict = {
+        "fm_first": nn.embedding_init(next(keys), vocab, 1),
+        "fm_embed": nn.embedding_init(next(keys), vocab, cfg["embed_dim"]),
+        "dense_w": nn.dense_init(next(keys), cfg["dense_dim"], 1),
+        "mlp": [],
+    }
+    in_dim = cfg["embed_dim"] * cfg["num_slots"] + cfg["dense_dim"]
+    for h in cfg["hidden"]:
+        params["mlp"].append(nn.dense_init(next(keys), in_dim, h))
+        in_dim = h
+    params["out"] = nn.dense_init(next(keys), in_dim, 1)
+    return params
+
+
+def apply(params, batch, dtype=jnp.bfloat16):
+    vocab_per_slot = params["fm_embed"]["table"].shape[0] // batch["sparse"].shape[-1]
+    ids = _fold_slots(batch["sparse"], vocab_per_slot)
+    emb = nn.embedding(params["fm_embed"], ids, dtype)     # [B, S, E]
+
+    # FM first order
+    first = jnp.sum(nn.embedding(params["fm_first"], ids, jnp.float32)[..., 0], -1)
+    first = first + nn.dense(params["dense_w"], batch["dense"], jnp.float32)[:, 0]
+
+    # FM second order: 0.5 * ((Σv)² - Σv²)
+    sum_sq = jnp.square(jnp.sum(emb, axis=1))
+    sq_sum = jnp.sum(jnp.square(emb), axis=1)
+    second = 0.5 * jnp.sum(sum_sq - sq_sum, axis=-1).astype(jnp.float32)
+
+    b = emb.shape[0]
+    deep = jnp.concatenate(
+        [emb.reshape(b, -1), batch["dense"].astype(dtype)], axis=-1
+    )
+    for layer in params["mlp"]:
+        deep = jax.nn.relu(nn.dense(layer, deep, dtype))
+    deep_logit = nn.dense(params["out"], deep, jnp.float32)[:, 0]
+    return first + second + deep_logit
+
+
+def loss_fn(params, batch, train=True, dtype=jnp.bfloat16):
+    logits = apply(params, batch, dtype)
+    loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])
+    pred = (logits > 0).astype(jnp.float32)
+    acc = jnp.mean((pred == batch["label"].astype(jnp.float32)).astype(jnp.float32))
+    return loss, {"accuracy": acc}
+
+
+from .wide_deep import synthetic_batch  # noqa: E402,F401  (same input schema)

@@ -1,0 +1,158 @@
+"""ResNet v1.5 for TPU: NHWC, bf16 compute, synced BatchNorm under GSPMD.
+
+The collective-mode flagship (reference workload:
+``deploy/examples/resnet.yaml`` trains ResNet-50 with paddle.distributed;
+here the model itself is part of the framework).
+
+BatchNorm running stats are carried inside the param tree; ``apply`` returns
+``(logits, stats_updates)`` where ``stats_updates`` maps flat paths to new
+{mean, var} — merge with :func:`merge_stats` after the optimizer step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn
+
+# depth -> (block counts, bottleneck?)
+CONFIGS = {
+    18: ([2, 2, 2, 2], False),
+    34: ([3, 4, 6, 3], False),
+    50: ([3, 4, 6, 3], True),
+    101: ([3, 4, 23, 3], True),
+    152: ([3, 8, 36, 3], True),
+}
+
+STAGE_CH = [64, 128, 256, 512]
+
+
+def init(key, depth: int = 50, num_classes: int = 1000) -> Dict:
+    blocks, bottleneck = CONFIGS[depth]
+    expansion = 4 if bottleneck else 1
+    keys = iter(jax.random.split(key, 1024))
+
+    params: Dict = {
+        "stem": {
+            "conv": nn.conv_init(next(keys), 7, 7, 3, 64),
+            "bn": nn.batchnorm_init(64),
+        },
+        "stages": [],
+    }
+    in_ch = 64
+    for si, n_blocks in enumerate(blocks):
+        stage: List[Dict] = []
+        out_ch = STAGE_CH[si] * expansion
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            block: Dict = {}
+            mid = STAGE_CH[si]
+            if bottleneck:
+                block["conv1"] = nn.conv_init(next(keys), 1, 1, in_ch, mid)
+                block["bn1"] = nn.batchnorm_init(mid)
+                block["conv2"] = nn.conv_init(next(keys), 3, 3, mid, mid)
+                block["bn2"] = nn.batchnorm_init(mid)
+                block["conv3"] = nn.conv_init(next(keys), 1, 1, mid, out_ch)
+                block["bn3"] = nn.batchnorm_init(out_ch)
+            else:
+                block["conv1"] = nn.conv_init(next(keys), 3, 3, in_ch, mid)
+                block["bn1"] = nn.batchnorm_init(mid)
+                block["conv2"] = nn.conv_init(next(keys), 3, 3, mid, out_ch)
+                block["bn2"] = nn.batchnorm_init(out_ch)
+            if in_ch != out_ch or stride != 1:
+                block["proj_conv"] = nn.conv_init(next(keys), 1, 1, in_ch, out_ch)
+                block["proj_bn"] = nn.batchnorm_init(out_ch)
+            stage.append(block)
+            in_ch = out_ch
+        params["stages"].append(stage)
+
+    params["head"] = {"fc": nn.dense_init(next(keys), in_ch, num_classes)}
+    return params
+
+
+def _bn(params, x, train, stats, path, dtype):
+    y, new = nn.batchnorm(params, x, train, dtype=dtype)
+    if new is not None:
+        stats[path] = new
+    return y
+
+
+def apply(params: Dict, x: jnp.ndarray, train: bool = True,
+          dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, Dict]:
+    """x: [B, H, W, 3] NHWC. Returns (logits [B, classes], bn stats updates)."""
+    bottleneck = "conv3" in params["stages"][0][0]
+    stats: Dict = {}
+
+    y = nn.conv2d(params["stem"]["conv"], x, stride=2, dtype=dtype)
+    y = _bn(params["stem"]["bn"], y, train, stats, "stem/bn", dtype)
+    y = jax.nn.relu(y)
+    y = nn.max_pool(y, 3, 2)
+
+    for si, stage in enumerate(params["stages"]):
+        for bi, block in enumerate(stage):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            shortcut = y
+            p = "stages/%d/%d" % (si, bi)
+            if bottleneck:
+                z = nn.conv2d(block["conv1"], y, dtype=dtype)
+                z = jax.nn.relu(_bn(block["bn1"], z, train, stats, p + "/bn1", dtype))
+                z = nn.conv2d(block["conv2"], z, stride=stride, dtype=dtype)
+                z = jax.nn.relu(_bn(block["bn2"], z, train, stats, p + "/bn2", dtype))
+                z = nn.conv2d(block["conv3"], z, dtype=dtype)
+                z = _bn(block["bn3"], z, train, stats, p + "/bn3", dtype)
+            else:
+                z = nn.conv2d(block["conv1"], y, stride=stride, dtype=dtype)
+                z = jax.nn.relu(_bn(block["bn1"], z, train, stats, p + "/bn1", dtype))
+                z = nn.conv2d(block["conv2"], z, dtype=dtype)
+                z = _bn(block["bn2"], z, train, stats, p + "/bn2", dtype)
+            if "proj_conv" in block:
+                shortcut = nn.conv2d(block["proj_conv"], y, stride=stride, dtype=dtype)
+                shortcut = _bn(block["proj_bn"], shortcut, train, stats, p + "/proj_bn", dtype)
+            y = jax.nn.relu(z + shortcut)
+
+    pooled = nn.global_avg_pool(y)
+    logits = nn.dense(params["head"]["fc"], pooled, dtype=jnp.float32)
+    return logits, stats
+
+
+def merge_stats(params: Dict, stats: Dict) -> Dict:
+    """Fold apply()'s BN stats updates back into the param tree."""
+    if not stats:
+        return params
+    params = dict(params)
+    for path, new in stats.items():
+        parts = path.split("/")
+        node = params
+        trail = []
+        for part in parts[:-1]:
+            key = int(part) if part.isdigit() else part
+            child = node[key]
+            child = list(child) if isinstance(child, list) else dict(child)
+            trail.append((node, key))
+            node[key] = child
+            node = child
+        leaf = dict(node[parts[-1]])
+        leaf.update(new)
+        node[parts[-1]] = leaf
+    return params
+
+
+def loss_fn(params, batch, train=True, dtype=jnp.bfloat16):
+    """batch = {"image": [B,H,W,3], "label": [B]}."""
+    logits, stats = apply(params, batch["image"], train=train, dtype=dtype)
+    loss = nn.softmax_cross_entropy(logits, batch["label"])
+    return loss, {"stats": stats, "accuracy": nn.accuracy(logits, batch["label"])}
+
+
+def synthetic_batch(key, batch_size: int, image_size: int = 224,
+                    num_classes: int = 1000):
+    k1, k2 = jax.random.split(key)
+    return {
+        "image": jax.random.normal(
+            k1, (batch_size, image_size, image_size, 3), jnp.bfloat16
+        ),
+        "label": jax.random.randint(k2, (batch_size,), 0, num_classes),
+    }

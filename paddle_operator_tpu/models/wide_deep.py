@@ -1,0 +1,92 @@
+"""wide_and_deep CTR model — the reference's PS-mode acceptance workload
+(deploy/examples/wide_and_deep.yaml). Sparse slot embeddings + wide linear
+part + deep MLP; binary cross-entropy on click labels.
+
+In PS mode the embedding tables are the "parameters on servers"; in the TPU
+rebuild they are just large pytree leaves shardable over the mesh
+(`parallel.sharding` maps table rows onto the dp axis).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn
+
+DEFAULT_CONFIG = dict(
+    num_slots=26,          # criteo-style categorical slots
+    vocab_per_slot=10000,
+    embed_dim=16,
+    dense_dim=13,          # continuous features
+    hidden=[400, 400, 400],
+)
+
+
+def init(key, config: Optional[dict] = None) -> Dict:
+    cfg = dict(DEFAULT_CONFIG, **(config or {}))
+    keys = iter(jax.random.split(key, 8 + len(cfg["hidden"])))
+    params: Dict = {
+        # one shared table across slots keeps the pytree compact; slot id is
+        # folded into the row index by apply()
+        "embed": nn.embedding_init(
+            next(keys), cfg["num_slots"] * cfg["vocab_per_slot"], cfg["embed_dim"]
+        ),
+        "wide": nn.embedding_init(
+            next(keys), cfg["num_slots"] * cfg["vocab_per_slot"], 1
+        ),
+        "dense_proj": nn.dense_init(next(keys), cfg["dense_dim"], cfg["embed_dim"]),
+        "mlp": [],
+    }
+    in_dim = cfg["embed_dim"] * (cfg["num_slots"] + 1)
+    for h in cfg["hidden"]:
+        params["mlp"].append(nn.dense_init(next(keys), in_dim, h))
+        in_dim = h
+    params["out"] = nn.dense_init(next(keys), in_dim, 1)
+    return params
+
+
+def _fold_slots(sparse_ids, vocab_per_slot):
+    num_slots = sparse_ids.shape[-1]
+    offsets = jnp.arange(num_slots) * vocab_per_slot
+    return sparse_ids + offsets[None, :]
+
+
+def apply(params, batch, dtype=jnp.bfloat16):
+    """batch = {"sparse": int [B, num_slots], "dense": float [B, dense_dim]}."""
+    # rows are laid out slot-major, so rows-per-slot falls out of the shape
+    vocab_per_slot = params["embed"]["table"].shape[0] // batch["sparse"].shape[-1]
+    ids = _fold_slots(batch["sparse"], vocab_per_slot)
+    emb = nn.embedding(params["embed"], ids, dtype)            # [B, S, E]
+    wide = nn.embedding(params["wide"], ids, jnp.float32)      # [B, S, 1]
+    dense_feat = nn.dense(params["dense_proj"], batch["dense"], dtype)  # [B, E]
+
+    b = emb.shape[0]
+    deep = jnp.concatenate([emb.reshape(b, -1), dense_feat], axis=-1)
+    for layer in params["mlp"]:
+        deep = jax.nn.relu(nn.dense(layer, deep, dtype))
+    deep_logit = nn.dense(params["out"], deep, jnp.float32)[:, 0]
+    wide_logit = jnp.sum(wide[..., 0], axis=-1)
+    return deep_logit + wide_logit
+
+
+def loss_fn(params, batch, train=True, dtype=jnp.bfloat16):
+    logits = apply(params, batch, dtype)
+    loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])
+    pred = (logits > 0).astype(jnp.float32)
+    acc = jnp.mean((pred == batch["label"].astype(jnp.float32)).astype(jnp.float32))
+    return loss, {"accuracy": acc}
+
+
+def synthetic_batch(key, batch_size: int, config: Optional[dict] = None):
+    cfg = dict(DEFAULT_CONFIG, **(config or {}))
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "sparse": jax.random.randint(
+            k1, (batch_size, cfg["num_slots"]), 0, cfg["vocab_per_slot"]
+        ),
+        "dense": jax.random.normal(k2, (batch_size, cfg["dense_dim"])),
+        "label": jax.random.bernoulli(k3, 0.5, (batch_size,)).astype(jnp.int32),
+    }

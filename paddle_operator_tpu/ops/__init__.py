@@ -1,0 +1,15 @@
+"""Functional NN ops for TPU: pure-JAX layers, losses, optimizers.
+
+Design: parameters are plain pytrees (nested dicts of jnp arrays); every layer
+is an (init, apply) pair of pure functions. No module framework — this keeps
+every model a transparent pytree that `jax.sharding` partition rules can match
+by path, and keeps tracing trivially compatible with `jit`/`scan`/`remat`.
+
+TPU-first conventions:
+* params live in fp32; compute is bf16 (MXU-native) via the `dtype` argument,
+* convolutions are NHWC (XLA-TPU's preferred layout),
+* reductions over the batch axis are written on the logical (global) batch so
+  GSPMD inserts the cross-device collectives (e.g. synced BatchNorm) for free.
+"""
+
+from . import nn, optim  # noqa: F401

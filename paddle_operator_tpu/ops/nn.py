@@ -1,0 +1,230 @@
+"""Core layers as (init, apply) pure-function pairs over dict pytrees."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+def _fan_in_out(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv HWIO
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+def kaiming_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fan_in_out(shape)
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(key, shape, dtype) * std
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fan_in_out(shape)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+def normal_init(key, shape, stddev=0.02, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * stddev
+
+
+# ---------------------------------------------------------------------------
+# dense
+# ---------------------------------------------------------------------------
+
+def dense_init(key, in_dim: int, out_dim: int, use_bias: bool = True,
+               init=xavier_uniform):
+    p = {"kernel": init(key, (in_dim, out_dim))}
+    if use_bias:
+        p["bias"] = jnp.zeros((out_dim,))
+    return p
+
+
+def dense(params, x, dtype=jnp.bfloat16):
+    w = params["kernel"].astype(dtype)
+    y = jnp.matmul(x.astype(dtype), w)
+    if "bias" in params:
+        y = y + params["bias"].astype(dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# conv2d (NHWC / HWIO)
+# ---------------------------------------------------------------------------
+
+def conv_init(key, kh: int, kw: int, in_ch: int, out_ch: int,
+              init=kaiming_normal):
+    return {"kernel": init(key, (kh, kw, in_ch, out_ch))}
+
+
+def conv2d(params, x, stride: int = 1, padding="SAME", dtype=jnp.bfloat16):
+    w = params["kernel"].astype(dtype)
+    return lax.conv_general_dilated(
+        x.astype(dtype), w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def batchnorm_init(ch: int):
+    return {
+        "scale": jnp.ones((ch,)),
+        "bias": jnp.zeros((ch,)),
+        # running stats live beside params but are updated out-of-band
+        "mean": jnp.zeros((ch,)),
+        "var": jnp.ones((ch,)),
+    }
+
+
+def batchnorm(params, x, train: bool, momentum: float = 0.9, eps: float = 1e-5,
+              dtype=jnp.bfloat16):
+    """Sync BatchNorm: reductions span the full logical batch, so under pjit
+    with a dp-sharded batch XLA lowers them to cross-replica collectives.
+
+    Returns (y, new_stats) in train mode; (y, None) in eval.
+    """
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+        var = jnp.var(x.astype(jnp.float32), axis=axes)
+        new_stats = {
+            "mean": momentum * params["mean"] + (1 - momentum) * mean,
+            "var": momentum * params["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = params["mean"], params["var"]
+        new_stats = None
+    inv = lax.rsqrt(var + eps) * params["scale"]
+    y = (x.astype(jnp.float32) - mean) * inv + params["bias"]
+    return y.astype(dtype), new_stats
+
+
+def layernorm_init(dim: int):
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def layernorm(params, x, eps: float = 1e-6, dtype=jnp.bfloat16):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y * params["scale"] + params["bias"]
+    return y.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+def embedding_init(key, vocab: int, dim: int, init=normal_init):
+    return {"table": init(key, (vocab, dim))}
+
+
+def embedding(params, ids, dtype=jnp.bfloat16):
+    return jnp.take(params["table"], ids, axis=0).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+def mha_init(key, dim: int, num_heads: int):
+    """QKV kernels are [dim, heads, head_dim] (O is [heads, head_dim, dim]):
+    the head axis is explicit in the array shape — so head count is derivable
+    without non-array leaves, and the `tp` mesh axis shards heads directly
+    (spec P(None, "tp", None)) with no resharding between projections."""
+    if dim % num_heads:
+        raise ValueError("dim %d not divisible by heads %d" % (dim, num_heads))
+    head_dim = dim // num_heads
+    ks = jax.random.split(key, 4)
+    def proj(k):
+        return {
+            "kernel": xavier_uniform(k, (dim, dim)).reshape(dim, num_heads, head_dim),
+            "bias": jnp.zeros((num_heads, head_dim)),
+        }
+    return {
+        "q": proj(ks[0]),
+        "k": proj(ks[1]),
+        "v": proj(ks[2]),
+        "o": {
+            "kernel": xavier_uniform(ks[3], (dim, dim)).reshape(num_heads, head_dim, dim),
+            "bias": jnp.zeros((dim,)),
+        },
+    }
+
+
+def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16):
+    """Multi-head self-attention, BSHD layout.
+
+    The einsum formulation keeps the contraction dims explicit so GSPMD can
+    shard heads over the `tp` mesh axis without resharding (heads axis is
+    preserved end-to-end until the output projection).
+    """
+    def proj(p, x):
+        return (
+            jnp.einsum("bsd,dhk->bshk", x.astype(dtype), p["kernel"].astype(dtype))
+            + p["bias"].astype(dtype)
+        )
+
+    q, k, v = proj(params["q"], x), proj(params["k"], x), proj(params["v"], x)
+    head_dim = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return (
+        jnp.einsum("bqhd,hdo->bqo", ctx, params["o"]["kernel"].astype(dtype))
+        + params["o"]["bias"].astype(dtype)
+    )
+
+
+# ---------------------------------------------------------------------------
+# activations / pooling / losses
+# ---------------------------------------------------------------------------
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def max_pool(x, window: int, stride: int, padding="SAME"):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        (1, window, window, 1), (1, stride, stride, 1), padding,
+    )
+
+
+def global_avg_pool(x):
+    return jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+
+
+def softmax_cross_entropy(logits, labels, num_classes: Optional[int] = None):
+    """Mean CE over the logical (global) batch; labels are int ids."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def sigmoid_binary_cross_entropy(logits, labels):
+    logits = logits.astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

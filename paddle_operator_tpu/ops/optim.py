@@ -1,0 +1,134 @@
+"""Optimizers as pure (init, update) pairs over param pytrees.
+
+Self-contained (no optax dependency) so the framework's checkpoint format and
+sharding rules own the full optimizer state; optax remains usable by callers
+since params are plain pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer(NamedTuple):
+    init: Callable
+    update: Callable  # (grads, state, params) -> (new_params, new_state)
+
+
+def _tree_map(f, *trees, is_leaf=None):
+    return jax.tree_util.tree_map(f, *trees, is_leaf=is_leaf)
+
+
+def make_wd_mask(params, exclude=("bias", "scale", "mean", "var")):
+    """Weight-decay mask: False for normalization/bias/BN-stat leaves.
+
+    Standard practice (and required for correctness here: BN running stats
+    live in the param tree and must never be decayed).
+    """
+    def leaf_mask(path, _leaf):
+        names = {getattr(p, "key", getattr(p, "name", None)) for p in path}
+        return not (names & set(exclude))
+    return jax.tree_util.tree_map_with_path(leaf_mask, params)
+
+
+def sgd(lr, momentum: float = 0.9, weight_decay: float = 0.0,
+        nesterov: bool = False, wd_mask=None) -> Optimizer:
+    lr_fn = lr if callable(lr) else (lambda step: lr)
+
+    def init(params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "momentum": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = lr_fn(step)
+
+        def upd(g, m, p, wd_on=True):
+            g = g.astype(jnp.float32)
+            if weight_decay and wd_on:
+                g = g + weight_decay * p
+            m_new = momentum * m + g
+            d = g + momentum * m_new if nesterov else m_new
+            return (p - lr_t * d).astype(p.dtype), m_new
+
+        if wd_mask is not None:
+            flat = _tree_map(upd, grads, state["momentum"], params, wd_mask)
+        else:
+            flat = _tree_map(upd, grads, state["momentum"], params)
+        new_params = _tree_map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_m = _tree_map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, {"step": step, "momentum": new_m}
+
+    return Optimizer(init, update)
+
+
+def adamw(lr, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+          weight_decay: float = 0.01, wd_mask=None) -> Optimizer:
+    lr_fn = lr if callable(lr) else (lambda step: lr)
+
+    def init(params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": _tree_map(jnp.zeros_like, params),
+            "nu": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(grads, state, params):
+        step = state["step"] + 1
+        lr_t = lr_fn(step)
+        c1 = 1.0 - b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - b2 ** step.astype(jnp.float32)
+
+        def upd(g, mu, nu, p, wd_on=True):
+            g = g.astype(jnp.float32)
+            mu_new = b1 * mu + (1 - b1) * g
+            nu_new = b2 * nu + (1 - b2) * g * g
+            mu_hat = mu_new / c1
+            nu_hat = nu_new / c2
+            d = mu_hat / (jnp.sqrt(nu_hat) + eps)
+            if weight_decay:
+                d = d + (weight_decay * p if wd_on else 0.0)
+            return (p - lr_t * d).astype(p.dtype), mu_new, nu_new
+
+        if wd_mask is not None:
+            flat = _tree_map(upd, grads, state["mu"], state["nu"], params, wd_mask)
+        else:
+            flat = _tree_map(upd, grads, state["mu"], state["nu"], params)
+        is_t = lambda t: isinstance(t, tuple)
+        return (
+            _tree_map(lambda t: t[0], flat, is_leaf=is_t),
+            {
+                "step": step,
+                "mu": _tree_map(lambda t: t[1], flat, is_leaf=is_t),
+                "nu": _tree_map(lambda t: t[2], flat, is_leaf=is_t),
+            },
+        )
+
+    return Optimizer(init, update)
+
+
+def cosine_schedule(base_lr: float, total_steps: int, warmup_steps: int = 0):
+    def lr(step):
+        step = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+        warm = jnp.minimum(1.0, step / jnp.maximum(1, warmup_steps)) if warmup_steps else 1.0
+        progress = jnp.clip(
+            (step - warmup_steps) / jnp.maximum(1, total_steps - warmup_steps), 0.0, 1.0
+        )
+        return base_lr * warm * 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+    return lr
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves))
+
+
+def clip_by_global_norm(tree, max_norm: float):
+    norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return _tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), tree), norm

@@ -1,0 +1,53 @@
+"""Device mesh construction."""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
+    """Build a Mesh from an ordered {axis: size} dict.
+
+    Sizes of -1 are inferred (at most one). Default: all devices on `dp`.
+    Axis order follows dict order — put the fastest-varying (ICI-nearest)
+    axis last (convention: dp outermost, tp innermost) so tensor-parallel
+    collectives ride the shortest ICI paths.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {"dp": n}
+    axes = dict(axes)
+    known = math.prod(s for s in axes.values() if s != -1)
+    unknown = [a for a, s in axes.items() if s == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if unknown:
+        if n % known:
+            raise ValueError("cannot infer %s: %d %% %d != 0" % (unknown[0], n, known))
+        axes[unknown[0]] = n // known
+    total = math.prod(axes.values())
+    if total != n:
+        raise ValueError(
+            "mesh %s covers %d devices but %d are available" % (axes, total, n)
+        )
+    arr = np.array(devices).reshape(*axes.values())
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def mesh_from_env(devices=None) -> Mesh:
+    """Mesh shape from TPUJOB_MESH env, e.g. 'dp=8,tp=4' (launcher-injected)."""
+    spec = os.environ.get("TPUJOB_MESH", "")
+    if not spec:
+        return make_mesh(devices=devices)
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return make_mesh(axes, devices)

@@ -1,0 +1,117 @@
+"""Path-pattern sharding rules over param pytrees.
+
+A rule set is an ordered list of ``(regex, PartitionSpec)``; the first regex
+that matches a leaf's flat path (e.g. ``layers/3/attn/q/kernel``) wins.
+Leaves with no match (or whose shapes don't divide) fall back to replication
+— GSPMD still produces a correct program, just with less sharding.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = List[Tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _spec_fits(leaf, spec: P, mesh: Mesh) -> bool:
+    """Check the leaf's dims divide by the mesh axes the spec assigns."""
+    shape = getattr(leaf, "shape", ())
+    if len(spec) > len(shape):
+        return False
+    for dim, axis in zip(shape, spec):
+        if axis is None:
+            continue
+        names = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        for nm in names:
+            if nm not in mesh.shape:
+                return False
+            size *= mesh.shape[nm]
+        if dim % size:
+            return False
+    return True
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    # drop axes the mesh doesn't have so one rule set serves many meshes
+    cleaned = []
+    for axis in spec:
+        if axis is None:
+            cleaned.append(None)
+            continue
+        names = axis if isinstance(axis, tuple) else (axis,)
+        kept = tuple(nm for nm in names if nm in mesh.shape)
+        cleaned.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return NamedSharding(mesh, P(*cleaned))
+
+
+def shard_tree(tree, mesh: Mesh, rules: Optional[Rules] = None,
+               default: P = P()):
+    """Map every leaf to a NamedSharding via the rule table."""
+    compiled = [(re.compile(rx), spec) for rx, spec in (rules or [])]
+
+    def pick(path, leaf):
+        path_s = _path_str(path)
+        for rx, spec in compiled:
+            if rx.search(path_s):
+                sh = named(mesh, spec)
+                if _spec_fits(leaf, sh.spec, mesh):
+                    return sh
+                break
+        return named(mesh, default)
+
+    return jax.tree_util.tree_map_with_path(pick, tree)
+
+
+# ---------------------------------------------------------------------------
+# model rule sets (Megatron-style TP layout expressed as GSPMD specs)
+# ---------------------------------------------------------------------------
+
+def bert_rules() -> Rules:
+    """BERT: column-parallel qkv/fc1, row-parallel o/fc2, vocab-sharded
+    embeddings/decoder. Biases of column-parallel layers shard with them."""
+    return [
+        (r"attn/(q|k|v)/kernel", P(None, "tp", None)),
+        (r"attn/(q|k|v)/bias", P("tp", None)),
+        (r"attn/o/kernel", P("tp", None, None)),
+        (r"mlp/fc1/kernel", P(None, "tp")),
+        (r"mlp/fc1/bias", P("tp")),
+        (r"mlp/fc2/kernel", P("tp", None)),
+        (r"embed/tok/table", P("tp", None)),
+        (r"mlm/decoder/kernel", P(None, "tp")),
+        (r"mlm/decoder/bias", P("tp")),
+    ]
+
+
+def resnet_rules() -> Rules:
+    """ResNet: pure data parallel; convs are small enough to replicate.
+    (fsdp axis, if present in the mesh, shards the classifier.)"""
+    return [
+        (r"head/fc/kernel", P(None, "fsdp")),
+    ]
+
+
+def ctr_rules() -> Rules:
+    """CTR models: the big embedding tables shard by row (vocab) over all
+    model axes — the PS-mode "parameters on servers" equivalent."""
+    return [
+        (r"(embed|wide|fm_first|fm_embed)/table", P(("tp",), None)),
+    ]

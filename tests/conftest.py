@@ -1,14 +1,15 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU platform.
 
-Must run before any jax import so sharding/multichip tests exercise real
-`jax.sharding.Mesh` semantics without TPU hardware (the driver's
-dryrun_multichip uses the same trick).
+The sharding/multichip tests exercise real `jax.sharding.Mesh` semantics
+without TPU hardware (the driver's dryrun_multichip uses the same trick).
+Note: the image's sitecustomize may pre-import jax and register a TPU
+backend, so we must redirect via jax.config (which works any time before
+first backend initialization), not just env vars.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +17,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # control-plane tests run fine without jax
+    pass

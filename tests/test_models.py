@@ -1,0 +1,122 @@
+"""Model-family tests: shapes, gradients, single-step convergence (tiny)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_operator_tpu.models import bert, deepfm, resnet, wide_deep
+from paddle_operator_tpu.ops import nn, optim
+
+KEY = jax.random.PRNGKey(0)
+
+CTR_CFG = dict(num_slots=4, vocab_per_slot=50, embed_dim=8, dense_dim=4,
+               hidden=[16, 8])
+
+
+def test_resnet18_forward_shapes():
+    p = resnet.init(KEY, depth=18, num_classes=10)
+    batch = resnet.synthetic_batch(KEY, 2, image_size=32, num_classes=10)
+    logits, stats = resnet.apply(p, batch["image"], train=True)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert stats  # BN stats collected in train mode
+    logits_eval, stats_eval = resnet.apply(p, batch["image"], train=False)
+    assert stats_eval == {}
+
+
+def test_resnet50_param_count():
+    p = resnet.init(KEY, depth=50, num_classes=1000)
+    n = sum(x.size for x in jax.tree_util.tree_leaves(p))
+    # ResNet-50 ~25.5M params (+ BN running stats counted in the tree)
+    assert 25_000_000 < n < 26_200_000
+
+
+def test_resnet_merge_stats_updates_running_stats():
+    p = resnet.init(KEY, depth=18, num_classes=10)
+    batch = resnet.synthetic_batch(KEY, 2, image_size=32, num_classes=10)
+    _, stats = resnet.apply(p, batch["image"], train=True)
+    merged = resnet.merge_stats(p, stats)
+    before = p["stem"]["bn"]["mean"]
+    after = merged["stem"]["bn"]["mean"]
+    assert not jnp.allclose(before, after)
+    # untouched leaves preserved
+    assert merged["stem"]["conv"]["kernel"] is p["stem"]["conv"]["kernel"]
+
+
+def test_bert_tiny_mlm_loss_and_grads():
+    p = bert.init(KEY, bert.TINY_CONFIG)
+    batch = bert.synthetic_batch(KEY, 2, seq_len=16, vocab_size=1024)
+    loss, aux = bert.loss_fn(p, batch)
+    assert jnp.isfinite(loss)
+    # roughly ln(vocab) at init
+    assert 5.0 < float(loss) < 9.0
+    grads = jax.grad(lambda pp: bert.loss_fn(pp, batch)[0])(p)
+    gn = optim.global_norm(grads)
+    assert jnp.isfinite(gn) and float(gn) > 0
+
+
+def test_bert_remat_matches():
+    p = bert.init(KEY, bert.TINY_CONFIG)
+    batch = bert.synthetic_batch(KEY, 2, seq_len=16, vocab_size=1024)
+    l1, _ = bert.loss_fn(p, batch, remat=False)
+    l2, _ = bert.loss_fn(p, batch, remat=True)
+    assert jnp.allclose(l1, l2, rtol=1e-5)
+
+
+@pytest.mark.parametrize("mod", [wide_deep, deepfm])
+def test_ctr_models_converge(mod):
+    p = mod.init(KEY, CTR_CFG)
+    batch = mod.synthetic_batch(KEY, 16, CTR_CFG)
+    opt = optim.adamw(1e-2, wd_mask=optim.make_wd_mask(p))
+    state = opt.init(p)
+    loss0 = None
+    for _ in range(5):
+        (loss, _), grads = jax.value_and_grad(
+            lambda pp: mod.loss_fn(pp, batch), has_aux=True
+        )(p)
+        if loss0 is None:
+            loss0 = float(loss)
+        p, state = opt.update(grads, state, p)
+    assert float(loss) < loss0
+
+
+def test_mha_head_axis_explicit():
+    p = nn.mha_init(KEY, 64, 4)
+    assert p["q"]["kernel"].shape == (64, 4, 16)
+    assert p["o"]["kernel"].shape == (4, 16, 64)
+    x = jax.random.normal(KEY, (2, 8, 64))
+    y = nn.mha(p, x)
+    assert y.shape == (2, 8, 64)
+
+
+def test_optimizer_wd_mask_protects_bn_stats():
+    p = {"conv": {"kernel": jnp.ones((3, 3))},
+         "bn": {"mean": jnp.ones((3,)), "var": jnp.ones((3,)),
+                "scale": jnp.ones((3,)), "bias": jnp.zeros((3,))}}
+    mask = optim.make_wd_mask(p)
+    assert mask["conv"]["kernel"] is True or mask["conv"]["kernel"]
+    assert not mask["bn"]["mean"]
+    opt = optim.sgd(0.1, momentum=0.0, weight_decay=1.0, wd_mask=mask)
+    state = opt.init(p)
+    zero_grads = jax.tree_util.tree_map(jnp.zeros_like, p)
+    new_p, _ = opt.update(zero_grads, state, p)
+    # decayed: conv kernel shrank; protected: bn stats unchanged
+    assert float(new_p["conv"]["kernel"][0, 0]) < 1.0
+    assert float(new_p["bn"]["mean"][0]) == 1.0
+
+
+def test_sgd_momentum_quadratic():
+    p = {"w": jnp.array([4.0, -3.0])}
+    opt = optim.sgd(0.1, momentum=0.9)
+    state = opt.init(p)
+    for _ in range(150):
+        grads = jax.grad(lambda pp: jnp.sum(pp["w"] ** 2))(p)
+        p, state = opt.update(grads, state, p)
+    assert float(jnp.abs(p["w"]).max()) < 0.05
+
+
+def test_cosine_schedule_endpoints():
+    lr = optim.cosine_schedule(1.0, total_steps=100, warmup_steps=10)
+    assert float(lr(jnp.array(0))) == 0.0
+    assert abs(float(lr(jnp.array(10))) - 1.0) < 1e-6
+    assert float(lr(jnp.array(100))) < 1e-6
