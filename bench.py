@@ -1,0 +1,72 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one TPU chip.
+
+North-star metric per BASELINE.md: ResNet-50 images/sec via the job CRD.
+The reference publishes no numbers (BASELINE.json "published": {}), so
+vs_baseline is reported against a nominal target recorded here.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.models import resnet
+from paddle_operator_tpu.ops import optim
+from paddle_operator_tpu.parallel import build_train_step, make_mesh, resnet_rules
+
+# No published reference number exists; use a nominal single-v5e-chip target
+# so vs_baseline is meaningful across rounds (v5e ~197 bf16 TFLOP/s; ResNet-50
+# fwd+bwd ~12.4 GFLOP/image at 224^2 => ~50% MXU utilization target).
+NOMINAL_TARGET_IMAGES_PER_SEC = 800.0
+
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev}) if n_dev > 1 else None
+
+    params = resnet.init(key, depth=50, num_classes=1000)
+    batch = resnet.synthetic_batch(key, BATCH, image_size=IMAGE)
+    opt = optim.sgd(
+        optim.cosine_schedule(0.1, 1000, 50), momentum=0.9,
+        weight_decay=1e-4, wd_mask=optim.make_wd_mask(params),
+    )
+    step, state = build_train_step(
+        resnet.loss_fn, opt, params, batch,
+        mesh=mesh, rules=resnet_rules(), merge_stats=resnet.merge_stats,
+    )
+
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / NOMINAL_TARGET_IMAGES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ def build_train_step(
     mesh: Optional[Mesh] = None,
     rules: Optional[Rules] = None,
     batch_axis: str = "dp",
+    seq_axis: Optional[str] = None,
     merge_stats: Optional[Callable] = None,
     grad_clip: Optional[float] = None,
 ):
@@ -65,11 +66,19 @@ def build_train_step(
     param_sh = shard_tree(params, mesh, rules)
     opt_sh = shard_tree(state["opt"], mesh, rules)
     state_sh = {"params": param_sh, "opt": opt_sh}
+    def batch_spec(leaf):
+        nd = getattr(leaf, "ndim", 0)
+        if nd == 0:
+            return P()
+        if seq_axis is not None and nd >= 2:
+            # sequence/context parallelism: tokens sharded over `sp` too —
+            # GSPMD gathers the sequence where attention needs it and keeps
+            # embedding/loss work token-sharded.
+            return P(batch_axis, seq_axis)
+        return P(batch_axis)
+
     batch_sh = jax.tree_util.tree_map(
-        lambda leaf: named(
-            mesh, P(batch_axis) if getattr(leaf, "ndim", 0) >= 1 else P()
-        ),
-        sample_batch,
+        lambda leaf: named(mesh, batch_spec(leaf)), sample_batch
     )
     metric_sh = named(mesh, P())
 

@@ -1,0 +1,3 @@
+"""Utilities: checkpointing, logging/metrics helpers."""
+
+from .checkpoint import save_checkpoint, restore_checkpoint, latest_step  # noqa: F401

@@ -1,0 +1,129 @@
+"""Checkpoint/resume for train-state pytrees.
+
+The reference delegates checkpointing entirely to training containers
+(SURVEY.md §5.4: elastic demo mounts /checkpoint hostPath); here it is a
+framework citizen because TPU elasticity *is* restart-from-checkpoint — a
+collective job cannot shrink below its compiled mesh, so preemption recovery
+= whole-slice restart from the newest step (see elastic/sync.py epoch).
+
+Format: one directory per step, `state.npz` (flat path -> array) +
+`manifest.json` (treedef + dtypes + membership epoch). Atomic via tmp-dir
+rename so a preempted writer never leaves a half checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], "%s%s/" % (prefix, k)))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, "%s%d/" % (prefix, i)))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _structure(tree: Any) -> Any:
+    if isinstance(tree, dict):
+        return {k: _structure(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_structure(v) for v in tree]
+    return None  # leaf marker
+
+
+def _unflatten(structure: Any, flat: Dict[str, Any], prefix: str = "") -> Any:
+    if isinstance(structure, dict):
+        return {
+            k: _unflatten(v, flat, "%s%s/" % (prefix, k))
+            for k, v in structure.items()
+        }
+    if isinstance(structure, list):
+        return [
+            _unflatten(v, flat, "%s%d/" % (prefix, i))
+            for i, v in enumerate(structure)
+        ]
+    return flat[prefix[:-1]]
+
+
+def save_checkpoint(ckpt_dir: str, step: int, state: Any,
+                    meta: Optional[dict] = None, keep: int = 3) -> str:
+    """Write state atomically; prune to the newest `keep` checkpoints."""
+    flat = _flatten(state)
+    arrays = {k: np.asarray(v) for k, v in flat.items()}
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    final = os.path.join(ckpt_dir, "step_%012d" % step)
+    tmp = tempfile.mkdtemp(dir=ckpt_dir, prefix=".tmp_")
+    try:
+        np.savez(os.path.join(tmp, "state.npz"), **arrays)
+        manifest = {
+            "step": step,
+            "structure": _structure(state),
+            "meta": meta or {},
+        }
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    steps = sorted(all_steps(ckpt_dir))
+    for old in steps[:-keep]:
+        shutil.rmtree(os.path.join(ckpt_dir, "step_%012d" % old),
+                      ignore_errors=True)
+    return final
+
+
+def all_steps(ckpt_dir: str):
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step_") and os.path.exists(
+            os.path.join(ckpt_dir, name, "manifest.json")
+        ):
+            out.append(int(name[len("step_"):]))
+    return sorted(out)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = all_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
+                       sharding_tree: Any = None) -> Tuple[Any, dict]:
+    """Load (state, manifest). If `sharding_tree` is given (a pytree of
+    NamedSharding matching the state), leaves are device_put sharded."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError("no checkpoints under %s" % ckpt_dir)
+    path = os.path.join(ckpt_dir, "step_%012d" % step)
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    with np.load(os.path.join(path, "state.npz")) as npz:
+        flat = {k: npz[k] for k in npz.files}
+    state = _unflatten(manifest["structure"], flat)
+    if sharding_tree is not None:
+        import jax
+
+        state = jax.tree_util.tree_map(
+            lambda leaf, sh: jax.device_put(leaf, sh), state, sharding_tree
+        )
+    return state, manifest

@@ -1,0 +1,166 @@
+"""Launcher env detection, elastic agent cycles, checkpoint roundtrip."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_operator_tpu.elastic.store import MemoryKVStore
+from paddle_operator_tpu.elastic.sync import epoch_key, np_key
+from paddle_operator_tpu.launch import ElasticAgent, LaunchConfig, detect_env
+from paddle_operator_tpu.utils.checkpoint import (
+    all_steps, latest_step, restore_checkpoint, save_checkpoint,
+)
+
+
+# ---------------------------------------------------------------------------
+# env detection
+# ---------------------------------------------------------------------------
+
+def test_detect_env_tpu_names():
+    cfg = detect_env({
+        "TPU_WORKER_ID": "2",
+        "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3",
+        "TPUJOB_NUM_WORKERS": "4",
+        "TPUJOB_COORDINATOR": "h0:2379",
+    })
+    assert cfg.worker_id == 2
+    assert cfg.num_workers == 4
+    assert cfg.coordinator == "h0:2379"
+    assert cfg.hostnames == ["h0", "h1", "h2", "h3"]
+    assert cfg.is_distributed and not cfg.is_elastic
+
+
+def test_detect_env_paddle_parity_names():
+    cfg = detect_env({
+        "PADDLE_TRAINER_ID": "1",
+        "PADDLE_TRAINER_ENDPOINTS": "10.0.0.1:2379,10.0.0.2:2379",
+        "PADDLE_TRAINERS_NUM": "2",
+        "PADDLE_PORT": "2379",
+        "TRAINING_ROLE": "TRAINER",
+    })
+    assert cfg.worker_id == 1
+    assert cfg.num_workers == 2
+    assert cfg.coordinator == "10.0.0.1:2379"
+
+
+def test_detect_env_elastic():
+    cfg = detect_env({
+        "TPU_WORKER_ID": "0",
+        "TPUJOB_NUM_WORKERS": "4",
+        "PADDLE_ELASTIC_JOB_ID": "default-ers",
+        "TPUJOB_ELASTIC_SERVER": "http://ms:2379",
+        "PADDLE_ELASTIC_TIMEOUT": "30",
+    })
+    assert cfg.is_elastic
+    assert cfg.job_id == "default-ers"
+    assert cfg.elastic_timeout == 30.0
+
+
+def test_detect_env_single():
+    cfg = detect_env({})
+    assert cfg.worker_id == 0 and cfg.num_workers == 1
+    assert not cfg.is_distributed
+
+
+# ---------------------------------------------------------------------------
+# elastic agent
+# ---------------------------------------------------------------------------
+
+def make_agent(store):
+    cfg = LaunchConfig(
+        worker_id=0, num_workers=4, job_id="default-ers",
+        elastic_server="mem://",
+    )
+    return ElasticAgent(cfg, store=store, poll_interval=0.0)
+
+
+def test_elastic_agent_completes_without_change():
+    store = MemoryKVStore()
+    store.put(np_key("default", "ers"), "4")
+    store.put(epoch_key("default", "ers"), "1")
+    agent = make_agent(store)
+    seen = []
+
+    def train(world, epoch, should_stop):
+        seen.append((world, epoch))
+        return True  # complete immediately
+
+    assert agent.run(train) == 1
+    assert seen == [(4, 1)]
+
+
+def test_elastic_agent_restarts_on_epoch_bump():
+    store = MemoryKVStore()
+    store.put(np_key("default", "ers"), "4")
+    store.put(epoch_key("default", "ers"), "1")
+    agent = make_agent(store)
+    cycles = []
+
+    def train(world, epoch, should_stop):
+        cycles.append((world, epoch))
+        if len(cycles) == 1:
+            # operator scales mid-training: 4 -> 8, epoch bump
+            store.put(np_key("default", "ers"), "8")
+            store.put(epoch_key("default", "ers"), "2")
+            assert should_stop()  # agent notices
+            return False  # interrupted, not complete
+        return True
+
+    assert agent.run(train) == 2
+    assert cycles == [(4, 1), (8, 2)]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint
+# ---------------------------------------------------------------------------
+
+def make_state():
+    return {
+        "params": {
+            "layers": [
+                {"kernel": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+                {"kernel": jnp.ones((3,), jnp.bfloat16)},
+            ]
+        },
+        "opt": {"step": jnp.array(7, jnp.int32)},
+    }
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = make_state()
+    save_checkpoint(str(tmp_path), 7, state, meta={"epoch": 3})
+    restored, manifest = restore_checkpoint(str(tmp_path))
+    assert manifest["step"] == 7
+    assert manifest["meta"]["epoch"] == 3
+    np.testing.assert_array_equal(
+        restored["params"]["layers"][0]["kernel"],
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+    )
+    assert int(restored["opt"]["step"]) == 7
+    # bf16 leaf survives via numpy void/round-trip
+    assert restored["params"]["layers"][1]["kernel"].shape == (3,)
+
+
+def test_checkpoint_keep_prunes(tmp_path):
+    state = make_state()
+    for step in [1, 2, 3, 4, 5]:
+        save_checkpoint(str(tmp_path), step, state, keep=3)
+    assert all_steps(str(tmp_path)) == [3, 4, 5]
+    assert latest_step(str(tmp_path)) == 5
+
+
+def test_checkpoint_restore_specific_step(tmp_path):
+    state = make_state()
+    save_checkpoint(str(tmp_path), 1, state)
+    state["opt"]["step"] = jnp.array(99, jnp.int32)
+    save_checkpoint(str(tmp_path), 2, state)
+    restored, _ = restore_checkpoint(str(tmp_path), step=1)
+    assert int(restored["opt"]["step"]) == 7
+
+
+def test_checkpoint_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path / "none"))
