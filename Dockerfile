@@ -1,0 +1,20 @@
+# Operator + runtime image (reference: Dockerfile builds /manager from Go;
+# here one image serves both the manager and the training runtime — the
+# runtime layer adds jax[tpu] on TPU node pools).
+FROM python:3.12-slim AS base
+
+WORKDIR /opt/tpujob
+COPY pyproject.toml Makefile ./
+COPY native/ native/
+COPY paddle_operator_tpu/ paddle_operator_tpu/
+COPY examples/ examples/
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && make -C native \
+    && apt-get purge -y g++ && apt-get autoremove -y \
+    && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir numpy pyyaml
+
+ENV PYTHONPATH=/opt/tpujob
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "paddle_operator_tpu.manager"]

@@ -1,0 +1,39 @@
+# Build/test/deploy targets (reference: Makefile — test/manager/run/install/
+# deploy/gen-deploy/helm/manifests/generate pipeline, reshaped for Python+C++).
+
+PY ?= python
+IMG ?= ghcr.io/tpujob/operator:v0.1.0
+
+.PHONY: all test bench native manifests gen-deploy helm run install deploy docker-build clean
+
+all: native test
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+# native components (host-port allocator); python fallbacks exist
+native:
+	$(MAKE) -C native
+
+# regenerate CRD + operator manifests + helm chart from api/crd.py
+manifests gen-deploy helm:
+	$(PY) scripts/gen_deploy.py
+
+run:
+	$(PY) -m paddle_operator_tpu.manager
+
+install:
+	kubectl apply -f deploy/v1/crd.yaml
+
+deploy: install
+	kubectl apply -f deploy/v1/operator.yaml
+
+docker-build:
+	docker build -t $(IMG) .
+
+clean:
+	rm -rf build dist *.egg-info paddle_operator_tpu/_native
+	find . -name __pycache__ -type d -exec rm -rf {} +

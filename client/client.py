@@ -1,0 +1,71 @@
+"""Go-SDK-example analog (reference: client/client.go): minimal typed-client
+CRUD against the TpuJob CRD. Run against a real cluster:
+
+    python client/client.py --kube-api https://...:6443
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.k8s.client import HttpKubeClient
+from paddle_operator_tpu.k8s.errors import NotFoundError
+
+
+def demo_job(name: str) -> dict:
+    return api.new_tpujob(name, spec={
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": "2x4"},
+        "cleanPodPolicy": "OnCompletion",
+        "worker": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{
+                "name": "trainer",
+                "image": "ghcr.io/tpujob/runtime:v0.1.0",
+                "command": ["python", "-m", "paddle_operator_tpu.launch",
+                            "/opt/tpujob/examples/train_resnet.py"],
+            }]}},
+        },
+    })
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kube-api", default=None)
+    ap.add_argument("--insecure-skip-tls-verify", action="store_true")
+    ap.add_argument("--name", default="client-demo")
+    args = ap.parse_args()
+
+    client = HttpKubeClient(base_url=args.kube_api,
+                            insecure=args.insecure_skip_tls_verify)
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+
+    # Create
+    job = client.create(demo_job(args.name))
+    print("created:", job["metadata"]["name"], job["metadata"]["uid"])
+
+    # Get + watch status a few times
+    for _ in range(5):
+        got = client.get(api.KIND, "default", args.name)
+        print("phase:", got.get("status", {}).get("phase", "<none>"))
+        time.sleep(2)
+
+    # List
+    jobs = client.list(api.KIND, "default")
+    print("jobs in default:", [j["metadata"]["name"] for j in jobs])
+
+    # Delete
+    client.delete(api.KIND, "default", args.name)
+    try:
+        client.get(api.KIND, "default", args.name)
+        print("job still terminating (finalizer)")
+    except NotFoundError:
+        print("deleted")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""deepfm CTR training entry (PS-mode parity workload)."""
+
+import logging
+import os
+
+from paddle_operator_tpu.models import deepfm
+from paddle_operator_tpu.ops import optim
+from paddle_operator_tpu.parallel.sharding import ctr_rules
+from paddle_operator_tpu.runner import TrainJob, run_training
+
+logging.basicConfig(level=logging.INFO)
+
+BATCH = int(os.environ.get("TPUJOB_BATCH", "512"))
+STEPS = int(os.environ.get("TPUJOB_STEPS", "100"))
+
+
+def main():
+    job = TrainJob(
+        init_params=lambda rng: deepfm.init(rng),
+        loss_fn=deepfm.loss_fn,
+        optimizer=optim.adamw(1e-3),
+        make_batch=lambda rng, step: deepfm.synthetic_batch(rng, BATCH),
+        rules=ctr_rules(),
+        total_steps=STEPS,
+    )
+    out = run_training(job)
+    print("final loss:", out.get("loss"))
+
+
+if __name__ == "__main__":
+    main()

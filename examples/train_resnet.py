@@ -1,0 +1,41 @@
+"""ResNet-50 training entry (collective mode; see deploy/examples/resnet.yaml).
+
+Launched in-pod as: python -m paddle_operator_tpu.launch train_resnet.py
+"""
+
+import logging
+import os
+
+import jax
+
+from paddle_operator_tpu.models import resnet
+from paddle_operator_tpu.ops import optim
+from paddle_operator_tpu.parallel.sharding import resnet_rules
+from paddle_operator_tpu.runner import TrainJob, run_training
+
+logging.basicConfig(level=logging.INFO)
+
+BATCH = int(os.environ.get("TPUJOB_BATCH", "128"))
+STEPS = int(os.environ.get("TPUJOB_STEPS", "200"))
+
+
+def main():
+    job = TrainJob(
+        init_params=lambda rng: resnet.init(rng, depth=50, num_classes=1000),
+        loss_fn=resnet.loss_fn,
+        optimizer=optim.sgd(
+            optim.cosine_schedule(0.4, STEPS, STEPS // 20),
+            momentum=0.9, weight_decay=1e-4,
+        ),
+        make_batch=lambda rng, step: resnet.synthetic_batch(rng, BATCH),
+        rules=resnet_rules(),
+        merge_stats=resnet.merge_stats,
+        total_steps=STEPS,
+        checkpoint_dir=os.environ.get("TPUJOB_CHECKPOINT_DIR", ""),
+    )
+    out = run_training(job)
+    print("final loss:", out.get("loss"))
+
+
+if __name__ == "__main__":
+    main()

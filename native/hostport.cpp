@@ -1,0 +1,76 @@
+// Native host-port block allocator (reference capability:
+// paddlejob_controller.go:438-458 allocNewPort + the standalone
+// third_party/hostport-allocator). Exposed to Python via ctypes
+// (controllers/hostport.py); semantics mirror the Python fallback exactly:
+// wrap-around cursor over [start, end) in `block`-sized strides, skip blocks
+// already held, fail (-1) when the range is exhausted.
+
+#include <cstdint>
+#include <mutex>
+#include <unordered_set>
+
+namespace {
+
+struct Allocator {
+  int start, end, block, cursor;
+  std::unordered_set<int> used;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hp_new(int start, int end, int block) {
+  if (end - start < block || block <= 0) return nullptr;
+  auto* a = new Allocator();
+  a->start = start;
+  a->end = end;
+  a->block = block;
+  a->cursor = start;
+  return a;
+}
+
+void hp_free(void* h) { delete static_cast<Allocator*>(h); }
+
+// Returns the base port of a fresh block, or -1 if the range is exhausted.
+int hp_alloc(void* h) {
+  auto* a = static_cast<Allocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (static_cast<long>(a->used.size()) * a->block > a->end - a->start)
+    return -1;
+  const int slots = (a->end - a->start) / a->block + 1;
+  for (int i = 0; i < slots; ++i) {
+    const int port = a->cursor;
+    const int next = port + a->block;
+    a->cursor = (next + a->block <= a->end) ? next : a->start;
+    if (a->used.find(port) == a->used.end()) {
+      a->used.insert(port);
+      return port;
+    }
+  }
+  return -1;
+}
+
+// Record an externally observed allocation (controller restart re-learn).
+// Returns 0 if it was already recorded, 1 otherwise.
+int hp_mark_used(void* h, int port) {
+  auto* a = static_cast<Allocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->used.insert(port).second ? 1 : 0;
+}
+
+// Returns 1 if the block was held and is now released, 0 otherwise.
+int hp_release(void* h, int port) {
+  auto* a = static_cast<Allocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->used.erase(port) ? 1 : 0;
+}
+
+int hp_used_count(void* h) {
+  auto* a = static_cast<Allocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return static_cast<int>(a->used.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+"""High-level training runner: the in-container counterpart of the operator.
+
+Wires together env detection (launch), mesh construction, the SPMD train
+step, checkpointing, and — for elastic jobs — the membership agent's
+restart-from-checkpoint cycles. Example scripts under ``examples/`` are thin
+wrappers over :func:`run_training`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distributed
+from .ops.optim import Optimizer
+from .parallel import build_train_step, make_mesh
+from .parallel.sharding import Rules
+from .utils.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+
+log = logging.getLogger("tpujob.runner")
+
+
+@dataclass
+class TrainJob:
+    """Everything the runner needs to train one model."""
+
+    init_params: Callable[[jax.Array], Any]          # rng -> params
+    loss_fn: Callable                                 # (params, batch) -> (loss, aux)
+    optimizer: Optimizer
+    make_batch: Callable[[jax.Array, int], Any]       # (rng, step) -> batch
+    rules: Optional[Rules] = None
+    mesh_axes: Optional[Dict[str, int]] = None
+    seq_axis: Optional[str] = None
+    merge_stats: Optional[Callable] = None
+    grad_clip: Optional[float] = None
+    total_steps: int = 100
+    log_every: int = 10
+    checkpoint_every: int = 50
+    checkpoint_dir: str = ""
+    seed: int = 0
+
+
+def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
+                 init_distributed: bool = True,
+                 poll_interval: float = 2.0) -> Dict[str, Any]:
+    """Train to job.total_steps, elastically if configured.
+
+    Returns {"state": final_state, "steps": int, "cycles": int, "loss": float}.
+    """
+    cfg = cfg or detect_env()
+    if init_distributed:
+        initialize_distributed(cfg)
+
+    result: Dict[str, Any] = {"cycles": 0}
+
+    def train_cycle(world: int, epoch: int, should_stop: Callable[[], bool]) -> bool:
+        mesh = make_mesh(job.mesh_axes) if (
+            job.mesh_axes or len(jax.devices()) > 1
+        ) else None
+        rng = jax.random.PRNGKey(job.seed)
+        params = job.init_params(rng)
+        step_fn, state = build_train_step(
+            job.loss_fn, job.optimizer, params, job.make_batch(rng, 0),
+            mesh=mesh, rules=job.rules, seq_axis=job.seq_axis,
+            merge_stats=job.merge_stats, grad_clip=job.grad_clip,
+        )
+
+        start_step = 0
+        if job.checkpoint_dir and latest_step(job.checkpoint_dir) is not None:
+            restored, manifest = restore_checkpoint(job.checkpoint_dir)
+            state = jax.device_put(
+                restored,
+                jax.tree_util.tree_map(lambda leaf: leaf.sharding, state),
+            )
+            start_step = manifest["step"]
+            log.info("restored checkpoint step=%d (epoch %s)",
+                     start_step, manifest["meta"].get("epoch"))
+
+        t0 = time.perf_counter()
+        metrics = {}
+        for step in range(start_step, job.total_steps):
+            batch = job.make_batch(jax.random.fold_in(rng, step), step)
+            state, metrics = step_fn(state, batch)
+            if job.log_every and (step + 1) % job.log_every == 0:
+                loss = float(metrics["loss"])
+                rate = (step + 1 - start_step) / (time.perf_counter() - t0)
+                log.info("step %d loss=%.4f steps/s=%.2f", step + 1, loss, rate)
+            if job.checkpoint_dir and (step + 1) % job.checkpoint_every == 0:
+                if cfg.worker_id == 0:
+                    save_checkpoint(
+                        job.checkpoint_dir, step + 1,
+                        jax.device_get(state), meta={"epoch": epoch},
+                    )
+            if should_stop():
+                log.info("membership epoch moved at step %d; restarting", step + 1)
+                if job.checkpoint_dir and cfg.worker_id == 0:
+                    save_checkpoint(
+                        job.checkpoint_dir, step + 1,
+                        jax.device_get(state), meta={"epoch": epoch},
+                    )
+                return False
+            result["state"] = state
+            result["steps"] = step + 1
+        if metrics:
+            result["loss"] = float(metrics["loss"])
+        return True
+
+    if cfg.is_elastic:
+        agent = ElasticAgent(cfg, poll_interval=poll_interval)
+        result["cycles"] = agent.run(train_cycle)
+    else:
+        train_cycle(cfg.num_workers, 0, lambda: False)
+        result["cycles"] = 1
+    return result

@@ -1,0 +1,188 @@
+"""Render deployment manifests (`make gen-deploy` analog, reference
+Makefile:43-50): deploy/v1/{crd,operator}.yaml + helm chart from the same
+sources, so the three install paths never drift.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import yaml
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.api.crd import crd_manifest
+
+NAMESPACE = "tpujob-system"
+IMAGE = "ghcr.io/tpujob/operator:v0.1.0"
+
+
+def operator_manifests(namespace=NAMESPACE, image=IMAGE, jobnamespace=""):
+    sa = {"apiVersion": "v1", "kind": "ServiceAccount",
+          "metadata": {"name": "tpujob-operator", "namespace": namespace}}
+
+    cluster_role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "tpujob-operator-role"},
+        "rules": [
+            {"apiGroups": [api.GROUP],
+             "resources": [api.PLURAL],
+             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+            {"apiGroups": [api.GROUP],
+             "resources": ["%s/status" % api.PLURAL],
+             "verbs": ["get", "update", "patch"]},
+            {"apiGroups": [api.GROUP],
+             "resources": ["%s/finalizers" % api.PLURAL],
+             "verbs": ["update"]},
+            {"apiGroups": [""], "resources": ["pods"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+            {"apiGroups": [""], "resources": ["pods/status"], "verbs": ["get"]},
+            {"apiGroups": [""], "resources": ["pods/exec"], "verbs": ["get", "create"]},
+            {"apiGroups": [""], "resources": ["services"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+            {"apiGroups": [""], "resources": ["services/status"], "verbs": ["get"]},
+            {"apiGroups": [""], "resources": ["configmaps"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+            {"apiGroups": [""], "resources": ["configmaps/status"], "verbs": ["get"]},
+            {"apiGroups": [""], "resources": ["events"], "verbs": ["create", "patch"]},
+            {"apiGroups": ["scheduling.volcano.sh"], "resources": ["podgroups"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+            {"apiGroups": ["scheduling.volcano.sh"], "resources": ["podgroups/status"],
+             "verbs": ["get", "update", "patch"]},
+        ],
+    }
+
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "tpujob-operator-rolebinding"},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": "tpujob-operator-role"},
+        "subjects": [{"kind": "ServiceAccount", "name": "tpujob-operator",
+                      "namespace": namespace}],
+    }
+
+    leader_role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": {"name": "tpujob-leader-election-role", "namespace": namespace},
+        "rules": [
+            {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+            {"apiGroups": [""], "resources": ["events"], "verbs": ["create", "patch"]},
+        ],
+    }
+
+    leader_binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": {"name": "tpujob-leader-election-rolebinding",
+                     "namespace": namespace},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io", "kind": "Role",
+                    "name": "tpujob-leader-election-role"},
+        "subjects": [{"kind": "ServiceAccount", "name": "tpujob-operator",
+                      "namespace": namespace}],
+    }
+
+    args = [
+        "--leader-elect",
+        "--metrics-bind-address", ":8080",
+        "--health-probe-bind-address", ":8081",
+    ]
+    if jobnamespace:
+        args += ["--namespace", jobnamespace]
+
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "tpujob-operator", "namespace": namespace,
+                     "labels": {"control-plane": "tpujob-operator"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"control-plane": "tpujob-operator"}},
+            "template": {
+                "metadata": {"labels": {"control-plane": "tpujob-operator"}},
+                "spec": {
+                    "serviceAccountName": "tpujob-operator",
+                    "securityContext": {"runAsNonRoot": True, "runAsUser": 65532},
+                    "terminationGracePeriodSeconds": 10,
+                    "containers": [{
+                        "name": "manager",
+                        "image": image,
+                        "command": ["python", "-m", "paddle_operator_tpu.manager"],
+                        "args": args,
+                        "securityContext": {"allowPrivilegeEscalation": False},
+                        "resources": {
+                            "limits": {"cpu": "100m", "memory": "300Mi"},
+                            "requests": {"cpu": "100m", "memory": "20Mi"},
+                        },
+                        "livenessProbe": {
+                            "httpGet": {"path": "/healthz", "port": 8081},
+                            "initialDelaySeconds": 15, "periodSeconds": 20,
+                        },
+                        "readinessProbe": {
+                            "httpGet": {"path": "/readyz", "port": 8081},
+                            "initialDelaySeconds": 5, "periodSeconds": 10,
+                        },
+                        "ports": [{"containerPort": 8080, "name": "metrics"}],
+                    }],
+                },
+            },
+        },
+    }
+
+    namespace_obj = {"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": namespace}}
+    return [namespace_obj, sa, cluster_role, binding, leader_role,
+            leader_binding, deployment]
+
+
+def dump_all(objs):
+    return "---\n".join(yaml.safe_dump(o, sort_keys=False, width=100) for o in objs)
+
+
+def main():
+    v1 = os.path.join(ROOT, "deploy", "v1")
+    os.makedirs(v1, exist_ok=True)
+    with open(os.path.join(v1, "crd.yaml"), "w") as f:
+        f.write(yaml.safe_dump(crd_manifest(), sort_keys=False, width=100))
+    with open(os.path.join(v1, "operator.yaml"), "w") as f:
+        f.write(dump_all(operator_manifests()))
+
+    # helm chart: same objects, image/namespaces templated
+    chart_dir = os.path.join(ROOT, "charts", "paddle-operator-tpu")
+    tmpl_dir = os.path.join(chart_dir, "templates")
+    os.makedirs(tmpl_dir, exist_ok=True)
+    with open(os.path.join(chart_dir, "Chart.yaml"), "w") as f:
+        yaml.safe_dump({
+            "apiVersion": "v2", "name": "paddle-operator-tpu",
+            "description": "TPU-native training-job operator",
+            "type": "application", "version": "0.1.0", "appVersion": "0.1.0",
+        }, f, sort_keys=False)
+    with open(os.path.join(chart_dir, "values.yaml"), "w") as f:
+        yaml.safe_dump({
+            "image": IMAGE,
+            "controllernamespace": NAMESPACE,
+            "jobnamespace": "",
+        }, f, sort_keys=False)
+    with open(os.path.join(tmpl_dir, "crd.yaml"), "w") as f:
+        f.write(yaml.safe_dump(crd_manifest(), sort_keys=False, width=100))
+    rendered = dump_all(
+        operator_manifests("CTRL_NS_PLACEHOLDER", "IMAGE_PLACEHOLDER",
+                           "JOB_NS_PLACEHOLDER")
+    )
+    rendered = (
+        rendered
+        .replace("IMAGE_PLACEHOLDER", "{{ .Values.image }}")
+        .replace("CTRL_NS_PLACEHOLDER", "{{ .Values.controllernamespace }}")
+        .replace("JOB_NS_PLACEHOLDER", "{{ .Values.jobnamespace }}")
+    )
+    with open(os.path.join(tmpl_dir, "controller.yaml"), "w") as f:
+        f.write(rendered)
+    print("rendered deploy/v1 and charts/paddle-operator-tpu")
+
+
+if __name__ == "__main__":
+    main()

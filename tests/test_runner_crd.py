@@ -1,0 +1,131 @@
+"""End-to-end runner tests (train → checkpoint → elastic restart) and CRD
+manifest generation checks."""
+
+import jax
+import jax.numpy as jnp
+import yaml
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.api.crd import crd_manifest, crd_yaml
+from paddle_operator_tpu.elastic.store import MemoryKVStore
+from paddle_operator_tpu.elastic.sync import epoch_key, np_key
+from paddle_operator_tpu.launch import LaunchConfig
+from paddle_operator_tpu.models import wide_deep
+from paddle_operator_tpu.ops import optim
+from paddle_operator_tpu.runner import TrainJob, run_training
+from paddle_operator_tpu.utils.checkpoint import all_steps
+
+CFG = dict(num_slots=4, vocab_per_slot=50, embed_dim=8, dense_dim=4,
+           hidden=[16])
+
+
+def small_job(**kw):
+    defaults = dict(
+        init_params=lambda rng: wide_deep.init(rng, CFG),
+        loss_fn=wide_deep.loss_fn,
+        optimizer=optim.adamw(1e-2),
+        make_batch=lambda rng, step: wide_deep.synthetic_batch(rng, 8, CFG),
+        mesh_axes={"dp": 8},
+        total_steps=6,
+        log_every=0,
+        checkpoint_every=2,
+    )
+    defaults.update(kw)
+    return TrainJob(**defaults)
+
+
+def test_runner_trains_to_completion():
+    out = run_training(small_job(), cfg=LaunchConfig(), init_distributed=False)
+    assert out["steps"] == 6
+    assert out["cycles"] == 1
+    assert jnp.isfinite(out["loss"])
+
+
+def test_runner_checkpoints_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    out = run_training(small_job(checkpoint_dir=ckpt),
+                       cfg=LaunchConfig(), init_distributed=False)
+    assert all_steps(ckpt) == [2, 4, 6]
+    # resume: a fresh run starts from step 6 and finishes instantly
+    out2 = run_training(small_job(checkpoint_dir=ckpt, total_steps=8),
+                        cfg=LaunchConfig(), init_distributed=False)
+    assert out2["steps"] == 8
+
+
+def test_runner_elastic_restart_cycle(tmp_path, monkeypatch):
+    """Scale event mid-training: agent restarts the cycle from checkpoint."""
+    store = MemoryKVStore()
+    store.put(np_key("default", "ej"), "1")
+    store.put(epoch_key("default", "ej"), "1")
+
+    cfg = LaunchConfig(worker_id=0, num_workers=1, job_id="default-ej",
+                       elastic_server="mem://")
+    import paddle_operator_tpu.runner as runner_mod
+    monkeypatch.setattr(
+        "paddle_operator_tpu.launch.kv_connect", lambda ep: store
+    )
+
+    ckpt = str(tmp_path / "ck")
+    fired = {"done": False}
+    orig_batch = lambda rng, step: wide_deep.synthetic_batch(rng, 8, CFG)
+
+    def batch_with_scale(rng, step):
+        # after a few steps of cycle 1, the "operator" bumps the epoch
+        if step == 3 and not fired["done"]:
+            fired["done"] = True
+            store.put(np_key("default", "ej"), "2")
+            store.put(epoch_key("default", "ej"), "2")
+        return orig_batch(rng, step)
+
+    job = small_job(make_batch=batch_with_scale, checkpoint_dir=ckpt,
+                    total_steps=6, checkpoint_every=100)
+    out = run_training(job, cfg=cfg, init_distributed=False, poll_interval=0.0)
+    assert out["cycles"] == 2            # interrupted once, then completed
+    assert out["steps"] == 6
+    assert all_steps(ckpt)               # interrupt checkpoint was written
+
+
+# ---------------------------------------------------------------------------
+# CRD manifest
+# ---------------------------------------------------------------------------
+
+def test_crd_manifest_shape():
+    crd = crd_manifest()
+    assert crd["metadata"]["name"] == "tpujobs.batch.tpujob.dev"
+    names = crd["spec"]["names"]
+    assert names["kind"] == api.KIND
+    assert names["shortNames"] == ["tj"]
+    v1 = crd["spec"]["versions"][0]
+    assert v1["subresources"] == {"status": {}}
+    cols = {c["name"]: c["jsonPath"] for c in v1["additionalPrinterColumns"]}
+    assert cols["Status"] == ".status.phase"
+    assert cols["Mode"] == ".status.mode"
+    spec_props = v1["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+    for field in ("ps", "worker", "heter", "elastic", "intranet",
+                  "cleanPodPolicy", "schedulingPolicy", "withGloo",
+                  "device", "tpu"):
+        assert field in spec_props, field
+    assert spec_props["intranet"]["enum"] == ["PodIP", "Service", "Host"]
+
+
+def test_crd_yaml_parses():
+    crd = yaml.safe_load(crd_yaml())
+    assert crd["kind"] == "CustomResourceDefinition"
+
+
+def test_example_manifests_validate(pytestconfig):
+    """Every shipped example must pass TpuJob.validate()."""
+    import glob
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = glob.glob(os.path.join(root, "deploy", "examples", "*.yaml"))
+    paths += [os.path.join(root, "deploy", "elastic", "resnet.yaml")]
+    assert len(paths) >= 6
+    for path in paths:
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if not doc or doc.get("kind") != api.KIND:
+                    continue
+                job = api.TpuJob(doc)
+                assert job.validate() == [], (path, job.validate())
