@@ -27,7 +27,7 @@ from paddle_operator_tpu.parallel import build_train_step, make_mesh, resnet_rul
 # fwd+bwd ~12.4 GFLOP/image at 224^2 => ~50% MXU utilization target).
 NOMINAL_TARGET_IMAGES_PER_SEC = 800.0
 
-BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))

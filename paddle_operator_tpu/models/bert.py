@@ -18,13 +18,15 @@ from ..ops import nn
 
 BASE_CONFIG = dict(
     vocab_size=30522, hidden=768, layers=12, heads=12, mlp_dim=3072,
-    max_seq=512, type_vocab=2,
+    max_seq=512, type_vocab=2, moe_experts=0, moe_every=2,
 )
 
 TINY_CONFIG = dict(
     vocab_size=1024, hidden=128, layers=2, heads=4, mlp_dim=256,
-    max_seq=128, type_vocab=2,
+    max_seq=128, type_vocab=2, moe_experts=0, moe_every=2,
 )
+
+TINY_MOE_CONFIG = dict(TINY_CONFIG, moe_experts=4, moe_every=1)
 
 
 def init(key, config: Optional[dict] = None) -> Dict:
@@ -47,31 +49,46 @@ def init(key, config: Optional[dict] = None) -> Dict:
             "decoder": nn.dense_init(next(keys), h, cfg["vocab_size"]),
         },
     }
-    for _ in range(cfg["layers"]):
-        params["layers"].append({
+    from ..ops.moe import moe_init
+
+    for li in range(cfg["layers"]):
+        layer = {
             "attn": nn.mha_init(next(keys), h, cfg["heads"]),
             "ln1": nn.layernorm_init(h),
-            "mlp": {
+            "ln2": nn.layernorm_init(h),
+        }
+        # MoE variant: every `moe_every`-th FFN becomes a switch-MoE block
+        # (expert axis shards over the `ep` mesh axis, parallel.moe_rules)
+        if cfg["moe_experts"] and li % cfg["moe_every"] == 0:
+            layer["moe"] = moe_init(next(keys), h, mlp, cfg["moe_experts"])
+        else:
+            layer["mlp"] = {
                 "fc1": nn.dense_init(next(keys), h, mlp),
                 "fc2": nn.dense_init(next(keys), mlp, h),
-            },
-            "ln2": nn.layernorm_init(h),
-        })
+            }
+        params["layers"].append(layer)
     return params
 
 
-def _encoder_layer(layer, x, mask, dtype):
-    y = nn.mha(layer["attn"], x, mask, dtype=dtype)
+def _encoder_layer(layer, x, mask, dtype, attn_impl="einsum"):
+    from ..ops.moe import moe_apply
+
+    y = nn.mha(layer["attn"], x, mask, dtype=dtype, impl=attn_impl)
     x = nn.layernorm(layer["ln1"], x + y, dtype=dtype)
-    y = nn.dense(layer["mlp"]["fc1"], x, dtype=dtype)
-    y = nn.gelu(y)
-    y = nn.dense(layer["mlp"]["fc2"], y, dtype=dtype)
-    return nn.layernorm(layer["ln2"], x + y, dtype=dtype)
+    aux = jnp.zeros((), jnp.float32)
+    if "moe" in layer:
+        y, moe_aux = moe_apply(layer["moe"], x, dtype=dtype)
+        aux = aux + moe_aux["moe_aux_loss"]
+    else:
+        y = nn.dense(layer["mlp"]["fc1"], x, dtype=dtype)
+        y = nn.gelu(y)
+        y = nn.dense(layer["mlp"]["fc2"], y, dtype=dtype)
+    return nn.layernorm(layer["ln2"], x + y, dtype=dtype), aux
 
 
 def encode(params, input_ids, type_ids=None, attention_mask=None,
-           dtype=jnp.bfloat16, remat: bool = False):
-    """input_ids: [B, S] -> hidden states [B, S, H]."""
+           dtype=jnp.bfloat16, remat: bool = False, attn_impl: str = "einsum"):
+    """input_ids: [B, S] -> (hidden states [B, S, H], aux loss scalar)."""
     b, s = input_ids.shape
     x = nn.embedding(params["embed"]["tok"], input_ids, dtype)
     pos = jnp.arange(s)[None, :]
@@ -87,10 +104,12 @@ def encode(params, input_ids, type_ids=None, attention_mask=None,
 
     layer_fn = _encoder_layer
     if remat:
-        layer_fn = jax.checkpoint(_encoder_layer, static_argnums=(3,))
+        layer_fn = jax.checkpoint(_encoder_layer, static_argnums=(3, 4))
+    aux = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
-        x = layer_fn(layer, x, mask, dtype)
-    return x
+        x, layer_aux = layer_fn(layer, x, mask, dtype, attn_impl)
+        aux = aux + layer_aux
+    return x, aux
 
 
 def mlm_logits(params, hidden, dtype=jnp.bfloat16):
@@ -100,12 +119,14 @@ def mlm_logits(params, hidden, dtype=jnp.bfloat16):
     return nn.dense(params["mlm"]["decoder"], y, dtype=jnp.float32)
 
 
-def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False):
+def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False,
+            attn_impl: str = "einsum", moe_aux_weight: float = 0.01):
     """Masked-LM loss. batch = {input_ids, labels, [type_ids, attention_mask,
     loss_mask]}; labels [B,S] with ignored positions marked by loss_mask=0."""
-    hidden = encode(
+    hidden, moe_aux = encode(
         params, batch["input_ids"], batch.get("type_ids"),
         batch.get("attention_mask"), dtype=dtype, remat=remat,
+        attn_impl=attn_impl,
     )
     logits = mlm_logits(params, hidden, dtype)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -116,10 +137,11 @@ def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False):
         mask = jnp.ones_like(labels, jnp.float32)
     mask = mask.astype(jnp.float32)
     loss = -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    loss = loss + moe_aux_weight * moe_aux
     acc = jnp.sum(
         (jnp.argmax(logits, -1) == labels).astype(jnp.float32) * mask
     ) / jnp.maximum(jnp.sum(mask), 1.0)
-    return loss, {"accuracy": acc}
+    return loss, {"accuracy": acc, "moe_aux": moe_aux}
 
 
 def synthetic_batch(key, batch_size: int, seq_len: int = 128,

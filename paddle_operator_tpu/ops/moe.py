@@ -1,0 +1,79 @@
+"""Mixture-of-Experts FFN with expert parallelism (`ep` mesh axis).
+
+Switch-style top-1 routing with capacity, expressed as dense einsum
+dispatch/combine — the GSPMD-friendly formulation: the expert axis `E` of
+both the dispatch tensors and the expert weights shards over `ep`, so XLA
+lowers routing to an all-to-all over ICI instead of per-expert gathers.
+
+Rules (see parallel.sharding.moe_rules): wi/wo shard P("ep", None, None).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import nn
+
+
+def moe_init(key, dim: int, mlp_dim: int, num_experts: int):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "router": {"kernel": nn.xavier_uniform(k1, (dim, num_experts))},
+        "wi": nn.normal_init(k2, (num_experts, dim, mlp_dim),
+                             stddev=(2.0 / dim) ** 0.5),
+        "wo": nn.normal_init(k3, (num_experts, mlp_dim, dim),
+                             stddev=(2.0 / mlp_dim) ** 0.5),
+    }
+
+
+def moe_apply(params, x, capacity_factor: float = 1.25, dtype=jnp.bfloat16):
+    """x: [B, S, D] -> ([B, S, D], aux_losses dict).
+
+    Top-1 (switch) routing; tokens over capacity are dropped (residual
+    connections carry them). Returns the load-balancing auxiliary loss.
+    """
+    b, s, d = x.shape
+    e = params["wi"].shape[0]
+    tokens = b * s
+    capacity = max(1, int(capacity_factor * tokens / e))
+
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32),
+        params["router"]["kernel"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)           # [B,S,E]
+    gate, choice = jnp.max(probs, -1), jnp.argmax(probs, -1)
+
+    # load-balancing loss (Switch Transformer): E * Σ_e fraction_e * prob_e
+    onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)     # [B,S,E]
+    fraction = jnp.mean(onehot, axis=(0, 1))
+    mean_prob = jnp.mean(probs, axis=(0, 1))
+    aux_loss = e * jnp.sum(fraction * mean_prob)
+
+    # capacity: position of each token within its expert's queue
+    flat_choice = choice.reshape(tokens)
+    flat_onehot = jax.nn.one_hot(flat_choice, e, dtype=jnp.int32)
+    position = jnp.cumsum(flat_onehot, axis=0) * flat_onehot - 1  # [T,E]
+    pos_in_expert = jnp.max(position, axis=-1)                    # [T]
+    keep = pos_in_expert < capacity
+
+    # dense dispatch tensor [T, E, C]
+    dispatch = (
+        jax.nn.one_hot(flat_choice, e, dtype=jnp.float32)[:, :, None]
+        * jax.nn.one_hot(
+            jnp.clip(pos_in_expert, 0, capacity - 1), capacity,
+            dtype=jnp.float32,
+        )[:, None, :]
+        * keep[:, None, None]
+    )
+
+    xf = x.reshape(tokens, d).astype(dtype)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xf)
+    h = jnp.einsum("ecd,edh->ech", expert_in, params["wi"].astype(dtype))
+    h = nn.gelu(h)
+    expert_out = jnp.einsum("ech,ehd->ecd", h, params["wo"].astype(dtype))
+
+    combine = dispatch * gate.reshape(tokens)[:, None, None]
+    out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+    return out.reshape(b, s, d), {"moe_aux_loss": aux_loss}

@@ -165,12 +165,16 @@ def mha_init(key, dim: int, num_heads: int):
     }
 
 
-def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16):
+def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
+        impl: str = "einsum"):
     """Multi-head self-attention, BSHD layout.
 
     The einsum formulation keeps the contraction dims explicit so GSPMD can
     shard heads over the `tp` mesh axis without resharding (heads axis is
     preserved end-to-end until the output projection).
+
+    impl: "einsum" (default), "flash" (Pallas fused blockwise kernel), or
+    "auto" (flash on TPU when the shape tiles and there is no mask).
     """
     def proj(p, x):
         return (
@@ -180,11 +184,30 @@ def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16):
 
     q, k, v = proj(params["q"], x), proj(params["k"], x), proj(params["v"], x)
     head_dim = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
-    if mask is not None:
-        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    use_flash = False
+    if impl in ("flash", "auto") and mask is None:
+        from . import attention_pallas
+
+        bhsd = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
+        use_flash = attention_pallas.supports(bhsd, dtype)
+        if impl == "auto":
+            use_flash = use_flash and jax.default_backend() == "tpu"
+
+    if use_flash:
+        from . import attention_pallas
+
+        interpret = jax.default_backend() == "cpu"
+        ctx = attention_pallas.flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), interpret=interpret,
+        ).transpose(0, 2, 1, 3)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
+        if mask is not None:
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     return (
         jnp.einsum("bqhd,hdo->bqo", ctx, params["o"]["kernel"].astype(dtype))
         + params["o"]["bias"].astype(dtype)

@@ -101,6 +101,13 @@ def bert_rules() -> Rules:
     ]
 
 
+def moe_rules() -> Rules:
+    """MoE: expert-parallel weights — expert axis over `ep`; router replicated."""
+    return [
+        (r"moe/w(i|o)$", P("ep", None, None)),
+    ]
+
+
 def resnet_rules() -> Rules:
     """ResNet: pure data parallel; convs are small enough to replicate.
     (fsdp axis, if present in the mesh, shards the classifier.)"""

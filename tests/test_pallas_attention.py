@@ -1,0 +1,69 @@
+"""Flash-attention Pallas kernel vs reference einsum (interpret mode on CPU)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_operator_tpu.ops import nn
+from paddle_operator_tpu.ops.attention_pallas import (
+    _reference_attention, flash_attention, supports,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def qkv(b=2, h=2, s=256, d=64, dtype=jnp.float32):
+    ks = jax.random.split(KEY, 3)
+    shape = (b, h, s, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+def test_flash_matches_reference_fwd():
+    q, k, v = qkv()
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _reference_attention(q, k, v, scale)
+    out = flash_attention(q, k, v, interpret=True)
+    assert jnp.allclose(out, ref, atol=2e-5)
+
+
+def test_flash_matches_reference_grads():
+    q, k, v = qkv(b=1, h=2, s=256, d=64)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, interpret=True).sum()
+
+    def loss_ref(q, k, v):
+        return _reference_attention(q, k, v, scale).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.allclose(a, b, atol=2e-5)
+
+
+def test_flash_nonuniform_kv_blocks():
+    # seq 384 = 3 x 128 KV tiles exercises the online-softmax correction
+    q, k, v = qkv(s=384)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _reference_attention(q, k, v, scale)
+    out = flash_attention(q, k, v, interpret=True)
+    assert jnp.allclose(out, ref, atol=2e-5)
+
+
+def test_supports_predicate():
+    assert supports((2, 4, 256, 64), jnp.bfloat16)
+    assert supports((2, 4, 512, 128), jnp.bfloat16)
+    assert not supports((2, 4, 100, 64), jnp.bfloat16)   # seq not tiled
+    assert not supports((2, 4, 128, 64), jnp.bfloat16)   # too short to pay off
+    assert not supports((2, 4, 256, 48), jnp.bfloat16)   # odd head_dim
+
+
+def test_mha_flash_impl_matches_einsum():
+    params = nn.mha_init(KEY, 128, 2)  # head_dim 64
+    x = jax.random.normal(KEY, (2, 256, 128), jnp.float32)
+    y_einsum = nn.mha(params, x, dtype=jnp.float32, impl="einsum")
+    y_flash = nn.mha(params, x, dtype=jnp.float32, impl="flash")
+    assert jnp.allclose(y_einsum, y_flash, atol=2e-4)
