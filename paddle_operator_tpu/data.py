@@ -37,6 +37,11 @@ def process_shard(batch, process_index: int, process_count: int):
 
     def slice_leaf(leaf):
         n = leaf.shape[0]
+        if n % process_count:
+            raise ValueError(
+                "global batch dim %d does not divide across %d processes"
+                % (n, process_count)
+            )
         per = n // process_count
         return leaf[process_index * per:(process_index + 1) * per]
 
@@ -64,12 +69,22 @@ class ShardedLoader:
     def _place(self, batch):
         import jax
 
-        batch = process_shard(batch, self._proc, self._nproc)
         if self._sharding is not None:
+            if self._nproc > 1:
+                # multi-host: each host holds only its rows; assemble the
+                # global array from the process-local shard so the result's
+                # global shape matches what the jitted step was traced with
+                local = process_shard(batch, self._proc, self._nproc)
+                return jax.tree_util.tree_map(
+                    lambda leaf, sh:
+                        jax.make_array_from_process_local_data(sh, leaf),
+                    local, self._sharding,
+                )
             return jax.tree_util.tree_map(
                 lambda leaf, sh: jax.device_put(leaf, sh),
                 batch, self._sharding,
             )
+        batch = process_shard(batch, self._proc, self._nproc)
         return jax.tree_util.tree_map(jax.device_put, batch)
 
     def _fill(self):
@@ -109,6 +124,10 @@ def numpy_file_source(paths, batch_size: int, shuffle_seed: Optional[int] = None
             with np.load(path) as npz:
                 arrays = {k: npz[k] for k in npz.files}
             n = min(a.shape[0] for a in arrays.values())
+            if n < batch_size:
+                raise ValueError(
+                    "shard %s has %d rows < batch_size %d" % (path, n, batch_size)
+                )
             idx = np.arange(n)
             if rng is not None:
                 rng.shuffle(idx)

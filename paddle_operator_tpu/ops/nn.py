@@ -173,8 +173,11 @@ def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
     shard heads over the `tp` mesh axis without resharding (heads axis is
     preserved end-to-end until the output projection).
 
-    impl: "einsum" (default), "flash" (Pallas fused blockwise kernel), or
-    "auto" (flash on TPU when the shape tiles and there is no mask).
+    impl: "einsum" (default), "flash" (Pallas fused blockwise kernel),
+    "auto" (flash on TPU when the shape tiles and there is no mask), or a
+    callable (q, k, v) -> ctx in BHSD layout — the hook the sequence-parallel
+    attentions plug into (e.g. ``partial(parallel.ring_attention, mesh=mesh)``);
+    the callable owns masking, so `mask` must be None.
     """
     def proj(p, x):
         return (
@@ -184,6 +187,14 @@ def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
 
     q, k, v = proj(params["q"], x), proj(params["k"], x), proj(params["v"], x)
     head_dim = q.shape[-1]
+
+    if callable(impl):
+        assert mask is None, "callable attention impls own their masking"
+        ctx = impl(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+        ).transpose(0, 2, 1, 3)
+        return _out_proj(params, ctx, dtype)
 
     use_flash = False
     if impl in ("flash", "auto") and mask is None:
@@ -208,6 +219,11 @@ def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
             scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return _out_proj(params, ctx, dtype)
+
+
+def _out_proj(params, ctx, dtype):
+    """MHA output projection: [B,S,H,D] context -> [B,S,dim]."""
     return (
         jnp.einsum("bqhd,hdo->bqo", ctx, params["o"]["kernel"].astype(dtype))
         + params["o"]["bias"].astype(dtype)

@@ -1,0 +1,197 @@
+"""Long-context sequence/context parallelism: ring attention and Ulysses.
+
+The reference operator has no sequence parallelism anywhere (SURVEY.md §5.7 —
+it would live inside the training runtime the operator launches). This module
+is that runtime piece, TPU-native: both strategies shard the *sequence* axis
+of attention across a mesh axis (conventionally ``sp``) so context length can
+scale with the number of chips.
+
+* :func:`ring_attention` — blockwise flash attention where each device holds
+  a sequence shard of Q/K/V and KV blocks rotate around the ``sp`` ring via
+  ``lax.ppermute`` (one ICI hop per step). Online-softmax accumulation keeps
+  memory at O(S·D/n) per device; total compute equals full attention. The
+  per-step block compute is wrapped in ``jax.checkpoint`` so the backward
+  pass rematerialises scores instead of storing n blocks of them.
+
+* :func:`ulysses_attention` — all-to-all sequence parallelism: two
+  ``lax.all_to_all`` collectives re-shard [seq-sharded, all heads] ->
+  [all seq, head-sharded], run dense local attention per head group, and
+  swap back. Cheaper collectives than the ring for moderate S (2 all-to-alls
+  vs n permutes) but requires heads % n == 0.
+
+Both take globally-shaped [B, H, S, D] arrays and handle the shard_map
+plumbing internally; both are reverse-mode differentiable (ppermute /
+all_to_all have transposes), so they drop into any loss under ``jax.grad``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_update(q, k, v, acc, m, l, q_pos, k_pos, scale, causal):
+    """One flash-attention accumulation step of local Q against one KV block.
+
+    q: [B,H,Sq,D]  k,v: [B,H,Sk,D]  acc: [B,H,Sq,D] f32
+    m, l: [B,H,Sq] f32 running max / denominator.
+    q_pos/k_pos: [Sq]/[Sk] global token positions for causal masking.
+    """
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # guard fully-masked rows: clamp m above -inf territory so the exps
+    # below underflow to 0.0 instead of producing inf - inf = nan
+    m_safe = jnp.maximum(m_new, NEG_INF / 2)
+    p = jnp.exp(scores - m_safe[..., None])               # [B,H,Sq,Sk]
+    correction = jnp.exp(m - m_safe)
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    )
+    return acc_new, m_safe, l_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention over the ``axis`` ring. BHSD layout.
+
+    S must divide by mesh.shape[axis]; each device computes its local Q
+    shard's attention over the full sequence as KV blocks rotate past.
+    """
+    n = mesh.shape[axis]
+    b, h, s, d = q.shape
+    assert s % n == 0, "seq len %d must divide ring size %d" % (s, n)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s_local = s // n
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    def run(ql, kl, vl):
+        my = lax.axis_index(axis)
+        q_pos = my * s_local + jnp.arange(s_local)
+        step_fn = jax.checkpoint(
+            functools.partial(_block_update, scale=scale, causal=causal)
+        )
+
+        def body(carry, r):
+            kb, vb, acc, m, l = carry
+            # after r hops each device holds the block born on (my - r) % n
+            src = (my - r) % n
+            k_pos = src * s_local + jnp.arange(s_local)
+            acc, m, l = step_fn(ql, kb, vb, acc, m, l, q_pos, k_pos)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            return (kb, vb, acc, m, l), None
+
+        # initial carries must be marked device-varying along sp (scan-vma)
+        acc0, m0, l0 = lax.pcast(
+            (
+                jnp.zeros(ql.shape, jnp.float32),
+                jnp.full(ql.shape[:-1], NEG_INF, jnp.float32),
+                jnp.zeros(ql.shape[:-1], jnp.float32),
+            ),
+            (axis,), to="varying",
+        )
+        (_, _, acc, m, l), _ = lax.scan(
+            body, (kl, vl, acc0, m0, l0), jnp.arange(n)
+        )
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(ql.dtype)
+
+    return run(q, k, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism (Ulysses). BHSD layout.
+
+    Re-shards [B, H, S/n, D] -> [B, H/n, S, D] with one all_to_all, runs
+    dense local attention over the full sequence for H/n heads, then swaps
+    back. Requires H % n == 0 and S % n == 0.
+    """
+    n = mesh.shape[axis]
+    b, h, s, d = q.shape
+    assert h % n == 0, "heads %d must divide sp size %d" % (h, n)
+    assert s % n == 0, "seq %d must divide sp size %d" % (s, n)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    def run(ql, kl, vl):
+        def to_heads(x):     # [B, H, S/n, D] -> [B, H/n, S, D]
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        def to_seq(x):       # [B, H/n, S, D] -> [B, H, S/n, D]
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qh, kh, vh = to_heads(ql), to_heads(kl), to_heads(vl)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)
+        ) * scale
+        if causal:
+            pos = jnp.arange(s)
+            scores = jnp.where(
+                (pos[:, None] >= pos[None, :])[None, None], scores, NEG_INF
+            )
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh.astype(jnp.float32))
+        return to_seq(out.astype(ql.dtype))
+
+    return run(q, k, v)
+
+
+def reference_attention(q, k, v, causal=False, scale=None):
+    """Dense single-device attention, fp32 softmax — the numeric oracle."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        s = q.shape[2]
+        pos = jnp.arange(s)
+        scores = jnp.where(
+            (pos[:, None] >= pos[None, :])[None, None], scores, NEG_INF
+        )
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(
+        q.dtype
+    )

@@ -1,0 +1,50 @@
+"""BERT encoder with sequence-parallel attention impls plugged into nn.mha."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_operator_tpu.models import bert
+from paddle_operator_tpu.parallel import (
+    make_mesh, ring_attention, ulysses_attention,
+)
+
+
+def test_bert_ring_matches_einsum():
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    cfg = dict(bert.TINY_CONFIG)
+    params = bert.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg["vocab_size"])
+
+    want, _ = bert.encode(params, ids, dtype=jnp.float32)
+    got, _ = bert.encode(
+        params, ids, dtype=jnp.float32,
+        attn_impl=partial(ring_attention, mesh=mesh, axis="sp"),
+    )
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_bert_ulysses_trains():
+    """Full loss+grads through Ulysses attention, jitted over dp x sp."""
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    cfg = dict(bert.TINY_CONFIG)
+    params = bert.init(jax.random.PRNGKey(0), cfg)
+    batch = bert.synthetic_batch(
+        jax.random.PRNGKey(1), batch_size=2, seq_len=64,
+        vocab_size=cfg["vocab_size"],
+    )
+    batch.pop("attention_mask")
+    attn = partial(ulysses_attention, mesh=mesh, axis="sp")
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            return bert.loss_fn(p, batch, attn_impl=attn)[0]
+        return jax.value_and_grad(loss)(params)
+
+    val, grads = step(params)
+    assert jnp.isfinite(val)
+    flat, _ = jax.tree_util.tree_flatten(grads)
+    assert all(jnp.all(jnp.isfinite(g)) for g in flat)

@@ -67,3 +67,17 @@ def test_numpy_file_source_shuffles(tmp_path):
     a, b = next(src1)["x"], next(src2)["x"]
     assert not np.array_equal(a, b)
     assert np.array_equal(np.sort(a), np.sort(b))
+
+
+def test_process_shard_rejects_indivisible_batch():
+    batch = {"x": np.arange(6).reshape(6, 1)}
+    with pytest.raises(ValueError, match="does not divide"):
+        process_shard(batch, process_index=0, process_count=4)
+
+
+def test_numpy_file_source_rejects_undersized_shard(tmp_path):
+    path = tmp_path / "tiny.npz"
+    np.savez(path, x=np.arange(3))
+    src = numpy_file_source([str(path)], batch_size=8)
+    with pytest.raises(ValueError, match="rows < batch_size"):
+        next(src)
