@@ -27,6 +27,10 @@ SHORT_NAME = "tj"
 LABEL_RES_NAME = "tpujob-res-name"
 LABEL_RES_TYPE = "tpujob-res-type"
 ANNOT_RESOURCE = "tpujob-resource"
+# multislice placement labels: pods of one logical slice must land on one
+# physical slice (node pool) and pods of different slices must not share one
+LABEL_JOB_NAME = "tpujob-name"
+LABEL_SLICE_ID = "tpujob-slice-id"
 
 # role names (reference: paddlejob_types.go:37-41)
 RES_PS = "ps"
@@ -218,14 +222,29 @@ class TpuJob:
         accel = tpu.get("accelerator", "v5e")
         return TPU_CHIPS_PER_HOST.get(accel, 8)
 
-    def tpu_hosts(self) -> int:
-        """Number of TPU-VM hosts covering the slice topology."""
+    def tpu_num_slices(self) -> int:
+        """Multislice: number of TPU slices the job spans (DCN-connected).
+
+        ``spec.tpu.numSlices > 1`` turns the job into a GKE multislice job:
+        each slice is its own ICI domain; slices communicate over DCN via the
+        MEGASCALE_* env the operator injects. New capability relative to the
+        reference (which has no TPU notion at all).
+        """
+        return max(1, int(self.tpu.get("numSlices", 1)))
+
+    def tpu_hosts_per_slice(self) -> int:
+        """Number of TPU-VM hosts covering ONE slice's topology."""
         tpu = self.tpu
         if "topology" in tpu:
             chips = topology_chips(tpu["topology"])
             return max(1, chips // self.tpu_chips_per_host())
         worker = self.spec.get(RES_WORKER)
-        return worker["replicas"] if worker else 1
+        replicas = worker["replicas"] if worker else 1
+        return max(1, replicas // self.tpu_num_slices())
+
+    def tpu_hosts(self) -> int:
+        """Total worker pods: hosts-per-slice × numSlices."""
+        return self.tpu_hosts_per_slice() * self.tpu_num_slices()
 
     def validate(self) -> List[str]:
         """Return a list of human-readable spec problems (empty = valid)."""
@@ -247,17 +266,39 @@ class TpuJob:
             if self.intranet == Intranet.HOST:
                 errs.append("intranet=Host is not supported for device=tpu")
             tpu = self.tpu
+            if int(tpu.get("numSlices", 1)) < 1:
+                errs.append("spec.tpu.numSlices must be >= 1")
+            if self.tpu_num_slices() > 1 and self.elastic is not None:
+                # Elastic pods bypass the ConfigMap barrier (env is per-pod),
+                # so the global MEGASCALE/DCN coordinator never reaches them
+                # and each slice would rendezvous into its own split world.
+                # TPU elasticity is whole-slice restart anyway (SURVEY.md §7).
+                errs.append(
+                    "spec.elastic cannot be combined with spec.tpu.numSlices "
+                    "> 1: multislice rendezvous needs the global coordinator "
+                    "barrier, which elastic per-pod env bypasses"
+                )
             if tpu.get("topology"):
                 hosts = self.tpu_hosts()
                 worker = self.spec.get(RES_WORKER) or {}
                 if worker and worker.get("replicas") not in (None, hosts):
                     errs.append(
-                        "spec.worker.replicas (%s) must equal hosts in slice "
-                        "topology %s (%d hosts x %d chips); a TPU slice is "
-                        "all-or-nothing" % (
-                            worker.get("replicas"), tpu["topology"], hosts,
+                        "spec.worker.replicas (%s) must equal total hosts "
+                        "(%d slices x %d hosts of topology %s, %d chips/host); "
+                        "a TPU slice is all-or-nothing" % (
+                            worker.get("replicas"), self.tpu_num_slices(),
+                            self.tpu_hosts_per_slice(), tpu["topology"],
                             self.tpu_chips_per_host(),
                         )
+                    )
+            elif self.tpu_num_slices() > 1:
+                worker = self.spec.get(RES_WORKER) or {}
+                replicas = worker.get("replicas")
+                if replicas is not None and replicas % self.tpu_num_slices():
+                    errs.append(
+                        "spec.worker.replicas (%s) must be a multiple of "
+                        "spec.tpu.numSlices (%d)"
+                        % (replicas, self.tpu_num_slices())
                     )
             if tpu.get("accelerator") and tpu["accelerator"] not in TPU_CHIPS_PER_HOST:
                 errs.append(

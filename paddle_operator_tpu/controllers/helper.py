@@ -49,6 +49,13 @@ TPU_RESOURCE = "google.com/tpu"
 GKE_TPU_ACCEL_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
 
+# Multislice: slices talk over DCN; the MEGASCALE runtime rendezvous via the
+# coordinator on this port (slice 0, host 0).
+MEGASCALE_PORT = 8080
+# One GKE node pool == one physical TPU slice; used as the affinity topology
+# domain so logical slices map 1:1 onto physical slices.
+GKE_NODEPOOL_TOPOLOGY = "cloud.google.com/gke-nodepool"
+
 
 # ---------------------------------------------------------------------------
 # naming (reference: paddlejob_helper.go:201-213)
@@ -266,6 +273,15 @@ def construct_configmap(job: api.TpuJob, child_pods: List[dict]) -> Optional[dic
         data["TPU_WORKER_HOSTNAMES"] = hosts
         data["TPUJOB_NUM_WORKERS"] = str(specs[api.RES_WORKER]["replicas"])
         data["TPUJOB_COORDINATOR"] = resources[api.RES_WORKER][0]
+        if job.tpu_num_slices() > 1:
+            # Multislice: the MEGASCALE (DCN) coordinator is slice 0 host 0.
+            # Slice-scoped env (slice id, slice count, per-slice hostnames)
+            # is injected per-pod at construct time; only the job-global
+            # coordinator address needs the barrier (it is an IP).
+            coord_host = resources[api.RES_WORKER][0].split(":")[0]
+            data["MEGASCALE_COORDINATOR_ADDRESS"] = "%s:%d" % (
+                coord_host, MEGASCALE_PORT
+            )
 
     cm["data"] = data
     return cm
@@ -372,10 +388,74 @@ def _tpu_ify_pod(job: api.TpuJob, pod: dict, res_type: str, idx: int) -> None:
         if tpu.get("topology"):
             sel.setdefault(GKE_TPU_TOPOLOGY_SELECTOR, tpu["topology"])
 
-        env.append({"name": "TPU_WORKER_ID", "value": str(idx)})
-        env.append({"name": "TPUJOB_WORKER_ID", "value": str(idx)})
+        n_slices = job.tpu_num_slices()
+        if n_slices > 1:
+            # Multislice: TPU_WORKER_ID / TPU_WORKER_HOSTNAMES are scoped to
+            # ONE slice (its ICI domain); the TPU runtime rejects hostnames
+            # outside the slice. Slice-local hostnames are the deterministic
+            # pod DNS names (hostname==subdomain==pod name), so they are
+            # known at construct time — no barrier needed for them.
+            per_slice = job.tpu_hosts_per_slice()
+            slice_id, local_id = divmod(idx, per_slice)
+            slice_hosts = ",".join(
+                gen_res_name(job.name, res_type, slice_id * per_slice + i)
+                for i in range(per_slice)
+            )
+            env.append({"name": "TPU_WORKER_ID", "value": str(local_id)})
+            env.append({"name": "TPU_WORKER_HOSTNAMES", "value": slice_hosts})
+            env.append({"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)})
+            env.append({"name": "MEGASCALE_NUM_SLICES", "value": str(n_slices)})
+            # global rank for jax.distributed (coordinator = slice0/host0)
+            env.append({"name": "TPUJOB_WORKER_ID", "value": str(idx)})
+            _add_slice_placement(job, pod, slice_id)
+        else:
+            env.append({"name": "TPU_WORKER_ID", "value": str(idx)})
+            env.append({"name": "TPUJOB_WORKER_ID", "value": str(idx)})
         # TPU_WORKER_HOSTNAMES / TPUJOB_COORDINATOR arrive via the ConfigMap
-        # barrier (non-elastic) or the membership store (elastic).
+        # barrier (non-elastic, single-slice) or the membership store (elastic).
+
+
+def _add_slice_placement(job: api.TpuJob, pod: dict, slice_id: int) -> None:
+    """Pin each logical slice onto exactly one physical slice.
+
+    The nodeSelector alone matches EVERY node pool of the right accelerator/
+    topology, so the scheduler could mix two logical slices' pods onto one
+    physical slice — duplicate slice-local TPU_WORKER_IDs, runtime init
+    failure. Same exclusive-placement recipe as GKE JobSet multislice:
+    pods of one slice require each other (co-location) and repel other
+    slices' pods, with the node pool (== one physical slice) as the
+    topology domain.
+    """
+    labels = pod["metadata"].setdefault("labels", {})
+    labels[api.LABEL_JOB_NAME] = job.name
+    labels[api.LABEL_SLICE_ID] = str(slice_id)
+
+    def term(operator: str) -> dict:
+        return {
+            "labelSelector": {"matchExpressions": [
+                {"key": api.LABEL_JOB_NAME, "operator": "In",
+                 "values": [job.name]},
+                {"key": api.LABEL_SLICE_ID, "operator": operator,
+                 "values": [str(slice_id)]},
+            ]},
+            "topologyKey": GKE_NODEPOOL_TOPOLOGY,
+        }
+
+    aff = pod["spec"].setdefault("affinity", {})
+    aff.setdefault("podAffinity", {}).setdefault(
+        "requiredDuringSchedulingIgnoredDuringExecution", []
+    ).append(term("In"))
+    aff.setdefault("podAntiAffinity", {}).setdefault(
+        "requiredDuringSchedulingIgnoredDuringExecution", []
+    ).append(term("NotIn"))
+
+
+def needs_pod_dns(job: api.TpuJob) -> bool:
+    """True when pods must be reachable by stable DNS name: Service intranet,
+    or multislice TPU (slice-local TPU_WORKER_HOSTNAMES are pod DNS names)."""
+    return job.intranet == api.Intranet.SERVICE or (
+        job.device == api.Device.TPU and job.tpu_num_slices() > 1
+    )
 
 
 def construct_service_for_pod(pod: dict, device: str = api.Device.CPU) -> dict:

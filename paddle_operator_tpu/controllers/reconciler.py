@@ -112,8 +112,11 @@ class TpuJobReconciler:
                 return Result(requeue=True)
 
         # -- per-pod headless services (reference :170-191) -------------
+        # Multislice always gets them: the slice-local TPU_WORKER_HOSTNAMES
+        # injected per pod are pod DNS names, which only resolve when a
+        # headless Service matches the pod's hostname/subdomain.
         svcs: List[dict] = []
-        if job.intranet == api.Intranet.SERVICE:
+        if helper.needs_pod_dns(job):
             svcs = self.client.list_owned("Service", job.obj)
             have = {s["metadata"]["name"] for s in svcs}
             for pod in child_pods:

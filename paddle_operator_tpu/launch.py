@@ -26,9 +26,11 @@ from .elastic.sync import epoch_key, np_key
 
 @dataclass
 class LaunchConfig:
-    worker_id: int = 0
-    num_workers: int = 1
-    coordinator: str = ""          # host:port of worker-0
+    worker_id: int = 0             # GLOBAL rank across all slices
+    num_workers: int = 1           # total hosts across all slices
+    coordinator: str = ""          # host:port of slice-0 worker-0
+    slice_id: int = 0              # multislice: which ICI domain this host is in
+    num_slices: int = 1            # multislice: DCN-connected slice count
     hostnames: List[str] = field(default_factory=list)
     role: str = "TRAINER"
     job_id: str = ""
@@ -75,11 +77,16 @@ def detect_env(environ: Optional[dict] = None) -> LaunchConfig:
             port = _env("PADDLE_PORT", default="2379")
             coordinator = "%s:%s" % (hostnames[0], port)
 
+        # Multislice: TPU_WORKER_ID is slice-local (the TPU runtime's view);
+        # TPUJOB_WORKER_ID is the global rank jax.distributed needs.
+        num_slices = int(_env("MEGASCALE_NUM_SLICES", default="1"))
         return LaunchConfig(
-            worker_id=int(_env("TPU_WORKER_ID", "TPUJOB_WORKER_ID",
+            worker_id=int(_env("TPUJOB_WORKER_ID", "TPU_WORKER_ID",
                                "PADDLE_TRAINER_ID", default="0")),
             num_workers=num_workers,
             coordinator=coordinator,
+            slice_id=int(_env("MEGASCALE_SLICE_ID", default="0")),
+            num_slices=num_slices,
             hostnames=hostnames,
             role=_env("TRAINING_ROLE", default="TRAINER"),
             job_id=_env("PADDLE_ELASTIC_JOB_ID", "TPUJOB_JOB_ID"),

@@ -8,7 +8,7 @@ tensor parallel = hidden dims on `tp`; sequence parallel = activation
 constraints on `sp`.
 """
 
-from .mesh import make_mesh, mesh_from_env  # noqa: F401
+from .mesh import make_hybrid_mesh, make_mesh, mesh_from_env  # noqa: F401
 from .sharding import (  # noqa: F401
     shard_tree, named, P, bert_rules, resnet_rules, ctr_rules, moe_rules,
 )

@@ -41,13 +41,84 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
     return Mesh(arr, tuple(axes.keys()))
 
 
+def make_hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+    devices=None,
+) -> Mesh:
+    """Multislice mesh: ``dcn_axes`` span slices (DCN), ``ici_axes`` span one
+    slice's chips (ICI). An axis named in both is the product (e.g. ici
+    ``dp=4`` + dcn ``dp=2`` → a size-8 ``dp`` axis whose outer stride
+    crosses slices).
+
+    Layout rule from the scaling playbook: only weak-contention collectives
+    (data-parallel gradient allreduce, pipeline edges) should cross DCN —
+    dcn-only axes come outermost, and dcn extent is the slow (outer) stride
+    of any shared axis — so tp/sp collectives stay inside a slice on ICI.
+
+    ``mesh_utils.create_hybrid_device_mesh`` wants per-axis shapes of EQUAL
+    length (each mesh dim = ici_size * dcn_size for that axis) and groups
+    devices by ``device.slice_index``. Devices without slice metadata (CPU
+    test meshes) get an in-order fallback with identical axis semantics:
+    device order is slice-major, so granule g of axis layout matches.
+    """
+    dcn_axes = dict(dcn_axes)
+    ici_axes = dict(ici_axes)
+    devices = list(devices if devices is not None else jax.devices())
+
+    # unified axis order: dcn-only axes outermost, then ici axes in order
+    names = [a for a in dcn_axes if a not in ici_axes] + list(ici_axes)
+    ici_shape = tuple(ici_axes.get(a, 1) for a in names)
+    dcn_shape = tuple(dcn_axes.get(a, 1) for a in names)
+
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=ici_shape,
+            dcn_mesh_shape=dcn_shape,
+            devices=devices,
+        )
+        return Mesh(arr, tuple(names))
+
+    # fallback: devices are in slice-major order; lay out the dcn extent as
+    # the outer stride of each axis: reshape to (*dcn, *ici), interleave each
+    # axis's (dcn_i, ici_i) pair, merge into dcn_i*ici_i.
+    n = math.prod(ici_shape) * math.prod(dcn_shape)
+    if n != len(devices):
+        raise ValueError(
+            "hybrid mesh ici=%s x dcn=%s covers %d devices but %d available"
+            % (ici_axes, dcn_axes, n, len(devices))
+        )
+    k = len(names)
+    arr = np.array(devices).reshape(*dcn_shape, *ici_shape)
+    arr = arr.transpose(*(i // 2 + (k if i % 2 else 0) for i in range(2 * k)))
+    arr = arr.reshape(*(d * i for d, i in zip(dcn_shape, ici_shape)))
+    return Mesh(arr, tuple(names))
+
+
 def mesh_from_env(devices=None) -> Mesh:
-    """Mesh shape from TPUJOB_MESH env, e.g. 'dp=8,tp=4' (launcher-injected)."""
-    spec = os.environ.get("TPUJOB_MESH", "")
-    if not spec:
+    """Mesh shape from env (launcher-injected):
+
+    * ``TPUJOB_MESH`` — ICI axes, e.g. ``dp=8,tp=4``.
+    * ``TPUJOB_DCN_MESH`` — multislice DCN axes, e.g. ``dp=2`` (outermost).
+    """
+    def parse(s: str) -> Dict[str, int]:
+        axes: Dict[str, int] = {}
+        for part in s.split(","):
+            if part.strip():
+                name, _, size = part.partition("=")
+                axes[name.strip()] = int(size)
+        return axes
+
+    axes = parse(os.environ.get("TPUJOB_MESH", ""))
+    dcn = parse(os.environ.get("TPUJOB_DCN_MESH", ""))
+    if dcn:
+        if not axes:
+            # default ICI layout: pure data parallel within each slice
+            n = len(devices if devices is not None else jax.devices())
+            axes = {"dp": n // math.prod(dcn.values())}
+        return make_hybrid_mesh(axes, dcn, devices)
+    if not axes:
         return make_mesh(devices=devices)
-    axes = {}
-    for part in spec.split(","):
-        name, _, size = part.partition("=")
-        axes[name.strip()] = int(size)
     return make_mesh(axes, devices)

@@ -376,7 +376,7 @@ def test_validate_topology_host_mismatch():
         "worker": role_spec(3),  # should be 4 hosts
     })
     errs = job.validate()
-    assert any("must equal hosts" in e for e in errs)
+    assert any("must equal total hosts" in e for e in errs)
 
 
 def test_validate_tpu_rejects_host_network():

@@ -1,0 +1,233 @@
+"""Multislice (MEGASCALE) support: CRD validation, env wiring, hybrid mesh.
+
+New capability relative to the reference (which has no TPU notion): a job
+spanning several DCN-connected TPU slices. Each slice is one ICI domain —
+TPU_WORKER_ID/TPU_WORKER_HOSTNAMES are slice-local, MEGASCALE_* carries the
+cross-slice topology, and the data plane builds a dcn×ici hybrid mesh.
+"""
+
+import jax
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import helper
+from paddle_operator_tpu.launch import detect_env
+from paddle_operator_tpu.parallel import make_hybrid_mesh, mesh_from_env
+
+from test_helper import make_job, role_spec
+
+
+def multislice_job(n_slices=2, hosts_per_slice=2, name="ms"):
+    # v5e 4x4 topology = 16 chips = 2 hosts of 8 chips
+    topo = {2: "4x4", 4: "4x8"}[hosts_per_slice]
+    return make_job({
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": topo, "numSlices": n_slices},
+        "worker": role_spec(n_slices * hosts_per_slice),
+    }, name=name)
+
+
+def env_of(pod):
+    return {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+
+
+# ---------------------------------------------------------------------------
+# CRD accessors + validation
+# ---------------------------------------------------------------------------
+
+def test_hosts_accounting():
+    job = multislice_job(n_slices=3, hosts_per_slice=2)
+    assert job.tpu_num_slices() == 3
+    assert job.tpu_hosts_per_slice() == 2
+    assert job.tpu_hosts() == 6
+
+
+def test_validate_replicas_must_cover_all_slices():
+    job = multislice_job(n_slices=2, hosts_per_slice=2)
+    assert job.validate() == []
+    job.spec["worker"]["replicas"] = 2  # only one slice's worth
+    errs = job.validate()
+    assert any("2 slices" in e for e in errs)
+
+
+def test_validate_num_slices_positive():
+    job = multislice_job()
+    job.spec["tpu"]["numSlices"] = 0
+    assert any("numSlices" in e for e in job.validate())
+
+
+def test_validate_rejects_elastic_multislice():
+    job = multislice_job(n_slices=2, hosts_per_slice=2)
+    job.spec["elastic"] = 1
+    assert any("elastic" in e for e in job.validate())
+
+
+def test_slice_placement_affinity():
+    job = multislice_job(n_slices=2, hosts_per_slice=2)
+    pod = helper.construct_pod(job, api.RES_WORKER, 2)  # slice 1
+    labels = pod["metadata"]["labels"]
+    assert labels[api.LABEL_SLICE_ID] == "1"
+    assert labels[api.LABEL_JOB_NAME] == "ms"
+    aff = pod["spec"]["affinity"]
+    require = aff["podAffinity"]["requiredDuringSchedulingIgnoredDuringExecution"]
+    repel = aff["podAntiAffinity"]["requiredDuringSchedulingIgnoredDuringExecution"]
+    assert require[0]["topologyKey"] == helper.GKE_NODEPOOL_TOPOLOGY
+    ops = {e["key"]: e["operator"] for e in
+           repel[0]["labelSelector"]["matchExpressions"]}
+    assert ops[api.LABEL_SLICE_ID] == "NotIn"
+    # single-slice pods carry no slice affinity
+    job1 = make_job({
+        "device": "tpu", "tpu": {"topology": "4x4"}, "worker": role_spec(2),
+    })
+    pod1 = helper.construct_pod(job1, api.RES_WORKER, 0)
+    assert "affinity" not in pod1["spec"]
+
+
+def test_validate_no_topology_requires_divisible_replicas():
+    job = make_job({
+        "device": "tpu",
+        "tpu": {"numSlices": 2},
+        "worker": role_spec(3),
+    })
+    assert any("multiple" in e for e in job.validate())
+
+
+# ---------------------------------------------------------------------------
+# pod env: slice-local worker id + hostnames, global rank
+# ---------------------------------------------------------------------------
+
+def test_pod_env_slice_local():
+    job = multislice_job(n_slices=2, hosts_per_slice=2)
+    # pod 3 = slice 1, local host 1
+    pod = helper.construct_pod(job, api.RES_WORKER, 3)
+    env = env_of(pod)
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["TPUJOB_WORKER_ID"] == "3"
+    # slice-local hostnames: pods 2 and 3 only
+    assert env["TPU_WORKER_HOSTNAMES"] == "ms-worker-2,ms-worker-3"
+
+
+def test_pod_env_single_slice_unchanged():
+    job = make_job({
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": "4x4"},
+        "worker": role_spec(2),
+    })
+    pod = helper.construct_pod(job, api.RES_WORKER, 1)
+    env = env_of(pod)
+    assert env["TPU_WORKER_ID"] == "1"
+    assert "MEGASCALE_SLICE_ID" not in env
+    assert "TPU_WORKER_HOSTNAMES" not in env  # arrives via ConfigMap barrier
+
+
+def test_configmap_megascale_coordinator():
+    job = multislice_job(n_slices=2, hosts_per_slice=2)
+    pods = []
+    for i in range(4):
+        pod = helper.construct_pod(job, api.RES_WORKER, i)
+        pod["status"] = {"podIP": "10.0.0.%d" % (i + 1)}
+        pods.append(pod)
+    cm = helper.construct_configmap(job, pods)
+    assert cm["data"]["MEGASCALE_COORDINATOR_ADDRESS"] == "10.0.0.1:%d" % (
+        helper.MEGASCALE_PORT
+    )
+    assert cm["data"]["TPUJOB_NUM_WORKERS"] == "4"
+    # slice count lives in per-pod env only (single source of truth)
+    assert "MEGASCALE_NUM_SLICES" not in cm["data"]
+
+
+def test_podgroup_covers_all_slices():
+    job = multislice_job(n_slices=2, hosts_per_slice=2)
+    pg = helper.construct_podgroup(job)
+    assert pg["spec"]["minMember"] == 4
+    # 8 chips/host x 4 hosts
+    assert pg["spec"]["minResources"][helper.TPU_RESOURCE] == "32"
+
+
+# ---------------------------------------------------------------------------
+# launcher: global rank wins over slice-local id
+# ---------------------------------------------------------------------------
+
+def test_detect_env_multislice():
+    cfg = detect_env({
+        "TPU_WORKER_ID": "1",
+        "TPUJOB_WORKER_ID": "3",
+        "MEGASCALE_SLICE_ID": "1",
+        "MEGASCALE_NUM_SLICES": "2",
+        "TPUJOB_NUM_WORKERS": "4",
+        "TPU_WORKER_HOSTNAMES": "ms-worker-2,ms-worker-3",
+        "TPUJOB_COORDINATOR": "10.0.0.1:2379",
+    })
+    assert cfg.worker_id == 3           # global rank for jax.distributed
+    assert cfg.slice_id == 1
+    assert cfg.num_slices == 2
+    assert cfg.num_workers == 4         # total across slices
+    assert cfg.coordinator == "10.0.0.1:2379"
+
+
+# ---------------------------------------------------------------------------
+# data plane: hybrid dcn x ici mesh
+# ---------------------------------------------------------------------------
+
+def test_hybrid_mesh_axis_order():
+    mesh = make_hybrid_mesh({"tp": 2, "sp": 2}, {"dp": 2})
+    # dcn axes outermost, ici axes innermost
+    assert tuple(mesh.axis_names) == ("dp", "tp", "sp")
+    assert dict(mesh.shape) == {"dp": 2, "tp": 2, "sp": 2}
+
+
+def test_hybrid_mesh_runs_collective():
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_hybrid_mesh({"tp": 4}, {"dp": 2})
+    x = jnp.arange(8.0)
+    y = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    assert float(jnp.sum(y)) == 28.0
+
+
+def test_hybrid_mesh_shared_axis_dcn_outer_stride():
+    # dp appears in both: ici extent 2 (fast) x dcn extent 2 (slow) = size 4.
+    mesh = make_hybrid_mesh({"dp": 2, "tp": 2}, {"dp": 2})
+    assert tuple(mesh.axis_names) == ("dp", "tp")
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+    # outer stride of dp crosses "slices": with in-order devices 0..7 and
+    # slice-major order, dp index 0,1 stay in slice 0 (devices 0..3).
+    ids = [[d.id for d in row] for row in mesh.devices]
+    assert ids == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+
+def test_hybrid_mesh_wrong_device_count():
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"tp": 4}, {"dp": 4})  # 16 > 8 devices
+
+
+def test_multislice_reconcile_creates_services():
+    # PodIP-intranet multislice job must still get per-pod headless Services,
+    # or the slice-local TPU_WORKER_HOSTNAMES (pod DNS names) never resolve.
+    from paddle_operator_tpu.testing import OperatorHarness
+
+    h = OperatorHarness()
+    job = multislice_job(n_slices=2, hosts_per_slice=2, name="msvc")
+    h.create_job(job.obj)
+    h.converge()
+    names = {s["metadata"]["name"] for s in h.services()}
+    assert {"msvc-worker-%d" % i for i in range(4)} <= names
+
+
+def test_mesh_from_env_dcn_only(monkeypatch):
+    monkeypatch.delenv("TPUJOB_MESH", raising=False)
+    monkeypatch.setenv("TPUJOB_DCN_MESH", "dp=2")
+    mesh = mesh_from_env()
+    # default ICI layout: remaining devices on dp inside each slice
+    assert dict(mesh.shape) == {"dp": 8}
+
+
+def test_mesh_from_env_dcn(monkeypatch):
+    monkeypatch.setenv("TPUJOB_MESH", "dp=2,tp=2")
+    monkeypatch.setenv("TPUJOB_DCN_MESH", "pp=2")
+    mesh = mesh_from_env()
+    assert tuple(mesh.axis_names) == ("pp", "dp", "tp")
+    assert dict(mesh.shape) == {"pp": 2, "dp": 2, "tp": 2}
