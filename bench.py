@@ -6,6 +6,12 @@ vs_baseline is reported against a nominal target recorded here.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Dispatch discipline: on TPU pods the host<->device hop can be high-latency,
+so everything here is a handful of jitted calls — params+batch+opt state are
+materialized by single compiled programs, and the timed loop only blocks once
+at the end. A persistent compilation cache makes repeat runs skip the big
+ResNet-50 fwd+bwd compile.
 """
 
 import json
@@ -15,8 +21,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
 import jax
 import jax.numpy as jnp
+from functools import partial
 
 from paddle_operator_tpu.models import resnet
 from paddle_operator_tpu.ops import optim
@@ -29,17 +38,26 @@ NOMINAL_TARGET_IMAGES_PER_SEC = 800.0
 
 BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
-WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 
 
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
 def main():
-    key = jax.random.PRNGKey(0)
     n_dev = len(jax.devices())
+    _log("bench: %d device(s), backend=%s" % (n_dev, jax.default_backend()))
     mesh = make_mesh({"dp": n_dev}) if n_dev > 1 else None
 
-    params = resnet.init(key, depth=50, num_classes=1000)
-    batch = resnet.synthetic_batch(key, BATCH, image_size=IMAGE)
+    # One compiled program builds params + synthetic batch on-device.
+    t0 = time.perf_counter()
+    make = jax.jit(partial(_make, BATCH, IMAGE))
+    params, batch = make(jax.random.PRNGKey(0))
+    jax.block_until_ready(params["head"]["fc"]["kernel"])
+    _log("bench: init in %.1fs" % (time.perf_counter() - t0))
+
     opt = optim.sgd(
         optim.cosine_schedule(0.1, 1000, 50), momentum=0.9,
         weight_decay=1e-4, wd_mask=optim.make_wd_mask(params),
@@ -49,9 +67,12 @@ def main():
         mesh=mesh, rules=resnet_rules(), merge_stats=resnet.merge_stats,
     )
 
+    t0 = time.perf_counter()
     for _ in range(WARMUP):
         state, metrics = step(state, batch)
     jax.block_until_ready(metrics["loss"])
+    _log("bench: warmup (%d steps incl. compile) in %.1fs"
+         % (WARMUP, time.perf_counter() - t0))
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
@@ -66,6 +87,13 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / NOMINAL_TARGET_IMAGES_PER_SEC, 4),
     }))
+
+
+def _make(batch_size, image_size, key):
+    kp, kb = jax.random.split(key)
+    params = resnet.init(kp, depth=50, num_classes=1000)
+    batch = resnet.synthetic_batch(kb, batch_size, image_size=image_size)
+    return params, batch
 
 
 if __name__ == "__main__":

@@ -445,9 +445,21 @@ def _add_slice_placement(job: api.TpuJob, pod: dict, slice_id: int) -> None:
     aff.setdefault("podAffinity", {}).setdefault(
         "requiredDuringSchedulingIgnoredDuringExecution", []
     ).append(term("In"))
-    aff.setdefault("podAntiAffinity", {}).setdefault(
+    anti = aff.setdefault("podAntiAffinity", {}).setdefault(
         "requiredDuringSchedulingIgnoredDuringExecution", []
-    ).append(term("NotIn"))
+    )
+    anti.append(term("NotIn"))
+    # Also repel OTHER jobs' slice pods: without this, two multislice jobs
+    # could each claim half the nodes of one physical slice (both their
+    # slice-local worlds then span a partial slice and TPU init hangs).
+    anti.append({
+        "labelSelector": {"matchExpressions": [
+            {"key": api.LABEL_JOB_NAME, "operator": "Exists"},
+            {"key": api.LABEL_JOB_NAME, "operator": "NotIn",
+             "values": [job.name]},
+        ]},
+        "topologyKey": GKE_NODEPOOL_TOPOLOGY,
+    })
 
 
 def needs_pod_dns(job: api.TpuJob) -> bool:

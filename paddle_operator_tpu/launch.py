@@ -68,24 +68,44 @@ def detect_env(environ: Optional[dict] = None) -> LaunchConfig:
             eps = _env("PADDLE_TRAINER_ENDPOINTS")
             hostnames = [e.split(":")[0] for e in eps.split(",") if e]
 
-        num_workers = int(
-            _env("TPUJOB_NUM_WORKERS", "PADDLE_TRAINERS_NUM",
-                 default=str(len(hostnames) or 1))
-        )
-        coordinator = _env("TPUJOB_COORDINATOR")
-        if not coordinator and hostnames:
-            port = _env("PADDLE_PORT", default="2379")
-            coordinator = "%s:%s" % (hostnames[0], port)
-
-        # Multislice: TPU_WORKER_ID is slice-local (the TPU runtime's view);
-        # TPUJOB_WORKER_ID is the global rank jax.distributed needs.
+        # Multislice: TPU_WORKER_HOSTNAMES / TPU_WORKER_ID are slice-local
+        # (the TPU runtime's view); TPUJOB_* are the global world
+        # jax.distributed needs. When only MEGASCALE_* + slice-local env is
+        # present (e.g. GKE-native injection), scale the fallbacks by the
+        # slice count instead of silently rendezvousing per-slice worlds.
         num_slices = int(_env("MEGASCALE_NUM_SLICES", default="1"))
+        slice_id = int(_env("MEGASCALE_SLICE_ID", default="0"))
+        hosts_per_slice = max(len(hostnames), 1)
+        num_workers = int(
+            _env("TPUJOB_NUM_WORKERS", "PADDLE_TRAINERS_NUM", default="0")
+        ) or hosts_per_slice * num_slices
+
+        coordinator = _env("TPUJOB_COORDINATOR")
+        if not coordinator:
+            port = _env("PADDLE_PORT", default="2379")
+            host = ""
+            if num_slices > 1:
+                # slice-local hostnames[0] is the wrong host on slices > 0;
+                # the MEGASCALE coordinator lives on slice 0.
+                mca = _env("MEGASCALE_COORDINATOR_ADDRESS")
+                host = mca.split(":")[0] if mca else ""
+            if not host and hostnames:
+                host = hostnames[0]
+            if host:
+                coordinator = "%s:%s" % (host, port)
+
+        worker_id_s = _env("TPUJOB_WORKER_ID", "PADDLE_TRAINER_ID")
+        if worker_id_s:
+            worker_id = int(worker_id_s)
+        else:
+            worker_id = int(_env("TPU_WORKER_ID", default="0"))
+            if num_slices > 1:
+                worker_id += slice_id * hosts_per_slice
         return LaunchConfig(
-            worker_id=int(_env("TPUJOB_WORKER_ID", "TPU_WORKER_ID",
-                               "PADDLE_TRAINER_ID", default="0")),
+            worker_id=worker_id,
             num_workers=num_workers,
             coordinator=coordinator,
-            slice_id=int(_env("MEGASCALE_SLICE_ID", default="0")),
+            slice_id=slice_id,
             num_slices=num_slices,
             hostnames=hostnames,
             role=_env("TRAINING_ROLE", default="TRAINER"),

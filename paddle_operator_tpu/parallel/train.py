@@ -41,7 +41,9 @@ def build_train_step(
     * state = {"params", "opt"}; ``step_fn(state, batch) -> (state, metrics)``
       with state donated.
     """
-    state = {"params": params, "opt": optimizer.init(params)}
+    # Build the optimizer state under jit: one executable instead of one
+    # host->device dispatch per leaf (the tunnel-latency killer on TPU pods).
+    state = jax.jit(lambda p: {"params": p, "opt": optimizer.init(p)})(params)
 
     def step(state, batch):
         def lossed(p):

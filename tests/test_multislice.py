@@ -167,6 +167,39 @@ def test_detect_env_multislice():
     assert cfg.coordinator == "10.0.0.1:2379"
 
 
+def test_detect_env_multislice_megascale_only_fallbacks():
+    """GKE-native injection: only MEGASCALE_* + slice-local env present.
+    Fallbacks must build the GLOBAL world, not a per-slice one."""
+    cfg = detect_env({
+        "TPU_WORKER_ID": "1",
+        "MEGASCALE_SLICE_ID": "1",
+        "MEGASCALE_NUM_SLICES": "2",
+        "MEGASCALE_COORDINATOR_ADDRESS": "ms-worker-0:8080",
+        "TPU_WORKER_HOSTNAMES": "ms-worker-2,ms-worker-3",
+    })
+    assert cfg.num_workers == 4                 # 2 hosts/slice x 2 slices
+    assert cfg.worker_id == 3                   # slice 1, local 1 -> global 3
+    # coordinator host comes from MEGASCALE (slice 0), not slice-local list
+    assert cfg.coordinator == "ms-worker-0:2379"
+
+
+def test_slice_anti_affinity_repels_other_jobs():
+    """Two multislice jobs must not split one physical slice between them."""
+    job = multislice_job(n_slices=2, hosts_per_slice=2)
+    pod = helper.construct_pod(job, api.RES_WORKER, 0)
+    repel = pod["spec"]["affinity"]["podAntiAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"]
+    cross_job = [
+        t for t in repel
+        if any(e["key"] == api.LABEL_JOB_NAME and e["operator"] == "NotIn"
+               for e in t["labelSelector"]["matchExpressions"])
+    ]
+    assert cross_job, "missing cross-job anti-affinity term"
+    exprs = {e["operator"] for e in cross_job[0]["labelSelector"]["matchExpressions"]}
+    assert "Exists" in exprs and "NotIn" in exprs
+    assert cross_job[0]["topologyKey"] == helper.GKE_NODEPOOL_TOPOLOGY
+
+
 # ---------------------------------------------------------------------------
 # data plane: hybrid dcn x ici mesh
 # ---------------------------------------------------------------------------
