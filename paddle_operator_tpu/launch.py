@@ -86,9 +86,18 @@ def detect_env(environ: Optional[dict] = None) -> LaunchConfig:
             host = ""
             if num_slices > 1:
                 # slice-local hostnames[0] is the wrong host on slices > 0;
-                # the MEGASCALE coordinator lives on slice 0.
+                # the MEGASCALE coordinator lives on slice 0. With neither
+                # source present, fail fast — falling back to the slice-local
+                # list would rendezvous divergent per-slice worlds that hang
+                # in jax.distributed.initialize with no error.
                 mca = _env("MEGASCALE_COORDINATOR_ADDRESS")
-                host = mca.split(":")[0] if mca else ""
+                if not mca:
+                    raise RuntimeError(
+                        "multislice launch needs TPUJOB_COORDINATOR or "
+                        "MEGASCALE_COORDINATOR_ADDRESS; slice-local hostnames "
+                        "cannot name the slice-0 coordinator"
+                    )
+                host = mca.split(":")[0]
             if not host and hostnames:
                 host = hostnames[0]
             if host:

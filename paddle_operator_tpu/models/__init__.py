@@ -2,10 +2,12 @@
 
 * ResNet-50 — collective-mode image classification (deploy/examples/resnet.yaml)
 * BERT — multi-host collective transformer (v5e-32 config)
+* GPT — decoder-only causal LM, the long-context flagship (RoPE + causal
+  flash attention + ring/Ulysses sequence parallelism)
 * wide_and_deep / deepfm — PS-mode CTR models (deploy/examples/*.yaml)
 
 All models are (init, apply) pure functions over dict pytrees, bf16 compute,
 built from `paddle_operator_tpu.ops.nn`.
 """
 
-from . import resnet, bert, wide_deep, deepfm  # noqa: F401
+from . import resnet, bert, gpt, wide_deep, deepfm  # noqa: F401

@@ -1,0 +1,133 @@
+"""Decoder-only causal LM (GPT family) for TPU: the long-context flagship.
+
+The reference operator launches user containers and never sees a model
+(SURVEY.md §0); this framework ships the training runtime, and the GPT family
+is where the long-context machinery earns its keep: rotary embeddings (no
+learned position table to gather under sequence sharding), causal flash
+attention fused in Pallas (diagonal tiles skipped, ~2x FLOP saving), and
+drop-in ring/Ulysses sequence parallelism over the ``sp`` mesh axis — pass
+``attn_impl=partial(parallel.ring_attention, mesh=mesh, causal=True)``.
+
+Pre-LN blocks, bf16 compute, optional switch-MoE FFNs (expert axis over
+``ep``), per-layer remat. Sharding rules: :func:`parallel.sharding.gpt_rules`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn
+
+BASE_CONFIG = dict(      # GPT-2 small scale
+    vocab_size=50304, hidden=768, layers=12, heads=12, mlp_dim=3072,
+    max_seq=1024, moe_experts=0, moe_every=2,
+)
+
+TINY_CONFIG = dict(
+    vocab_size=1024, hidden=128, layers=2, heads=4, mlp_dim=256,
+    max_seq=256, moe_experts=0, moe_every=2,
+)
+
+TINY_MOE_CONFIG = dict(TINY_CONFIG, moe_experts=4, moe_every=1)
+
+
+def init(key, config: Optional[dict] = None) -> Dict:
+    cfg = dict(BASE_CONFIG, **(config or {}))
+    h, mlp = cfg["hidden"], cfg["mlp_dim"]
+    keys = iter(jax.random.split(key, 8 + 8 * cfg["layers"]))
+    from ..ops.moe import moe_init
+
+    params: Dict = {
+        "embed": {"tok": nn.embedding_init(next(keys), cfg["vocab_size"], h)},
+        "layers": [],
+        "final_ln": nn.layernorm_init(h),
+        "lm_head": nn.dense_init(next(keys), h, cfg["vocab_size"],
+                                 use_bias=False),
+    }
+    for li in range(cfg["layers"]):
+        layer = {
+            "ln1": nn.layernorm_init(h),
+            "attn": nn.mha_init(next(keys), h, cfg["heads"]),
+            "ln2": nn.layernorm_init(h),
+        }
+        if cfg["moe_experts"] and li % cfg["moe_every"] == 0:
+            layer["moe"] = moe_init(next(keys), h, mlp, cfg["moe_experts"])
+        else:
+            layer["mlp"] = {
+                "fc1": nn.dense_init(next(keys), h, mlp),
+                "fc2": nn.dense_init(next(keys), mlp, h),
+            }
+        params["layers"].append(layer)
+    return params
+
+
+def _block(layer, x, dtype, attn_impl, positions):
+    """Pre-LN decoder block: x + attn(ln1 x); x + ffn(ln2 x)."""
+    from ..ops.moe import moe_apply
+
+    causal = not callable(attn_impl)  # callables (ring/ulysses) own masking
+    y = nn.mha(layer["attn"], nn.layernorm(layer["ln1"], x, dtype=dtype),
+               dtype=dtype, impl=attn_impl, causal=causal, use_rope=True,
+               positions=positions)
+    x = x + y
+    z = nn.layernorm(layer["ln2"], x, dtype=dtype)
+    aux = jnp.zeros((), jnp.float32)
+    if "moe" in layer:
+        z, moe_aux = moe_apply(layer["moe"], z, dtype=dtype)
+        aux = aux + moe_aux["moe_aux_loss"]
+    else:
+        z = nn.dense(layer["mlp"]["fc1"], z, dtype=dtype)
+        z = nn.gelu(z)
+        z = nn.dense(layer["mlp"]["fc2"], z, dtype=dtype)
+    return x + z, aux
+
+
+def apply(params, input_ids, dtype=jnp.bfloat16, remat: bool = False,
+          attn_impl="einsum", positions: Optional[jnp.ndarray] = None):
+    """input_ids: [B, S] -> (logits [B, S, V] fp32, moe aux loss scalar)."""
+    x = nn.embedding(params["embed"]["tok"], input_ids, dtype)
+
+    layer_fn = _block
+    if remat:
+        layer_fn = jax.checkpoint(_block, static_argnums=(2, 3))
+    aux = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x, layer_aux = layer_fn(layer, x, dtype, attn_impl, positions)
+        aux = aux + layer_aux
+    x = nn.layernorm(params["final_ln"], x, dtype=dtype)
+    logits = nn.dense(params["lm_head"], x, dtype=jnp.float32)
+    return logits, aux
+
+
+def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False,
+            attn_impl="einsum", moe_aux_weight: float = 0.01):
+    """Next-token LM loss. batch = {"input_ids" [B,S], optional "loss_mask"}.
+
+    Labels are input_ids shifted left; the final position is dropped. A
+    ``loss_mask`` (e.g. padding) applies to the *label* position.
+    """
+    ids = batch["input_ids"]
+    logits, moe_aux = apply(params, ids, dtype=dtype, remat=remat,
+                            attn_impl=attn_impl)
+    logits = logits[:, :-1]
+    labels = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    mask = (jnp.ones_like(labels, jnp.float32) if mask is None
+            else mask[:, 1:].astype(jnp.float32))
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    loss = -jnp.sum(picked * mask) / denom
+    loss = loss + moe_aux_weight * moe_aux
+    acc = jnp.sum(
+        (jnp.argmax(logits, -1) == labels).astype(jnp.float32) * mask) / denom
+    return loss, {"accuracy": acc, "moe_aux": moe_aux}
+
+
+def synthetic_batch(key, batch_size: int, seq_len: int = 256,
+                    vocab_size: int = 50304):
+    ids = jax.random.randint(key, (batch_size, seq_len), 0, vocab_size)
+    return {"input_ids": ids}

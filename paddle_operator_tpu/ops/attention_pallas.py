@@ -5,11 +5,14 @@ keeps the S×S score matrix out of HBM: per (batch·head, q-tile) grid cell the
 kernel streams KV tiles through VMEM maintaining running max/denominator —
 O(S·D) memory instead of O(S²).
 
-Training integration: ``flash_attention`` is a ``jax.custom_vjp`` whose
-forward runs the Pallas kernel and whose backward recomputes attention with
-the reference einsum formulation (identical math; forward-fused, classic
-rematerialised backward). Falls back to the einsum path automatically off-TPU
-or for shapes that don't tile (see ``supports``).
+Training integration: ``flash_attention`` is a ``jax.custom_vjp``. The
+forward kernel also emits the per-row log-sum-exp; the backward runs two
+Pallas kernels (a dQ pass over q-tiles and a dK/dV pass over kv-tiles) that
+recompute P from the saved LSE tile-by-tile — O(S·D) memory end to end, never
+materialising the S×S score matrix. ``causal=True`` fuses the triangular mask
+into the loop bounds of all three kernels (skipped tiles, ~2x FLOPs saved).
+Falls back to the einsum path automatically off-TPU or for shapes that don't
+tile (see ``supports``).
 """
 
 from __future__ import annotations
@@ -24,26 +27,46 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _reference_attention(q, k, v, scale):
+def _reference_attention(q, k, v, scale, causal=False):
     """Plain einsum attention in BHSD; fp32 softmax."""
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s = q.shape[2]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, seq_len):
-    """One (batch·head, q-tile) cell: stream KV tiles, online softmax."""
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                seq_len, causal):
+    """One (batch·head, q-tile) cell: stream KV tiles, online softmax.
+
+    Causal: KV tiles strictly above the diagonal are skipped entirely (the
+    fori_loop trip count is data-independent but grid-position-dependent, so
+    late q-tiles do proportionally less work — ~2x FLOP saving overall); the
+    tiles straddling the diagonal get an in-tile triangular mask.
+    """
     q = q_ref[0].astype(jnp.float32) * scale            # [block_q, d]
     block_q, head_dim = q.shape
+    qi = pl.program_id(1)
+    q_start = qi * block_q
 
     def body(i, carry):
         acc, m_prev, l_prev = carry
-        k_tile = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v_tile = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_start = i * block_k
+        k_tile = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(                         # [block_q, block_k]
             q, k_tile, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)       # [block_q, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                           # [block_q, block_k]
@@ -55,14 +78,125 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, seq_len):
         )
         return acc, m_new, l_new
 
+    if causal:
+        # tiles with k_start > q_end contribute nothing — skip them
+        n_steps = (q_start + block_q + block_k - 1) // block_k
+    else:
+        n_steps = seq_len // block_k
     acc = jnp.zeros((block_q, head_dim), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, seq_len // block_k, body, (acc, m0, l0))
+    acc, m, l = jax.lax.fori_loop(0, n_steps, body, (acc, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
-def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, block_k, seq_len, causal):
+    """dQ pass, one (batch·head, q-tile) cell: stream KV tiles.
+
+    dS_ij = P_ij * (dO_i·V_j - delta_i);  dQ_i = scale * Σ_j dS_ij K_j
+    with P recomputed from the saved log-sum-exp — no S×S residency.
+    """
+    q = q_ref[0].astype(jnp.float32)                     # [block_q, d]
+    do = do_ref[0].astype(jnp.float32)                   # [block_q, d]
+    lse = lse_ref[0][:, None]                            # [block_q, 1]
+    delta = delta_ref[0][:, None]                        # [block_q, 1]
+    block_q, head_dim = q.shape
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    def body(i, dq):
+        k_start = i * block_k
+        k_tile = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # [block_q, block_k]
+        dov = jax.lax.dot_general(                       # dO·V^T
+            do, v_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dov - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_tile, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        n_steps = (q_start + block_q + block_k - 1) // block_k
+    else:
+        n_steps = seq_len // block_k
+    dq = jax.lax.fori_loop(
+        0, n_steps, body, jnp.zeros((block_q, head_dim), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, block_q, seq_len, causal):
+    """dK/dV pass, one (batch·head, kv-tile) cell: stream Q tiles.
+
+    dV_j = Σ_i P_ij dO_i;  dK_j = scale * Σ_i dS_ij Q_i.
+    Causal: Q tiles strictly above the diagonal are skipped (dynamic lower
+    loop bound), mirroring the forward's FLOP saving.
+    """
+    k = k_ref[0].astype(jnp.float32)                     # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)                     # [block_k, d]
+    block_k, head_dim = k.shape
+    ki = pl.program_id(1)
+    k_start = ki * block_k
+
+    def body(i, carry):
+        dk, dv = carry
+        q_start = i * block_q
+        q_tile = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        do_tile = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(q_start, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(q_start, block_q)][:, None]
+        s = jax.lax.dot_general(                         # [block_q, block_k]
+            q_tile, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # [block_q, block_k]
+        dv = dv + jax.lax.dot_general(                   # P^T dO
+            p, do_tile, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dov = jax.lax.dot_general(
+            do_tile, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dov - delta)
+        dk = dk + jax.lax.dot_general(                   # dS^T Q
+            ds, q_tile, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    n_q_tiles = seq_len // block_q
+    start = k_start // block_q if causal else 0
+    dk0 = jnp.zeros((block_k, head_dim), jnp.float32)
+    dv0 = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q_tiles, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
     b, h, s, d = q.shape
     grid = (b * h, s // block_q)
 
@@ -72,39 +206,118 @@ def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
     def kv_index(bh, qi):
         return (bh, 0, 0)
 
+    def lse_index(bh, qi):
+        return (bh, qi)
+
     q3 = q.reshape(b * h, s, d)
     k3 = k.reshape(b * h, s, d)
     v3 = v.reshape(b * h, s, d)
 
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_k=block_k, seq_len=s),
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+                          seq_len=s, causal=causal),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), qo_index),
             pl.BlockSpec((1, s, d), kv_index),
             pl.BlockSpec((1, s, d), kv_index),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), qo_index),
+            pl.BlockSpec((1, block_q), lse_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, s, d), lse
+
+
+def _flash_bwd(q, k, v, out, lse, g, scale, block_q, block_k, interpret,
+               causal):
+    b, h, s, d = q.shape
+    q3, k3, v3 = (x.reshape(b * h, s, d) for x in (q, k, v))
+    do3 = g.reshape(b * h, s, d)
+    # delta_i = Σ_d dO_i O_i — O(S·D) rowwise reduce, fused by XLA
+    delta = jnp.sum(do3.astype(jnp.float32)
+                    * out.reshape(b * h, s, d).astype(jnp.float32), axis=-1)
+
+    def qo_index(bh, qi):
+        return (bh, qi, 0)
+
+    def full_index(bh, qi):
+        return (bh, 0, 0)
+
+    def row_tile_index(bh, qi):
+        return (bh, qi)
+
+    def row_full_index(bh, qi):
+        return (bh, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_k=block_k,
+                          seq_len=s, causal=causal),
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), qo_index),
+            pl.BlockSpec((1, s, d), full_index),
+            pl.BlockSpec((1, s, d), full_index),
+            pl.BlockSpec((1, block_q, d), qo_index),
+            pl.BlockSpec((1, block_q), row_tile_index),
+            pl.BlockSpec((1, block_q), row_tile_index),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), qo_index),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
-    )(q3, k3, v3)
-    return out.reshape(b, h, s, d)
+    )(q3, k3, v3, do3, lse, delta)
+
+    def kv_tile_index(bh, ki):
+        return (bh, ki, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
+                          seq_len=s, causal=causal),
+        grid=(b * h, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), full_index),
+            pl.BlockSpec((1, block_k, d), kv_tile_index),
+            pl.BlockSpec((1, block_k, d), kv_tile_index),
+            pl.BlockSpec((1, s, d), full_index),
+            pl.BlockSpec((1, s), row_full_index),
+            pl.BlockSpec((1, s), row_full_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), kv_tile_index),
+            pl.BlockSpec((1, block_k, d), kv_tile_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    shape = (b, h, s, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, scale, block_q, block_k, interpret, causal):
+    out, _ = _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal)
+    return out
 
 
-def _flash_attention_fwd(q, k, v, scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+def _flash_attention_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
+    out, lse = _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, scale), q, k, v)
-    return vjp(g)
+def _flash_attention_bwd(scale, block_q, block_k, interpret, causal, res, g):
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, scale, block_q, block_k,
+                      interpret, causal)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
@@ -119,8 +332,9 @@ def supports(q_shape, dtype) -> bool:
 
 
 def flash_attention(q, k, v, scale=None, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+                    block_k: int = 128, interpret: bool = False,
+                    causal: bool = False):
     """q,k,v: [B, H, S, D] → [B, H, S, D]. Differentiable."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_attention(q, k, v, scale, block_q, block_k, interpret)
+    return _flash_attention(q, k, v, scale, block_q, block_k, interpret, causal)

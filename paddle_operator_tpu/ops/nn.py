@@ -165,8 +165,29 @@ def mha_init(key, dim: int, num_heads: int):
     }
 
 
+def rope(x: jnp.ndarray, positions: Optional[jnp.ndarray] = None,
+         base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding over the head dim. x: [B, S, H, D].
+
+    Position-relative by construction, so it extrapolates under sequence
+    sharding: each sp shard passes its global positions and no learned
+    position table has to be gathered.
+    """
+    b, s, h, d = x.shape
+    half = d // 2
+    if positions is None:
+        positions = jnp.arange(s)
+    inv_freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, half]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
 def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
-        impl: str = "einsum"):
+        impl: str = "einsum", causal: bool = False, use_rope: bool = False,
+        positions: Optional[jnp.ndarray] = None):
     """Multi-head self-attention, BSHD layout.
 
     The einsum formulation keeps the contraction dims explicit so GSPMD can
@@ -176,8 +197,14 @@ def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
     impl: "einsum" (default), "flash" (Pallas fused blockwise kernel),
     "auto" (flash on TPU when the shape tiles and there is no mask), or a
     callable (q, k, v) -> ctx in BHSD layout — the hook the sequence-parallel
-    attentions plug into (e.g. ``partial(parallel.ring_attention, mesh=mesh)``);
-    the callable owns masking, so `mask` must be None.
+    attentions plug into (e.g. ``partial(parallel.ring_attention, mesh=mesh,
+    causal=True)``); the callable owns masking, so `mask`/`causal` stay here
+    only for the non-callable paths.
+
+    causal: decoder (GPT) masking — fused into the flash kernel's loop bounds
+    (skipped tiles, not masked-after-compute) on the Pallas path.
+    use_rope: rotary embedding on q/k after projection (positions = global
+    token positions, defaults to arange — sp shards pass their own).
     """
     def proj(p, x):
         return (
@@ -186,10 +213,14 @@ def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
         )
 
     q, k, v = proj(params["q"], x), proj(params["k"], x), proj(params["v"], x)
+    if use_rope:
+        q, k = rope(q, positions), rope(k, positions)
     head_dim = q.shape[-1]
 
     if callable(impl):
-        assert mask is None, "callable attention impls own their masking"
+        assert mask is None and not causal, (
+            "callable attention impls own their masking/causality — pass "
+            "causal=True inside the partial (e.g. ring_attention causal=...)")
         ctx = impl(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3),
@@ -211,10 +242,14 @@ def mha(params, x, mask: Optional[jnp.ndarray] = None, dtype=jnp.bfloat16,
         interpret = jax.default_backend() == "cpu"
         ctx = attention_pallas.flash_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), interpret=interpret,
+            v.transpose(0, 2, 1, 3), interpret=interpret, causal=causal,
         ).transpose(0, 2, 1, 3)
     else:
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
+        if causal:
+            s_len = scores.shape[-1]
+            cmask = jnp.tril(jnp.ones((s_len, s_len), bool))[None, None]
+            mask = cmask if mask is None else jnp.logical_and(mask, cmask)
         if mask is not None:
             scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)

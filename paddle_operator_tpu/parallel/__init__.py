@@ -10,7 +10,8 @@ constraints on `sp`.
 
 from .mesh import make_hybrid_mesh, make_mesh, mesh_from_env  # noqa: F401
 from .sharding import (  # noqa: F401
-    shard_tree, named, P, bert_rules, resnet_rules, ctr_rules, moe_rules,
+    shard_tree, named, P, bert_rules, gpt_rules, resnet_rules, ctr_rules,
+    moe_rules,
 )
 from .train import build_train_step  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401

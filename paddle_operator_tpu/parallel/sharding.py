@@ -85,9 +85,10 @@ def shard_tree(tree, mesh: Mesh, rules: Optional[Rules] = None,
 # model rule sets (Megatron-style TP layout expressed as GSPMD specs)
 # ---------------------------------------------------------------------------
 
-def bert_rules() -> Rules:
-    """BERT: column-parallel qkv/fc1, row-parallel o/fc2, vocab-sharded
-    embeddings/decoder. Biases of column-parallel layers shard with them."""
+def _megatron_tp_rules() -> Rules:
+    """Shared transformer TP layout: column-parallel qkv/fc1 (head/hidden dim
+    on `tp`), row-parallel o/fc2, vocab-sharded token embedding. Biases of
+    column-parallel layers shard with them."""
     return [
         (r"attn/(q|k|v)/kernel", P(None, "tp", None)),
         (r"attn/(q|k|v)/bias", P("tp", None)),
@@ -96,8 +97,21 @@ def bert_rules() -> Rules:
         (r"mlp/fc1/bias", P("tp")),
         (r"mlp/fc2/kernel", P("tp", None)),
         (r"embed/tok/table", P("tp", None)),
+    ]
+
+
+def bert_rules() -> Rules:
+    """BERT: Megatron TP base + vocab-sharded MLM decoder head."""
+    return _megatron_tp_rules() + [
         (r"mlm/decoder/kernel", P(None, "tp")),
         (r"mlm/decoder/bias", P("tp")),
+    ]
+
+
+def gpt_rules() -> Rules:
+    """GPT decoder: Megatron TP base + vocab-sharded LM head."""
+    return _megatron_tp_rules() + [
+        (r"lm_head/kernel", P(None, "tp")),
     ]
 
 

@@ -8,6 +8,8 @@ wrappers over :func:`run_training`.
 
 from __future__ import annotations
 
+import functools
+import inspect
 import logging
 import time
 from dataclasses import dataclass, field
@@ -63,8 +65,16 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         ) else None
         rng = jax.random.PRNGKey(job.seed)
         params = job.init_params(rng)
+        loss_fn = job.loss_fn
+        # loss functions that declare a `mesh` kwarg get the live mesh —
+        # the hook sequence-parallel attention (ring/Ulysses) plugs into.
+        try:
+            if "mesh" in inspect.signature(loss_fn).parameters:
+                loss_fn = functools.partial(loss_fn, mesh=mesh)
+        except (TypeError, ValueError):
+            pass
         step_fn, state = build_train_step(
-            job.loss_fn, job.optimizer, params, job.make_batch(rng, 0),
+            loss_fn, job.optimizer, params, job.make_batch(rng, 0),
             mesh=mesh, rules=job.rules, seq_axis=job.seq_axis,
             merge_stats=job.merge_stats, grad_clip=job.grad_clip,
         )

@@ -183,6 +183,20 @@ def test_detect_env_multislice_megascale_only_fallbacks():
     assert cfg.coordinator == "ms-worker-0:2379"
 
 
+def test_detect_env_multislice_no_coordinator_fails_fast():
+    """No TPUJOB_COORDINATOR and no MEGASCALE_COORDINATOR_ADDRESS: refuse to
+    rendezvous divergent per-slice worlds (they'd hang, not error)."""
+    import pytest
+
+    with pytest.raises(RuntimeError, match="coordinator"):
+        detect_env({
+            "TPU_WORKER_ID": "0",
+            "MEGASCALE_SLICE_ID": "1",
+            "MEGASCALE_NUM_SLICES": "2",
+            "TPU_WORKER_HOSTNAMES": "ms-worker-2,ms-worker-3",
+        })
+
+
 def test_slice_anti_affinity_repels_other_jobs():
     """Two multislice jobs must not split one physical slice between them."""
     job = multislice_job(n_slices=2, hosts_per_slice=2)
