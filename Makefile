@@ -4,7 +4,7 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all test bench native manifests gen-deploy helm run install deploy docker-build clean
+.PHONY: all test bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
@@ -21,6 +21,13 @@ native:
 # regenerate CRD + operator manifests + helm chart from api/crd.py
 manifests gen-deploy helm:
 	$(PY) scripts/gen_deploy.py
+
+# third-party license NOTICES (reference: go-licenses pipeline)
+notices:
+	$(PY) scripts/gen_notices.py
+
+notices-check:
+	$(PY) scripts/gen_notices.py --check
 
 run:
 	$(PY) -m paddle_operator_tpu.manager

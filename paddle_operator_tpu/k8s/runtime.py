@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .client import KubeClient
 from .fake import FakeKubeClient
 from .objects import get_controller_of
+from ..utils.trace import tracer
 
 log = logging.getLogger("tpujob.runtime")
 
@@ -152,7 +153,9 @@ class Controller:
         """Run one reconcile; enqueue follow-ups per the Result contract."""
         self.metrics["reconcile_total"] += 1
         try:
-            result = self.reconcile(*key)
+            with tracer().span("reconcile", controller=self.name,
+                               namespace=key[0], obj=key[1]):
+                result = self.reconcile(*key)
         except Exception:
             log.exception("reconcile %s/%s panicked", *key)
             self.metrics["reconcile_errors_total"] += 1

@@ -22,6 +22,7 @@ from .ops.optim import Optimizer
 from .parallel import build_train_step, make_mesh
 from .parallel.sharding import Rules
 from .utils.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .utils.trace import profile_steps, tracer
 
 log = logging.getLogger("tpujob.runner")
 
@@ -92,29 +93,41 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
 
         t0 = time.perf_counter()
         metrics = {}
-        for step in range(start_step, job.total_steps):
-            batch = job.make_batch(jax.random.fold_in(rng, step), step)
-            state, metrics = step_fn(state, batch)
-            if job.log_every and (step + 1) % job.log_every == 0:
-                loss = float(metrics["loss"])
-                rate = (step + 1 - start_step) / (time.perf_counter() - t0)
-                log.info("step %d loss=%.4f steps/s=%.2f", step + 1, loss, rate)
-            if job.checkpoint_dir and (step + 1) % job.checkpoint_every == 0:
-                if cfg.worker_id == 0:
-                    save_checkpoint(
-                        job.checkpoint_dir, step + 1,
-                        jax.device_get(state), meta={"epoch": epoch},
-                    )
-            if should_stop():
-                log.info("membership epoch moved at step %d; restarting", step + 1)
-                if job.checkpoint_dir and cfg.worker_id == 0:
-                    save_checkpoint(
-                        job.checkpoint_dir, step + 1,
-                        jax.device_get(state), meta={"epoch": epoch},
-                    )
-                return False
-            result["state"] = state
-            result["steps"] = step + 1
+        prof = profile_steps()
+        trc = tracer()
+        try:
+            for step in range(start_step, job.total_steps):
+                prof.before(step)
+                batch = job.make_batch(jax.random.fold_in(rng, step), step)
+                state, metrics = step_fn(state, batch)
+                prof.after(step)
+                trc.event("train_step", step=step + 1, epoch=epoch)
+                if job.log_every and (step + 1) % job.log_every == 0:
+                    loss = float(metrics["loss"])
+                    rate = (step + 1 - start_step) / (time.perf_counter() - t0)
+                    log.info("step %d loss=%.4f steps/s=%.2f",
+                             step + 1, loss, rate)
+                if job.checkpoint_dir and (step + 1) % job.checkpoint_every == 0:
+                    if cfg.worker_id == 0:
+                        save_checkpoint(
+                            job.checkpoint_dir, step + 1,
+                            jax.device_get(state), meta={"epoch": epoch},
+                        )
+                if should_stop():
+                    log.info("membership epoch moved at step %d; restarting",
+                             step + 1)
+                    if job.checkpoint_dir and cfg.worker_id == 0:
+                        save_checkpoint(
+                            job.checkpoint_dir, step + 1,
+                            jax.device_get(state), meta={"epoch": epoch},
+                        )
+                    return False
+                result["state"] = state
+                result["steps"] = step + 1
+        finally:
+            # a step that raises mid-window must still finalize the device
+            # trace, or the capture is lost and re-entry hits "already active"
+            prof.close()
         if metrics:
             result["loss"] = float(metrics["loss"])
         return True

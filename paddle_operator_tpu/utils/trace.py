@@ -1,0 +1,151 @@
+"""Tracing / profiling: structured spans + XLA profiler integration.
+
+The reference has NO tracing or profiling anywhere (SURVEY.md §5.1 — only
+zap logging and k8s Events). This subsystem goes beyond it, in two layers:
+
+* :class:`Tracer` — zero-dependency structured span recorder. Spans nest via
+  a context manager, carry attributes, and stream to a JSONL file (one event
+  per line: ``{"name", "t0", "dur_ms", "attrs", "depth"}``) so both the
+  operator's reconcile loop and the training runner share one trace format.
+  Negligible overhead when disabled (no-op fast path).
+
+* :func:`profile_steps` — gates ``jax.profiler`` capture over a window of
+  training steps (device traces viewable in TensorBoard/XProf). Enabled by
+  ``TPUJOB_PROFILE_DIR`` (where to write) + optional
+  ``TPUJOB_PROFILE_STEPS=start:stop``; the runner calls the hooks every step
+  and the profiler only engages inside the window, so production runs pay
+  nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+_local = threading.local()
+
+
+class Tracer:
+    """Structured span recorder, JSONL sink, thread-safe, cheap when off."""
+
+    def __init__(self, path: str = "", enabled: Optional[bool] = None):
+        self.path = path or os.environ.get("TPUJOB_TRACE_FILE", "")
+        self.enabled = bool(self.path) if enabled is None else enabled
+        self._lock = threading.Lock()
+        self._file = None
+        self._events = []          # in-memory ring for tests/inspection
+        self._max_events = 4096
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        if not self.enabled:
+            yield self
+            return
+        depth = getattr(_local, "depth", 0)
+        _local.depth = depth + 1
+        t0 = time.time()
+        p0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            _local.depth = depth
+            self._emit({
+                "name": name,
+                "t0": round(t0, 6),
+                "dur_ms": round((time.perf_counter() - p0) * 1e3, 3),
+                "depth": depth,
+                "attrs": attrs,
+            })
+
+    def event(self, name: str, **attrs: Any) -> None:
+        if not self.enabled:
+            return
+        self._emit({
+            "name": name, "t0": round(time.time(), 6), "dur_ms": 0.0,
+            "depth": getattr(_local, "depth", 0), "attrs": attrs,
+        })
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append(rec)
+            if len(self._events) > self._max_events:
+                self._events = self._events[-self._max_events:]
+            if self.path:
+                if self._file is None:
+                    os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                    self._file = open(self.path, "a", buffering=1)
+                self._file.write(json.dumps(rec) + "\n")
+
+    @property
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+_global: Optional[Tracer] = None
+
+
+def tracer() -> Tracer:
+    """Process-wide tracer, configured from TPUJOB_TRACE_FILE."""
+    global _global
+    if _global is None:
+        _global = Tracer()
+    return _global
+
+
+class profile_steps:
+    """Step-window gate for the XLA device profiler.
+
+    >>> prof = profile_steps()        # reads TPUJOB_PROFILE_DIR/_STEPS
+    >>> for step in range(n):
+    ...     prof.before(step)
+    ...     state, _ = train_step(state, batch)
+    ...     prof.after(step)
+
+    Captures device + host traces for steps in [start, stop) into
+    ``profile_dir`` (default window: steps 10:13 once a dir is set).
+    """
+
+    def __init__(self, profile_dir: str = "",
+                 window: Optional[str] = None):
+        self.dir = profile_dir or os.environ.get("TPUJOB_PROFILE_DIR", "")
+        window = window or os.environ.get("TPUJOB_PROFILE_STEPS", "10:13")
+        try:
+            start_s, _, stop_s = window.partition(":")
+            self.start, self.stop = int(start_s), int(stop_s)
+        except ValueError:
+            self.start, self.stop = 10, 13
+        self._active = False
+
+    def before(self, step: int) -> None:
+        # range check, not equality: a run resumed from a checkpoint past
+        # `start` (or an elastic restart) must still capture the window tail
+        if self.dir and not self._active and self.start <= step < self.stop:
+            import jax
+
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+
+    def after(self, step: int) -> None:
+        if self._active and step + 1 >= self.stop:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False

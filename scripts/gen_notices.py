@@ -1,0 +1,129 @@
+"""Generate third-party license NOTICES for the framework.
+
+The reference ships a go-licenses pipeline (`hack/install-go-licenses.sh`,
+`third_party/licenses/licenses.csv`, Makefile NOTICES targets). This is the
+Python equivalent: walk installed distribution metadata for the framework's
+import closure, write `third_party/licenses/licenses.csv` (name, version,
+license) and a concatenated `third_party/NOTICES` with full license texts
+where the wheel ships them.
+
+Usage: python scripts/gen_notices.py [--check]
+  --check: exit 1 if the generated csv differs from the committed one
+  (CI drift guard; mirrors go-licenses' csv check).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import io
+import os
+import sys
+
+try:
+    from importlib import metadata
+except ImportError:  # pragma: no cover
+    import importlib_metadata as metadata  # type: ignore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "third_party", "licenses")
+NOTICES = os.path.join(REPO, "third_party", "NOTICES")
+
+# direct runtime dependencies of paddle_operator_tpu (stdlib excluded);
+# transitive closure resolved from dist metadata below.
+ROOTS = ["jax", "jaxlib", "numpy", "flax", "optax", "chex", "einops"]
+
+LICENSE_FILE_NAMES = ("LICENSE", "LICENSE.txt", "LICENSE.md", "COPYING",
+                      "LICENSE.rst", "LICENCE")
+
+
+def _license_of(dist) -> str:
+    meta = dist.metadata
+    lic = (meta.get("License-Expression") or "").strip()
+    if lic and lic.lower() != "unknown":
+        return lic
+    for classifier in meta.get_all("Classifier") or []:
+        if classifier.startswith("License ::"):
+            return classifier.split("::")[-1].strip()
+    lic = (meta.get("License") or "").strip()
+    if lic and len(lic) < 64:
+        return lic
+    return "unknown"
+
+
+def closure(roots):
+    seen = {}
+    stack = list(roots)
+    while stack:
+        name = stack.pop()
+        key = name.lower().replace("_", "-")
+        if key in seen:
+            continue
+        try:
+            dist = metadata.distribution(name)
+        except metadata.PackageNotFoundError:
+            continue
+        seen[key] = dist
+        for req in dist.requires or []:
+            # extras-gated deps are not part of the installed runtime closure
+            if "extra ==" in req:
+                continue
+            dep = req.split(";")[0].split(" ")[0]
+            dep = dep.split("[")[0].split(">")[0].split("<")[0]
+            dep = dep.split("=")[0].split("!")[0].split("~")[0].strip()
+            if dep:
+                stack.append(dep)
+    return dict(sorted(seen.items()))
+
+
+def license_text(dist) -> str:
+    for f in dist.files or []:
+        if f.name in LICENSE_FILE_NAMES:
+            try:
+                return dist.locate_file(f).read_text(errors="replace")
+            except OSError:
+                pass
+    return ""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args(argv)
+
+    dists = closure(ROOTS)
+    buf = io.StringIO()
+    w = csv.writer(buf)
+    for key, dist in dists.items():
+        w.writerow([key, dist.version, _license_of(dist)])
+    csv_text = buf.getvalue()
+
+    csv_path = os.path.join(OUT_DIR, "licenses.csv")
+    if args.check:
+        try:
+            committed = open(csv_path).read()
+        except OSError:
+            committed = ""
+        if committed != csv_text:
+            sys.stderr.write("licenses.csv is stale; run scripts/gen_notices.py\n")
+            return 1
+        return 0
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(csv_path, "w") as f:
+        f.write(csv_text)
+
+    with open(NOTICES, "w") as f:
+        f.write("Third-party notices for paddle-operator-tpu\n")
+        f.write("=" * 60 + "\n")
+        for key, dist in dists.items():
+            text = license_text(dist)
+            f.write("\n%s %s — %s\n" % (key, dist.version, _license_of(dist)))
+            f.write("-" * 60 + "\n")
+            f.write(text or "(license text not bundled in wheel metadata)\n")
+    print("wrote %s (%d packages) and %s" % (csv_path, len(dists), NOTICES))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
