@@ -1,0 +1,188 @@
+"""`tpujob` CLI: kubectl-flavoured CRUD for TpuJob.
+
+The user-facing SDK surface beyond the typed-client example
+(``client/client.py``; reference analog ``client/client.go``):
+
+    python -m paddle_operator_tpu.cli submit -f deploy/examples/resnet.yaml
+    python -m paddle_operator_tpu.cli list
+    python -m paddle_operator_tpu.cli get resnet50 -o yaml
+    python -m paddle_operator_tpu.cli describe resnet50
+    python -m paddle_operator_tpu.cli delete resnet50
+
+Output columns mirror the CRD's printer columns (Status / Mode / Age —
+reference: additionalPrinterColumns in the generated CRD yaml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .api import types as api
+from .k8s.client import HttpKubeClient
+from .k8s.errors import AlreadyExistsError, NotFoundError
+
+
+def _age(obj: dict) -> str:
+    ts = obj.get("metadata", {}).get("creationTimestamp")
+    if not ts:
+        return "-"
+    try:
+        import calendar
+
+        created = calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+        secs = max(0, int(time.time()) - created)
+    except ValueError:
+        return "-"
+    if secs < 120:
+        return "%ds" % secs
+    if secs < 7200:
+        return "%dm" % (secs // 60)
+    if secs < 172800:
+        return "%dh" % (secs // 3600)
+    return "%dd" % (secs // 86400)
+
+
+def _print_table(jobs) -> None:
+    rows = [("NAME", "STATUS", "MODE", "AGE")]
+    for j in jobs:
+        status = j.get("status", {}) or {}
+        rows.append((
+            j["metadata"]["name"],
+            status.get("phase", "-"),
+            status.get("mode", "-"),
+            _age(j),
+        ))
+    widths = [max(len(r[i]) for r in rows) + 2 for i in range(4)]
+    for r in rows:
+        print("".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+def _load_manifest(path: str) -> list:
+    import yaml
+
+    with (sys.stdin if path == "-" else open(path)) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    for d in docs:
+        if d.get("kind") != api.KIND:
+            raise SystemExit("unsupported kind %r (want %s)"
+                             % (d.get("kind"), api.KIND))
+    return docs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpujob")
+    ap.add_argument("--kube-api", default=None, help="apiserver URL override")
+    ap.add_argument("--insecure-skip-tls-verify", action="store_true")
+    ap.add_argument("-n", "--namespace", default="default")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_submit = sub.add_parser("submit", help="create TpuJob(s) from yaml")
+    p_submit.add_argument("-f", "--filename", required=True,
+                          help="manifest path ('-' = stdin)")
+
+    sub.add_parser("list", help="list TpuJobs")
+
+    p_get = sub.add_parser("get", help="get one TpuJob")
+    p_get.add_argument("name")
+    p_get.add_argument("-o", "--output", choices=["table", "yaml", "json"],
+                       default="table")
+
+    p_desc = sub.add_parser("describe", help="spec + status + per-role refs")
+    p_desc.add_argument("name")
+
+    p_del = sub.add_parser("delete", help="delete a TpuJob")
+    p_del.add_argument("name")
+
+    args = ap.parse_args(argv)
+
+    client = HttpKubeClient(base_url=args.kube_api,
+                            insecure=args.insecure_skip_tls_verify)
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    return run(client, args)
+
+
+def run(client, args) -> int:
+    """Command dispatch, client injected (tests pass a FakeKubeClient)."""
+    if args.cmd == "submit":
+        docs = _load_manifest(args.filename)
+        # validate ALL documents before creating ANY: submit is atomic
+        # client-side, no partial application on a bad later doc
+        for doc in docs:
+            doc.setdefault("metadata", {}).setdefault("namespace",
+                                                      args.namespace)
+            errs = api.TpuJob(doc).validate()
+            if errs:
+                print("invalid %s: %s" % (doc["metadata"].get("name"),
+                                          "; ".join(errs)), file=sys.stderr)
+                return 1
+        for doc in docs:
+            try:
+                created = client.create(doc)
+            except AlreadyExistsError:
+                print("tpujob %r already exists"
+                      % doc["metadata"].get("name"), file=sys.stderr)
+                return 1
+            print("tpujob/%s created" % created["metadata"]["name"])
+        return 0
+
+    if args.cmd == "list":
+        _print_table(client.list(api.KIND, args.namespace))
+        return 0
+
+    if args.cmd in ("get", "describe"):
+        try:
+            obj = client.get(api.KIND, args.namespace, args.name)
+        except NotFoundError:
+            print("tpujob %r not found" % args.name, file=sys.stderr)
+            return 1
+        if args.cmd == "get":
+            if args.output == "yaml":
+                import yaml
+
+                print(yaml.safe_dump(obj, sort_keys=False).rstrip())
+            elif args.output == "json":
+                print(json.dumps(obj, indent=2))
+            else:
+                _print_table([obj])
+            return 0
+        # describe
+        status = obj.get("status", {}) or {}
+        print("Name:      %s" % obj["metadata"]["name"])
+        print("Namespace: %s" % obj["metadata"].get("namespace", "default"))
+        print("Phase:     %s" % status.get("phase", "-"))
+        print("Mode:      %s" % status.get("mode", "-"))
+        spec = obj.get("spec", {})
+        if spec.get("device"):
+            print("Device:    %s" % spec["device"])
+        tpu = spec.get("tpu") or {}
+        if tpu:
+            print("TPU:       %s %s x%d slice(s)" % (
+                tpu.get("accelerator", "?"), tpu.get("topology", "?"),
+                tpu.get("numSlices", 1)))
+        for role in api.RESOURCE_ORDER:
+            rs = status.get(role)
+            if not rs:
+                continue
+            print("%-9s ready %s/%s  refs=%s" % (
+                role + ":", rs.get("running", 0),
+                (spec.get(role) or {}).get("replicas", 0),
+                ",".join(rs.get("refs", [])) or "-"))
+        return 0
+
+    if args.cmd == "delete":
+        try:
+            client.delete(api.KIND, args.namespace, args.name)
+        except NotFoundError:
+            print("tpujob %r not found" % args.name, file=sys.stderr)
+            return 1
+        print("tpujob/%s deleted" % args.name)
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

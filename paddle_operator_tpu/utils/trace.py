@@ -23,6 +23,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
@@ -37,8 +38,7 @@ class Tracer:
         self.enabled = bool(self.path) if enabled is None else enabled
         self._lock = threading.Lock()
         self._file = None
-        self._events = []          # in-memory ring for tests/inspection
-        self._max_events = 4096
+        self._events = deque(maxlen=4096)  # in-memory ring, O(1) append
 
     @contextmanager
     def span(self, name: str, **attrs: Any):
@@ -72,8 +72,6 @@ class Tracer:
     def _emit(self, rec: Dict[str, Any]) -> None:
         with self._lock:
             self._events.append(rec)
-            if len(self._events) > self._max_events:
-                self._events = self._events[-self._max_events:]
             if self.path:
                 if self._file is None:
                     os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -124,6 +122,11 @@ class profile_steps:
             start_s, _, stop_s = window.partition(":")
             self.start, self.stop = int(start_s), int(stop_s)
         except ValueError:
+            import logging
+
+            logging.getLogger("tpujob.trace").warning(
+                "unparseable TPUJOB_PROFILE_STEPS=%r (want start:stop); "
+                "using default 10:13", window)
             self.start, self.stop = 10, 13
         self._active = False
 

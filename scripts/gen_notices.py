@@ -29,9 +29,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT_DIR = os.path.join(REPO, "third_party", "licenses")
 NOTICES = os.path.join(REPO, "third_party", "NOTICES")
 
-# direct runtime dependencies of paddle_operator_tpu (stdlib excluded);
-# transitive closure resolved from dist metadata below.
-ROOTS = ["jax", "jaxlib", "numpy", "flax", "optax", "chex", "einops"]
+# direct runtime dependencies of paddle_operator_tpu — exactly the
+# third-party modules the package imports (jax, numpy, yaml) plus jax's
+# binary backend; transitive closure resolved from dist metadata below.
+ROOTS = ["jax", "jaxlib", "numpy", "PyYAML"]
 
 LICENSE_FILE_NAMES = ("LICENSE", "LICENSE.txt", "LICENSE.md", "COPYING",
                       "LICENSE.rst", "LICENCE")

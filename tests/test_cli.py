@@ -1,0 +1,113 @@
+"""tpujob CLI against the fake apiserver."""
+
+import argparse
+
+import pytest
+import yaml
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.cli import run
+from paddle_operator_tpu.k8s.fake import FakeKubeClient
+
+
+@pytest.fixture
+def client():
+    c = FakeKubeClient()
+    c.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    return c
+
+
+def args(**kw):
+    defaults = dict(namespace="default", output="table")
+    defaults.update(kw)
+    return argparse.Namespace(**defaults)
+
+
+def manifest(tmp_path, name="cli-job", replicas=4):
+    doc = {
+        "apiVersion": api.API_VERSION,
+        "kind": api.KIND,
+        "metadata": {"name": name},
+        "spec": {
+            "device": "tpu",
+            "tpu": {"accelerator": "v5e", "topology": "4x8"},
+            "worker": {
+                "replicas": replicas,
+                "template": {"spec": {"containers": [
+                    {"name": "trainer", "image": "img"}]}},
+            },
+        },
+    }
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+def test_submit_list_get_describe_delete(tmp_path, capsys, client):
+    assert run(client, args(cmd="submit", filename=manifest(tmp_path))) == 0
+    assert "tpujob/cli-job created" in capsys.readouterr().out
+
+    assert run(client, args(cmd="list")) == 0
+    out = capsys.readouterr().out
+    assert "NAME" in out and "cli-job" in out
+
+    assert run(client, args(cmd="get", name="cli-job", output="yaml")) == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert doc["metadata"]["name"] == "cli-job"
+
+    # simulate controller-populated status, then describe
+    obj = client.get(api.KIND, "default", "cli-job")
+    obj["status"] = {
+        "phase": "Running", "mode": "Collective",
+        "worker": {"running": 4, "refs": [
+            "cli-job-worker-%d" % i for i in range(4)]},
+    }
+    client.update_status(obj)
+    assert run(client, args(cmd="describe", name="cli-job")) == 0
+    out = capsys.readouterr().out
+    assert "Phase:     Running" in out
+    assert "ready 4/4" in out
+    assert "cli-job-worker-0" in out
+
+    assert run(client, args(cmd="delete", name="cli-job")) == 0
+    assert run(client, args(cmd="get", name="cli-job", output="table")) == 1
+
+
+def test_submit_duplicate_friendly_error(tmp_path, capsys, client):
+    path = manifest(tmp_path)
+    assert run(client, args(cmd="submit", filename=path)) == 0
+    capsys.readouterr()
+    assert run(client, args(cmd="submit", filename=path)) == 1
+    assert "already exists" in capsys.readouterr().err
+
+
+def test_submit_rejects_invalid(tmp_path, capsys, client):
+    # elastic + multislice is rejected by validate()
+    doc = {
+        "apiVersion": api.API_VERSION,
+        "kind": api.KIND,
+        "metadata": {"name": "bad"},
+        "spec": {
+            "device": "tpu",
+            "elastic": 1,
+            "tpu": {"numSlices": 2},
+            "worker": {"replicas": 4,
+                       "template": {"spec": {"containers": []}}},
+        },
+    }
+    path = tmp_path / "bad.yaml"
+    path.write_text(yaml.safe_dump(doc))
+    assert run(client, args(cmd="submit", filename=str(path))) == 1
+    assert "invalid" in capsys.readouterr().err
+
+
+def test_submit_rejects_wrong_kind(tmp_path, client):
+    path = tmp_path / "wrong.yaml"
+    path.write_text(yaml.safe_dump({"kind": "Pod", "metadata": {"name": "x"}}))
+    with pytest.raises(SystemExit):
+        run(client, args(cmd="submit", filename=str(path)))
+
+
+def test_delete_missing_returns_error(capsys, client):
+    assert run(client, args(cmd="delete", name="nope")) == 1
+    assert "not found" in capsys.readouterr().err

@@ -1,0 +1,59 @@
+"""Full-stack elastic training: membership HTTP server + runner + checkpoint.
+
+The closest thing to the reference's EDL loop (SURVEY.md §3.4) that runs
+hermetically: a live MembershipServer stands in for etcd, the real
+ElasticAgent polls it, training is interrupted by an epoch bump mid-run,
+and the second cycle resumes from the checkpoint the first one saved.
+"""
+
+import jax.numpy as jnp
+
+from paddle_operator_tpu.elastic.server import MembershipServer
+from paddle_operator_tpu.elastic.store import connect as kv_connect
+from paddle_operator_tpu.elastic.sync import epoch_key, np_key
+from paddle_operator_tpu.launch import LaunchConfig
+from paddle_operator_tpu.models import gpt
+from paddle_operator_tpu.ops import optim
+from paddle_operator_tpu.runner import TrainJob, run_training
+from paddle_operator_tpu.utils.checkpoint import latest_step
+
+
+def test_elastic_chaos_restart_resumes_from_checkpoint(tmp_path):
+    with MembershipServer() as server:
+        store = kv_connect(server.endpoint)
+        store.put(np_key("default", "echaos"), "1")
+        store.put(epoch_key("default", "echaos"), "1")
+
+        bumped = {"done": False}
+
+        def make_batch(rng, step):
+            # chaos: the "operator" bumps the membership epoch mid-cycle-0
+            # (as it would on preemption / scale), exactly once
+            if step == 3 and not bumped["done"]:
+                bumped["done"] = True
+                store.put(epoch_key("default", "echaos"), "2")
+            return gpt.synthetic_batch(rng, 8, 16, 1024)
+
+        job = TrainJob(
+            init_params=lambda rng: gpt.init(rng, gpt.TINY_CONFIG),
+            loss_fn=gpt.loss_fn,
+            optimizer=optim.adamw(1e-3),
+            make_batch=make_batch,
+            total_steps=6,
+            checkpoint_every=2,
+            checkpoint_dir=str(tmp_path),
+            log_every=0,
+        )
+        cfg = LaunchConfig(
+            worker_id=0, num_workers=1,
+            elastic_server=server.endpoint, job_id="default-echaos",
+        )
+        out = run_training(job, cfg=cfg, init_distributed=False,
+                           poll_interval=0.0)
+
+    # cycle 0 interrupted at the bump, cycle 1 restored and finished
+    assert out["cycles"] == 2
+    assert out["steps"] == 6
+    assert latest_step(str(tmp_path)) is not None
+    loss = out["loss"]
+    assert jnp.isfinite(jnp.asarray(loss))
