@@ -17,11 +17,29 @@ ResNet-50 fwd+bwd compile.
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+# Watchdog: if the TPU runtime/tunnel is wedged, backend init can block
+# forever with no exception to catch. Fail loudly instead of hanging the
+# caller — the timeout covers first-compile (~minutes) with slack.
+_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "900"))
+
+
+def _watchdog():
+    time.sleep(_TIMEOUT_S)
+    sys.stderr.write(
+        "bench: exceeded BENCH_TIMEOUT=%.0fs (TPU runtime hung or compile "
+        "runaway); aborting\n" % _TIMEOUT_S)
+    sys.stderr.flush()
+    os._exit(2)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
 
 import jax
 import jax.numpy as jnp

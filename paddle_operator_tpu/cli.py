@@ -166,10 +166,14 @@ def run(client, args) -> int:
             rs = status.get(role)
             if not rs:
                 continue
+            # refs are ObjectReferences (dicts) when controller-written;
+            # tolerate plain strings for hand-edited status
+            names = [r.get("name", "?") if isinstance(r, dict) else str(r)
+                     for r in rs.get("refs", [])]
             print("%-9s ready %s/%s  refs=%s" % (
                 role + ":", rs.get("running", 0),
                 (spec.get(role) or {}).get("replicas", 0),
-                ",".join(rs.get("refs", [])) or "-"))
+                ",".join(names) or "-"))
         return 0
 
     if args.cmd == "delete":

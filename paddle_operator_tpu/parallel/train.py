@@ -32,6 +32,7 @@ def build_train_step(
     seq_axis: Optional[str] = None,
     merge_stats: Optional[Callable] = None,
     grad_clip: Optional[float] = None,
+    accum_steps: int = 1,
 ):
     """Returns (step_fn, sharded_state).
 
@@ -40,16 +41,70 @@ def build_train_step(
       (BatchNorm running stats).
     * state = {"params", "opt"}; ``step_fn(state, batch) -> (state, metrics)``
       with state donated.
+    * ``accum_steps > 1``: gradient accumulation — ``batch`` leaves carry a
+      leading microbatch axis ``[accum_steps, mb, ...]`` (shard specs map the
+      *second* axis to dp); a ``lax.scan`` averages grads over microbatches
+      before one optimizer update, so the effective batch grows without the
+      activation memory.
     """
     # Build the optimizer state under jit: one executable instead of one
     # host->device dispatch per leaf (the tunnel-latency killer on TPU pods).
     state = jax.jit(lambda p: {"params": p, "opt": optimizer.init(p)})(params)
 
-    def step(state, batch):
+    def grads_of(params, batch):
         def lossed(p):
             return loss_fn(p, batch)
 
-        (loss, aux), grads = jax.value_and_grad(lossed, has_aux=True)(state["params"])
+        return jax.value_and_grad(lossed, has_aux=True)(params)
+
+    def accum_grads(params, batch):
+        """Mean loss/grads over the leading microbatch axis via lax.scan.
+
+        Everything lives in the scan CARRY (no stacked ys): grads/loss/aux
+        scalars accumulate by sum, BN "stats" are replaced each microbatch so
+        the last one wins — running stats are not additive, and carrying them
+        avoids materialising accum_steps copies.
+        """
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), params)
+        mb0 = jax.tree_util.tree_map(lambda x: x[0], batch)
+        aux_shape = jax.eval_shape(
+            lambda p, b: grads_of(p, b)[0][1], params, mb0)
+        aux0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), aux_shape)
+
+        def body(carry, mb):
+            gsum, lsum, aux_c = carry
+            (loss, aux), grads = grads_of(params, mb)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            if isinstance(aux, dict):
+                aux_c = {
+                    k: (v if k == "stats"
+                        else jax.tree_util.tree_map(jnp.add, aux_c[k], v))
+                    for k, v in aux.items()
+                }
+            else:
+                aux_c = jax.tree_util.tree_map(jnp.add, aux_c, aux)
+            return (gsum, lsum + loss, aux_c), None
+
+        (gsum, lsum, aux_c), _ = jax.lax.scan(body, (zeros, 0.0, aux0), batch)
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+        if isinstance(aux_c, dict):
+            aux = {
+                k: (v if k == "stats"
+                    else jax.tree_util.tree_map(
+                        lambda x: x / accum_steps, v))
+                for k, v in aux_c.items()
+            }
+        else:
+            aux = jax.tree_util.tree_map(lambda x: x / accum_steps, aux_c)
+        return (lsum / accum_steps, aux), grads
+
+    def step(state, batch):
+        if accum_steps > 1:
+            (loss, aux), grads = accum_grads(state["params"], batch)
+        else:
+            (loss, aux), grads = grads_of(state["params"], batch)
         metrics = {"loss": loss}
         if grad_clip:
             grads, gnorm = clip_by_global_norm(grads, grad_clip)
@@ -70,14 +125,15 @@ def build_train_step(
     state_sh = {"params": param_sh, "opt": opt_sh}
     def batch_spec(leaf):
         nd = getattr(leaf, "ndim", 0)
-        if nd == 0:
+        lead = (None,) if accum_steps > 1 else ()  # microbatch axis: unsharded
+        if nd <= len(lead):
             return P()
-        if seq_axis is not None and nd >= 2:
+        if seq_axis is not None and nd >= 2 + len(lead):
             # sequence/context parallelism: tokens sharded over `sp` too —
             # GSPMD gathers the sequence where attention needs it and keeps
             # embedding/loss work token-sharded.
-            return P(batch_axis, seq_axis)
-        return P(batch_axis)
+            return P(*lead, batch_axis, seq_axis)
+        return P(*lead, batch_axis)
 
     batch_sh = jax.tree_util.tree_map(
         lambda leaf: named(mesh, batch_spec(leaf)), sample_batch

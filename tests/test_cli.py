@@ -57,10 +57,13 @@ def test_submit_list_get_describe_delete(tmp_path, capsys, client):
 
     # simulate controller-populated status, then describe
     obj = client.get(api.KIND, "default", "cli-job")
+    # controller-shaped refs: ObjectReference dicts, not strings
     obj["status"] = {
         "phase": "Running", "mode": "Collective",
         "worker": {"running": 4, "refs": [
-            "cli-job-worker-%d" % i for i in range(4)]},
+            {"apiVersion": "v1", "kind": "Pod",
+             "name": "cli-job-worker-%d" % i, "namespace": "default"}
+            for i in range(4)]},
     }
     client.update_status(obj)
     assert run(client, args(cmd="describe", name="cli-job")) == 0

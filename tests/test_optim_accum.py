@@ -1,0 +1,126 @@
+"""Adafactor / LAMB optimizers + gradient accumulation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_operator_tpu.models import gpt, wide_deep
+from paddle_operator_tpu.ops import optim
+from paddle_operator_tpu.parallel import build_train_step, make_mesh
+
+KEY = jax.random.PRNGKey(0)
+CTR = dict(num_slots=4, vocab_per_slot=50, embed_dim=8, dense_dim=4,
+           hidden=[16])
+
+
+def test_adafactor_factored_state_is_smaller():
+    params = gpt.init(KEY, gpt.TINY_CONFIG)
+    opt = optim.adafactor(1e-2)
+    state = opt.init(params)
+    # the tok embedding (1024x128) must be factored: vr [1024], vc [128]
+    slot = state["v"]["embed"]["tok"]["table"]
+    assert set(slot) == {"vr", "vc"}
+    assert slot["vr"].shape == (1024,)
+    assert slot["vc"].shape == (128,)
+    # 1-D params keep full second moment
+    ln = state["v"]["final_ln"]["scale"]
+    assert set(ln) == {"v"}
+
+
+def test_adafactor_trains():
+    params = gpt.init(KEY, gpt.TINY_CONFIG)
+    batch = gpt.synthetic_batch(KEY, 4, seq_len=32, vocab_size=1024)
+    step, state = build_train_step(
+        gpt.loss_fn, optim.adafactor(3e-2), params, batch)
+    losses = []
+    for _ in range(8):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_lamb_trains_and_trust_bounded():
+    params = wide_deep.init(KEY, CTR)
+    batch = wide_deep.synthetic_batch(KEY, 16, CTR)
+    step, state = build_train_step(
+        wide_deep.loss_fn, optim.lamb(1e-2), params, batch)
+    losses = []
+    for _ in range(6):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_lamb_zero_param_leaf_uses_unit_trust():
+    """Fresh zero-init leaves (p_norm == 0) must still receive updates."""
+    params = {"w": jnp.zeros((4,))}
+    opt = optim.lamb(1e-1, weight_decay=0.0)
+    state = opt.init(params)
+    grads = {"w": jnp.ones((4,))}
+    new_params, _ = opt.update(grads, state, params)
+    assert float(jnp.abs(new_params["w"]).sum()) > 0
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=4 over [4, 2, ...] microbatches == one step on the full
+    batch of 8 (same mean-loss gradients, fp32)."""
+    params = wide_deep.init(KEY, CTR)
+    batch = wide_deep.synthetic_batch(KEY, 8, CTR)
+
+    def loss32(p, b):
+        return wide_deep.loss_fn(p, b, dtype=jnp.float32)
+
+    opt = optim.sgd(0.1, momentum=0.0, weight_decay=0.0)
+    step_full, state_full = build_train_step(loss32, opt, params, batch)
+    state_full, m_full = step_full(state_full, batch)
+
+    micro = jax.tree_util.tree_map(
+        lambda x: x.reshape((4, 2) + x.shape[1:]), batch)
+    step_acc, state_acc = build_train_step(
+        loss32, opt, params, micro, accum_steps=4)
+    state_acc, m_acc = step_acc(state_acc, micro)
+
+    np.testing.assert_allclose(
+        float(m_full["loss"]), float(m_acc["loss"]), rtol=1e-5)
+    flat_full = jax.tree_util.tree_leaves(state_full["params"])
+    flat_acc = jax.tree_util.tree_leaves(state_acc["params"])
+    for a, b in zip(flat_full, flat_acc):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_grad_accumulation_bn_stats_merged():
+    """accum + merge_stats: BN running stats come from the carry (last
+    microbatch), and fold into params."""
+    from paddle_operator_tpu.models import resnet
+    from paddle_operator_tpu.parallel import resnet_rules
+
+    params = resnet.init(KEY, depth=18, num_classes=10)
+    batch = resnet.synthetic_batch(KEY, 4, image_size=32, num_classes=10)
+    micro = jax.tree_util.tree_map(
+        lambda x: x.reshape((2, 2) + x.shape[1:]), batch)
+    step, state = build_train_step(
+        resnet.loss_fn, optim.sgd(0.1), params, micro,
+        accum_steps=2, merge_stats=resnet.merge_stats)
+    state, m = step(state, micro)
+    assert np.isfinite(float(m["loss"]))
+    # running mean moved away from its zero init
+    bn_mean = state["params"]["stem"]["bn"]["mean"]
+    assert float(jnp.abs(bn_mean).sum()) > 0
+
+
+def test_grad_accumulation_sharded():
+    """Accumulation composes with a dp mesh: microbatch axis unsharded,
+    batch axis on dp."""
+    mesh = make_mesh({"dp": 8})
+    params = gpt.init(KEY, gpt.TINY_CONFIG)
+    micro = jax.tree_util.tree_map(
+        lambda x: x.reshape((2, 8) + x.shape[1:]),
+        gpt.synthetic_batch(KEY, 16, seq_len=16, vocab_size=1024))
+    step, state = build_train_step(
+        gpt.loss_fn, optim.adamw(1e-3), params, micro,
+        mesh=mesh, accum_steps=2)
+    state, m = step(state, micro)
+    assert np.isfinite(float(m["loss"]))
