@@ -21,7 +21,10 @@ from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distribut
 from .ops.optim import Optimizer
 from .parallel import build_train_step, make_mesh
 from .parallel.sharding import Rules
-from .utils.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .utils.checkpoint import (
+    latest_step, read_manifest, restore_checkpoint,
+    restore_checkpoint_sharded, save_checkpoint, save_checkpoint_sharded,
+)
 from .utils.trace import profile_steps, tracer
 
 log = logging.getLogger("tpujob.runner")
@@ -40,6 +43,7 @@ class TrainJob:
     seq_axis: Optional[str] = None
     merge_stats: Optional[Callable] = None
     grad_clip: Optional[float] = None
+    accum_steps: int = 1        # >1: make_batch returns [accum, mb, ...]
     total_steps: int = 100
     log_every: int = 10
     checkpoint_every: int = 50
@@ -60,7 +64,38 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
 
     result: Dict[str, Any] = {"cycles": 0}
 
+    def save(step: int, state, epoch: int) -> None:
+        """Multi-host: every process writes its own shards (a full gather of
+        a sharded model is impossible); single-host: worker 0 writes npz."""
+        if jax.process_count() > 1:
+            save_checkpoint_sharded(job.checkpoint_dir, step, state,
+                                    meta={"epoch": epoch})
+        elif cfg.worker_id == 0:
+            save_checkpoint(job.checkpoint_dir, step,
+                            jax.device_get(state), meta={"epoch": epoch})
+
+    def agreed_stop(should_stop: Callable[[], bool]) -> Callable[[], bool]:
+        """Multi-host: the stop decision must be identical on every process
+        at the same step — a divergent view deadlocks (one process enters the
+        checkpoint barrier while another enters the next step's collectives).
+        Process 0's poll is broadcast; all processes call this every step, so
+        the broadcast itself is an aligned collective."""
+        if jax.process_count() == 1:
+            return should_stop
+
+        from jax.experimental import multihost_utils
+
+        import numpy as np
+
+        def agreed() -> bool:  # pragma: no cover - needs real multihost
+            local = should_stop() if jax.process_index() == 0 else False
+            return bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(local)))
+
+        return agreed
+
     def train_cycle(world: int, epoch: int, should_stop: Callable[[], bool]) -> bool:
+        should_stop = agreed_stop(should_stop)
         mesh = make_mesh(job.mesh_axes) if (
             job.mesh_axes or len(jax.devices()) > 1
         ) else None
@@ -78,15 +113,21 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             loss_fn, job.optimizer, params, job.make_batch(rng, 0),
             mesh=mesh, rules=job.rules, seq_axis=job.seq_axis,
             merge_stats=job.merge_stats, grad_clip=job.grad_clip,
+            accum_steps=job.accum_steps,
         )
 
         start_step = 0
         if job.checkpoint_dir and latest_step(job.checkpoint_dir) is not None:
-            restored, manifest = restore_checkpoint(job.checkpoint_dir)
-            state = jax.device_put(
-                restored,
-                jax.tree_util.tree_map(lambda leaf: leaf.sharding, state),
-            )
+            if read_manifest(job.checkpoint_dir).get("format") == "sharded":
+                # shard-wise: each process reads only its devices' blocks
+                state, manifest = restore_checkpoint_sharded(
+                    job.checkpoint_dir, state)
+            else:
+                restored, manifest = restore_checkpoint(job.checkpoint_dir)
+                state = jax.device_put(
+                    restored,
+                    jax.tree_util.tree_map(lambda leaf: leaf.sharding, state),
+                )
             start_step = manifest["step"]
             log.info("restored checkpoint step=%d (epoch %s)",
                      start_step, manifest["meta"].get("epoch"))
@@ -108,19 +149,12 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                     log.info("step %d loss=%.4f steps/s=%.2f",
                              step + 1, loss, rate)
                 if job.checkpoint_dir and (step + 1) % job.checkpoint_every == 0:
-                    if cfg.worker_id == 0:
-                        save_checkpoint(
-                            job.checkpoint_dir, step + 1,
-                            jax.device_get(state), meta={"epoch": epoch},
-                        )
+                    save(step + 1, state, epoch)
                 if should_stop():
                     log.info("membership epoch moved at step %d; restarting",
                              step + 1)
-                    if job.checkpoint_dir and cfg.worker_id == 0:
-                        save_checkpoint(
-                            job.checkpoint_dir, step + 1,
-                            jax.device_get(state), meta={"epoch": epoch},
-                        )
+                    if job.checkpoint_dir:
+                        save(step + 1, state, epoch)
                     return False
                 result["state"] = state
                 result["steps"] = step + 1

@@ -106,6 +106,239 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def save_checkpoint_sharded(ckpt_dir: str, step: int, state: Any,
+                            meta: Optional[dict] = None, keep: int = 3) -> str:
+    """Multi-host-safe save: each process writes only the shards its own
+    devices hold — no host-side full gather (``jax.device_get`` of a sharded
+    array is impossible on multi-host for models bigger than one host).
+
+    Layout: ``step_N/<path>.sNN.npy`` per shard + ``shards.json`` index
+    recording each shard's global-index slices, written by process 0 after a
+    cross-host barrier. Completion is signalled by ``manifest.json`` (same
+    atomicity contract as the npz format: readers key off the manifest).
+    """
+    import jax
+
+    flat = _flatten(state)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    final = os.path.join(ckpt_dir, "step_%012d" % step)
+    # hidden from all_steps (no "step_" prefix); wiped before use so a
+    # crashed prior attempt cannot leak stale shards into this one
+    staging = os.path.join(ckpt_dir, ".partial_step_%012d" % step)
+    if jax.process_count() > 1:  # pragma: no cover - needs real multihost
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0 and os.path.exists(staging):
+            shutil.rmtree(staging)
+        multihost_utils.sync_global_devices("ckpt_staging_clean_%d" % step)
+    elif os.path.exists(staging):
+        shutil.rmtree(staging)
+    os.makedirs(staging, exist_ok=True)
+
+    index: Dict[str, Any] = {}
+    for path, arr in flat.items():
+        safe = path.replace("/", "__")
+        entries = []
+        if hasattr(arr, "addressable_shards"):
+            shards = [s for s in arr.addressable_shards if s.replica_id == 0]
+            shape, dtype = arr.shape, str(arr.dtype)
+        else:  # plain numpy / python leaf: single shard on process 0
+            shards = []
+            shape, dtype = np.asarray(arr).shape, str(np.asarray(arr).dtype)
+            if jax.process_index() == 0:
+                fname = "%s.s0.npy" % safe
+                _save_arr(os.path.join(staging, fname), arr)
+                entries.append({"file": fname, "slices": None})
+        for shard in shards:
+            fname = "%s.s%d.npy" % (safe, shard.device.id)
+            _save_arr(os.path.join(staging, fname), shard.data)
+            entries.append({
+                "file": fname,
+                # replicated dims give slice(None): normalize to full extent
+                "slices": [
+                    [0 if s.start is None else int(s.start),
+                     dim if s.stop is None else int(s.stop)]
+                    for s, dim in zip(shard.index, shape)
+                ],
+            })
+        index[path] = {"shape": list(shape), "dtype": dtype,
+                       "shards": entries}
+
+    if jax.process_count() > 1:  # pragma: no cover - needs real multihost
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ckpt_shards_written_%d" % step)
+        # merge per-process indices: every process wrote disjoint files, so
+        # process 0 re-lists the staging dir is unnecessary — instead each
+        # process writes its partial index and p0 merges
+        part = os.path.join(staging, "index.p%d.json" % jax.process_index())
+        with open(part, "w") as f:
+            json.dump(index, f)
+        multihost_utils.sync_global_devices("ckpt_index_written_%d" % step)
+        if jax.process_index() == 0:
+            merged: Dict[str, Any] = {}
+            for pi in range(jax.process_count()):
+                part = os.path.join(staging, "index.p%d.json" % pi)
+                with open(part) as f:  # missing partial = hard error, not
+                    data = json.load(f)  # a silently thinner checkpoint
+                for k, v in data.items():
+                    if k in merged:
+                        merged[k]["shards"].extend(v["shards"])
+                    else:
+                        merged[k] = v
+                os.remove(part)
+            index = merged
+
+    if jax.process_index() == 0:
+        for entry in index.values():
+            _check_coverage(entry)
+        with open(os.path.join(staging, "shards.json"), "w") as f:
+            json.dump(index, f)
+        # manifest is written INSIDE staging: the rename below atomically
+        # publishes a complete checkpoint (readers key off manifest.json)
+        with open(os.path.join(staging, "manifest.json"), "w") as f:
+            json.dump({"step": step, "structure": _structure(state),
+                       "meta": meta or {}, "format": "sharded"}, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(staging, final)
+        steps = sorted(all_steps(ckpt_dir))
+        for old in steps[:-keep]:
+            shutil.rmtree(os.path.join(ckpt_dir, "step_%012d" % old),
+                          ignore_errors=True)
+    return final
+
+
+def _check_coverage(entry: Dict[str, Any]) -> None:
+    """Shard tiles must exactly tile the full array (assumes disjoint tiles,
+    which distinct replica-0 shards are): catches lost index partials before
+    they become a checkpoint that silently restores zeros."""
+    total = 1
+    for dim in entry["shape"]:
+        total *= dim
+    covered = 0
+    for shard in entry["shards"]:
+        if shard["slices"] is None:
+            covered += total
+            continue
+        vol = 1
+        for a, b in shard["slices"]:
+            vol *= b - a
+        covered += vol
+    if covered != total:
+        raise ValueError(
+            "sharded checkpoint coverage mismatch: %d/%d elements "
+            "(lost shards or overlapping tiles)" % (covered, total))
+
+
+def _save_arr(path: str, a) -> None:
+    """npy write; extension dtypes (bfloat16 etc., numpy kind 'V') round-trip
+    as raw same-width unsigned views — np.load would otherwise hand back
+    uncastable void arrays."""
+    a = np.asarray(a)
+    if a.dtype.kind == "V":
+        a = a.view(np.dtype("u%d" % a.dtype.itemsize))
+    np.save(path, a)
+
+
+def _load_arr(path: str, dtype_str: str):
+    want = np.dtype(dtype_str)
+    data = np.load(path)
+    if data.dtype != want:
+        data = data.view(want)
+    return data
+
+
+def _restore_sharded_leaf(path_dir: str, entry: Dict[str, Any]):
+    _check_coverage(entry)
+    dtype = np.dtype(entry["dtype"])
+    out = np.zeros(tuple(entry["shape"]), dtype)
+    for shard in entry["shards"]:
+        data = _load_arr(os.path.join(path_dir, shard["file"]),
+                         entry["dtype"])
+        if shard["slices"] is None:
+            return data
+        sl = tuple(slice(a, b) for a, b in shard["slices"])
+        out[sl] = data
+    return out
+
+
+def read_manifest(ckpt_dir: str, step: Optional[int] = None) -> dict:
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError("no checkpoints under %s" % ckpt_dir)
+    with open(os.path.join(ckpt_dir, "step_%012d" % step,
+                           "manifest.json")) as f:
+        return json.load(f)
+
+
+def restore_checkpoint_sharded(ckpt_dir: str, target_state: Any,
+                               step: Optional[int] = None) -> Tuple[Any, dict]:
+    """Shard-wise restore into ``target_state``'s shardings — the read-side
+    twin of :func:`save_checkpoint_sharded`: each process materialises only
+    the blocks its own devices need (never a full host copy), assembled from
+    the overlapping saved tiles, so restore works for models bigger than one
+    host and for a DIFFERENT mesh/sharding than the one that saved.
+    """
+    import jax
+
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError("no checkpoints under %s" % ckpt_dir)
+    path = os.path.join(ckpt_dir, "step_%012d" % step)
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != "sharded":
+        raise ValueError("checkpoint at step %d is not sharded format" % step)
+    with open(os.path.join(path, "shards.json")) as f:
+        index = json.load(f)
+
+    flat_t = _flatten(target_state)
+    out_flat: Dict[str, Any] = {}
+    for key, tgt in flat_t.items():
+        entry = index[key]
+        _check_coverage(entry)
+        if not hasattr(tgt, "sharding"):
+            out_flat[key] = _restore_sharded_leaf(path, entry)
+            continue
+        shape = tuple(entry["shape"])
+        cache: Dict[str, Any] = {}
+
+        def tile_data(fname):
+            if fname not in cache:
+                cache[fname] = _load_arr(os.path.join(path, fname),
+                                         entry["dtype"])
+            return cache[fname]
+
+        blocks, devices = [], []
+        for dshard in tgt.addressable_shards:
+            tsl = [(0 if s.start is None else int(s.start),
+                    dim if s.stop is None else int(s.stop))
+                   for s, dim in zip(dshard.index, shape)]
+            block = np.zeros([b - a for a, b in tsl], np.dtype(entry["dtype"]))
+            for tile in entry["shards"]:
+                til = (tile["slices"] if tile["slices"] is not None
+                       else [(0, dim) for dim in shape])
+                inter = [(max(a1, a2), min(b1, b2))
+                         for (a1, b1), (a2, b2) in zip(tsl, til)]
+                if any(a >= b for a, b in inter):
+                    continue
+                data = tile_data(tile["file"])
+                src = tuple(slice(a - ta, b - ta)
+                            for (a, b), (ta, _) in zip(inter, til))
+                dst = tuple(slice(a - qa, b - qa)
+                            for (a, b), (qa, _) in zip(inter, tsl))
+                block[dst] = data[src]
+            blocks.append(jax.device_put(block, dshard.device))
+            devices.append(dshard.device)
+        out_flat[key] = jax.make_array_from_single_device_arrays(
+            shape, tgt.sharding, blocks)
+    state = _unflatten(manifest["structure"], out_flat)
+    return state, manifest
+
+
 def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
                        sharding_tree: Any = None) -> Tuple[Any, dict]:
     """Load (state, manifest). If `sharding_tree` is given (a pytree of
@@ -117,8 +350,13 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
     path = os.path.join(ckpt_dir, "step_%012d" % step)
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
-    with np.load(os.path.join(path, "state.npz")) as npz:
-        flat = {k: npz[k] for k in npz.files}
+    if manifest.get("format") == "sharded":
+        with open(os.path.join(path, "shards.json")) as f:
+            index = json.load(f)
+        flat = {k: _restore_sharded_leaf(path, v) for k, v in index.items()}
+    else:
+        with np.load(os.path.join(path, "state.npz")) as npz:
+            flat = {k: npz[k] for k in npz.files}
     state = _unflatten(manifest["structure"], flat)
     if sharding_tree is not None:
         import jax

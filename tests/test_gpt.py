@@ -129,6 +129,21 @@ def test_sp_ring_attention_model_parity():
                                atol=1e-2, rtol=1e-2)
 
 
+def test_sp_ulysses_attention_model_parity():
+    """GPT through Ulysses all-to-all sp == single-device causal GPT."""
+    from paddle_operator_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    params = gpt.init(KEY, gpt.TINY_CONFIG)   # 4 heads % sp=4 == 0
+    ids = jax.random.randint(KEY, (2, 64), 0, 1024)
+    uly = functools.partial(
+        ulysses_attention, mesh=mesh, axis="sp", causal=True)
+    logits_sp, _ = gpt.apply(params, ids, dtype=jnp.float32, attn_impl=uly)
+    logits_ref, _ = gpt.apply(params, ids, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits_sp), np.asarray(logits_ref),
+                               atol=1e-2, rtol=1e-2)
+
+
 def test_moe_variant_trains():
     params = gpt.init(KEY, gpt.TINY_MOE_CONFIG)
     batch = gpt.synthetic_batch(KEY, 4, seq_len=32, vocab_size=1024)
