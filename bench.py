@@ -4,115 +4,330 @@ North-star metric per BASELINE.md: ResNet-50 images/sec via the job CRD.
 The reference publishes no numbers (BASELINE.json "published": {}), so
 vs_baseline is reported against a nominal target recorded here.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+Prints exactly one JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N, ...}
 
-Dispatch discipline: on TPU pods the host<->device hop can be high-latency,
-so everything here is a handful of jitted calls — params+batch+opt state are
-materialized by single compiled programs, and the timed loop only blocks once
-at the end. A persistent compilation cache makes repeat runs skip the big
-ResNet-50 fwd+bwd compile.
+Architecture (post round-1 hang): a PARENT process that never imports jax
+(so it cannot hang) supervises a CHILD subprocess that does the actual
+benchmark. The child emits `BENCH_STAGE <name>` markers on stderr as it
+enters each stage; the parent enforces a per-stage deadline and an overall
+budget, kills a wedged child, and retries down a batch ladder
+(256 -> 64 -> 8). Backend/interpreter-startup hangs (the round-1 failure:
+the TPU claim stalled before `jax.devices()` returned) are retried once,
+then the parent falls back to the CPU backend so a real -- honestly
+labelled -- number exists either way. On total failure it still emits a
+JSON line with `stage_reached` so the BENCH artifact localizes the hang.
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-
-# Watchdog: if the TPU runtime/tunnel is wedged, backend init can block
-# forever with no exception to catch. Fail loudly instead of hanging the
-# caller — the timeout covers first-compile (~minutes) with slack.
-_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "900"))
-
-
-def _watchdog():
-    time.sleep(_TIMEOUT_S)
-    sys.stderr.write(
-        "bench: exceeded BENCH_TIMEOUT=%.0fs (TPU runtime hung or compile "
-        "runaway); aborting\n" % _TIMEOUT_S)
-    sys.stderr.flush()
-    os._exit(2)
-
-
-threading.Thread(target=_watchdog, daemon=True).start()
-
-import jax
-import jax.numpy as jnp
-from functools import partial
-
-from paddle_operator_tpu.models import resnet
-from paddle_operator_tpu.ops import optim
-from paddle_operator_tpu.parallel import build_train_step, make_mesh, resnet_rules
-
 # No published reference number exists; use a nominal single-v5e-chip target
 # so vs_baseline is meaningful across rounds (v5e ~197 bf16 TFLOP/s; ResNet-50
 # fwd+bwd ~12.4 GFLOP/image at 224^2 => ~50% MXU utilization target).
 NOMINAL_TARGET_IMAGES_PER_SEC = 800.0
 
-BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
-WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+# Per-stage deadlines (seconds). `child_up` covers interpreter start incl.
+# the axon sitecustomize TPU claim -- the exact spot round 1 wedged.
+STAGE_DEADLINES = {
+    "child_up": float(os.environ.get("BENCH_T_STARTUP", "150")),
+    "backend_init": float(os.environ.get("BENCH_T_BACKEND", "150")),
+    "canary": float(os.environ.get("BENCH_T_CANARY", "120")),
+    "model_init": float(os.environ.get("BENCH_T_INIT", "120")),
+    "compile_warmup": float(os.environ.get("BENCH_T_COMPILE", "360")),
+    "measure": float(os.environ.get("BENCH_T_MEASURE", "180")),
+}
+
+STAGE_MARK = "BENCH_STAGE "
 
 
 def _log(msg):
-    print(msg, file=sys.stderr, flush=True)
+    print("bench: " + msg, file=sys.stderr, flush=True)
 
 
-def main():
+# ---------------------------------------------------------------------------
+# Child: the actual benchmark. Runs in a subprocess; stderr carries staged
+# progress markers so the parent can localize a hang and kill precisely.
+# ---------------------------------------------------------------------------
+
+def _stage(name):
+    print(STAGE_MARK + name, file=sys.stderr, flush=True)
+
+
+def child_main():
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    _stage("backend_init")
+    import jax
+
+    # The image's sitecustomize force-registers the TPU plugin and pins
+    # JAX_PLATFORMS in the environment; jax.config.update before the first
+    # backend touch is the only override that sticks (same trick as
+    # tests/conftest.py).
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    from functools import partial
+
     n_dev = len(jax.devices())
-    _log("bench: %d device(s), backend=%s" % (n_dev, jax.default_backend()))
-    mesh = make_mesh({"dp": n_dev}) if n_dev > 1 else None
+    backend = jax.default_backend()
+    _log("%d device(s), backend=%s" % (n_dev, backend))
 
-    # One compiled program builds params + synthetic batch on-device.
+    _stage("canary")
     t0 = time.perf_counter()
-    make = jax.jit(partial(_make, BATCH, IMAGE))
-    params, batch = make(jax.random.PRNGKey(0))
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    _log("canary matmul in %.1fs" % (time.perf_counter() - t0))
+
+    from paddle_operator_tpu.models import resnet
+    from paddle_operator_tpu.ops import optim
+    from paddle_operator_tpu.parallel import (
+        build_train_step, make_mesh, resnet_rules)
+
+    _stage("model_init")
+    mesh = make_mesh({"dp": n_dev}) if n_dev > 1 else None
+    t0 = time.perf_counter()
+    make = jax.jit(partial(_make, batch, IMAGE))
+    params, batch_data = make(jax.random.PRNGKey(0))
     jax.block_until_ready(params["head"]["fc"]["kernel"])
-    _log("bench: init in %.1fs" % (time.perf_counter() - t0))
+    _log("init in %.1fs" % (time.perf_counter() - t0))
 
     opt = optim.sgd(
         optim.cosine_schedule(0.1, 1000, 50), momentum=0.9,
         weight_decay=1e-4, wd_mask=optim.make_wd_mask(params),
     )
     step, state = build_train_step(
-        resnet.loss_fn, opt, params, batch,
+        resnet.loss_fn, opt, params, batch_data,
         mesh=mesh, rules=resnet_rules(), merge_stats=resnet.merge_stats,
     )
 
+    _stage("compile_warmup")
     t0 = time.perf_counter()
     for _ in range(WARMUP):
-        state, metrics = step(state, batch)
+        state, metrics = step(state, batch_data)
     jax.block_until_ready(metrics["loss"])
-    _log("bench: warmup (%d steps incl. compile) in %.1fs"
+    _log("warmup (%d steps incl. compile) in %.1fs"
          % (WARMUP, time.perf_counter() - t0))
 
+    _stage("measure")
     t0 = time.perf_counter()
     for _ in range(STEPS):
-        state, metrics = step(state, batch)
+        state, metrics = step(state, batch_data)
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
 
-    images_per_sec = BATCH * STEPS / dt
+    images_per_sec = batch * STEPS / dt
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / NOMINAL_TARGET_IMAGES_PER_SEC, 4),
+        "backend": backend,
+        "batch": batch,
+        "step_ms": round(1000.0 * dt / STEPS, 2),
     }))
+    sys.stdout.flush()
 
 
 def _make(batch_size, image_size, key):
+    import jax
+    from paddle_operator_tpu.models import resnet
     kp, kb = jax.random.split(key)
     params = resnet.init(kp, depth=50, num_classes=1000)
     batch = resnet.synthetic_batch(kb, batch_size, image_size=image_size)
     return params, batch
 
 
+# ---------------------------------------------------------------------------
+# Parent: jax-free supervisor.
+# ---------------------------------------------------------------------------
+
+class _Attempt:
+    def __init__(self, batch, platform=None, steps=None, warmup=None):
+        self.batch = batch
+        self.platform = platform
+        self.steps = steps
+        self.warmup = warmup
+        self.stage = "child_up"
+        self.stage_t = time.monotonic()
+        self.stdout_lines = []
+        self.result = None  # parsed JSON from child
+        self.outcome = None  # "ok" | "killed:<stage>" | "exit:<rc>"
+
+
+def _run_attempt(att, budget_s):
+    env = os.environ.copy()
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_BATCH"] = str(att.batch)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    if att.platform:
+        env["BENCH_PLATFORM"] = att.platform
+        if att.platform == "cpu":
+            # Bypass the image's sitecustomize TPU registration entirely: it
+            # is gated on PALLAS_AXON_POOL_IPS and lives on the injected
+            # PYTHONPATH entry, and its TPU claim can wedge interpreter
+            # startup (the round-1 hang) before any in-process override runs.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon" not in p)
+    if att.steps is not None:
+        env["BENCH_STEPS"] = str(att.steps)
+    if att.warmup is not None:
+        env["BENCH_WARMUP"] = str(att.warmup)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env, start_new_session=True,
+    )
+
+    def read_stderr():
+        for line in proc.stderr:
+            line = line.rstrip("\n")
+            if line.startswith(STAGE_MARK):
+                att.stage = line[len(STAGE_MARK):].strip()
+                att.stage_t = time.monotonic()
+                _log("stage -> %s (batch=%d%s)" % (
+                    att.stage, att.batch,
+                    ", platform=%s" % att.platform if att.platform else ""))
+            else:
+                print(line, file=sys.stderr, flush=True)
+
+    def read_stdout():
+        for line in proc.stdout:
+            att.stdout_lines.append(line.strip())
+
+    t_err = threading.Thread(target=read_stderr, daemon=True)
+    t_out = threading.Thread(target=read_stdout, daemon=True)
+    t_err.start()
+    t_out.start()
+
+    t_start = time.monotonic()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        now = time.monotonic()
+        in_stage = now - att.stage_t
+        deadline = STAGE_DEADLINES.get(att.stage, 180.0)
+        if in_stage > deadline or (now - t_start) > budget_s:
+            why = ("stage '%s' exceeded %.0fs" % (att.stage, deadline)
+                   if in_stage > deadline
+                   else "attempt exceeded budget %.0fs" % budget_s)
+            _log("killing child: " + why)
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            att.outcome = "killed:" + att.stage
+            return att
+        time.sleep(0.5)
+
+    t_err.join(timeout=5)
+    t_out.join(timeout=5)
+    for line in att.stdout_lines:
+        if line.startswith("{"):
+            try:
+                att.result = json.loads(line)
+            except ValueError:
+                pass
+    if rc == 0 and att.result is not None:
+        att.outcome = "ok"
+    else:
+        att.outcome = "exit:%d" % rc
+    return att
+
+
+def parent_main():
+    total_budget = float(os.environ.get("BENCH_TIMEOUT", "840"))
+    t_start = time.monotonic()
+    first_batch = int(os.environ.get("BENCH_BATCH", "256"))
+    ladder = [b for b in (first_batch, 64, 8) if b <= first_batch]
+    ladder = sorted(set(ladder), reverse=True)
+
+    attempts = []
+    startup_retries = 1  # one extra chance for a transient TPU-claim stall
+
+    def remaining():
+        return total_budget - (time.monotonic() - t_start)
+
+    i = 0
+    while i < len(ladder):
+        batch = ladder[i]
+        if remaining() < 60:
+            _log("out of budget before attempt (batch=%d)" % batch)
+            break
+        att = _run_attempt(_Attempt(batch), min(remaining() - 20, 600))
+        attempts.append(att)
+        if att.outcome == "ok":
+            _emit(att.result, attempts)
+            return
+        _log("attempt failed: %s (batch=%d)" % (att.outcome, att.batch))
+        # Classify by the stage reached, not by killed-vs-exited: batch size
+        # is irrelevant to a backend that won't even initialize.
+        stuck_pre_compute = att.stage in ("child_up", "backend_init")
+        if stuck_pre_compute and startup_retries > 0:
+            startup_retries -= 1
+            time.sleep(5)  # let the relay/claim settle before re-dialing
+            continue  # same rung
+        if stuck_pre_compute:
+            break  # TPU unreachable; go to CPU fallback
+        i += 1  # compute-side trouble: smaller batch
+
+    # CPU fallback: an honestly-labelled number beats no number.
+    if os.environ.get("BENCH_CPU_FALLBACK", "1") == "1" and remaining() > 90:
+        _log("falling back to CPU backend")
+        # CPU ResNet-50 runs ~seconds/step; a short measured window is all
+        # the budget allows and all the honesty requires.
+        att = _run_attempt(
+            _Attempt(int(os.environ.get("BENCH_CPU_BATCH", "16")),
+                     platform="cpu", steps=2, warmup=1),
+            min(remaining() - 10, 420))
+        attempts.append(att)
+        if att.outcome == "ok":
+            res = dict(att.result)
+            res["note"] = "TPU backend unavailable; CPU fallback"
+            _emit(res, attempts)
+            return
+
+    # Total failure: still emit one parseable JSON line localizing the hang.
+    last = attempts[-1] if attempts else None
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": 0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "stage_reached": last.stage if last else "none",
+        "attempts": [
+            {"batch": a.batch, "platform": a.platform or "tpu",
+             "outcome": a.outcome} for a in attempts],
+    }))
+
+
+def _emit(result, attempts):
+    if len(attempts) > 1:
+        result = dict(result)
+        result["attempts"] = [
+            {"batch": a.batch, "platform": a.platform or "tpu",
+             "outcome": a.outcome} for a in attempts]
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        child_main()
+    else:
+        parent_main()
