@@ -1,0 +1,230 @@
+"""HTTP startup-coordination channel — the production release mechanism.
+
+The reference releases pods in role order by SPDY-exec'ing ``touch goon``
+into each coordination init container (``paddlejob_controller.go:491-518``,
+wired at ``:308-330``). SPDY exec needs a full client-go transport stack; this
+operator inverts the direction instead: each coordination init container
+**pulls** its release decision from an HTTP endpoint the operator serves.
+
+Properties the exec push lacked:
+
+* **Stateless** — the decision is recomputed from job + pod state per request,
+  so operator restarts, pod restarts, and requeue storms all converge; there
+  is no release bit to lose.
+* **Stdlib-only on both ends** — the operator side is ``http.server``, the pod
+  side is busybox ``wget`` (same init image the reference uses).
+* **No pods/exec RBAC needed** for the startup path.
+
+Release semantics match the reference exactly: roles are released in
+``get_resource_order()`` order (ps -> worker -> heter); a role is released
+only when every earlier role is fully Running; and the first role is held
+until every pod's coordination container is live, so the whole gang is
+scheduled before anyone starts.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from ..api import types as api
+from ..k8s.client import KubeClient
+from ..k8s.errors import ApiError, NotFoundError
+from . import helper
+
+log = logging.getLogger("tpujob.coordination")
+
+RELEASE_PATH_PREFIX = "/coordination/v1/release/"
+FRONTIER_PATH_PREFIX = "/coordination/v1/frontier/"
+RELEASE_URL_ENV = "TPUJOB_RELEASE_URL"
+
+
+def release_url(base_url: str, namespace: str, job_name: str, pod_name: str) -> str:
+    return "%s%s%s/%s/%s" % (
+        base_url.rstrip("/"), RELEASE_PATH_PREFIX, namespace, job_name, pod_name
+    )
+
+
+def compute_release(
+    job: api.TpuJob, child_pods: List[dict], pod_name: str
+) -> Tuple[bool, str]:
+    """Decide whether ``pod_name`` may start its main containers.
+
+    Pure function of job + pod state; returns (decision, reason). Mirrors the
+    reference's role-ordered exec loop (paddlejob_controller.go:308-330) as a
+    per-pod predicate.
+    """
+    pod = None
+    for p in child_pods:
+        if p["metadata"]["name"] == pod_name:
+            pod = p
+            break
+    if pod is None:
+        return False, "pod not found among job children"
+    res = pod["metadata"].get("annotations", {}).get(api.ANNOT_RESOURCE)
+    if not res:
+        return False, "pod has no resource annotation"
+
+    order = job.get_resource_order()
+    specs = job.get_specs()
+    if res not in order:
+        return False, "unknown role %r" % res
+
+    running = {r: 0 for r in order}
+    for p in child_pods:
+        r = p["metadata"].get("annotations", {}).get(api.ANNOT_RESOURCE)
+        if r in running and helper.is_pod_real_running(p):
+            running[r] += 1
+
+    # Every earlier role must be fully Running before this role goes.
+    first_role = next(r for r in order if specs.get(r) is not None)
+    for r in order:
+        if r == res:
+            break
+        spec = specs.get(r)
+        if spec is not None and running[r] < spec["replicas"]:
+            return False, "waiting for role %s (%d/%d running)" % (
+                r, running[r], spec["replicas"]
+            )
+
+    # Gang gate for the first role: hold until every pod's coordination
+    # container is live, so the full slice is scheduled before rank 0 starts
+    # (reference's i==0 && running==0 && !allCoordRunning guard).
+    if res == first_role and running[first_role] == 0:
+        expected = sum(
+            s["replicas"] for s in specs.values() if s is not None
+        )
+        live = 0
+        for p in child_pods:
+            if helper.is_coord_container_running(p) or helper.is_pod_real_running(p):
+                live += 1
+        if live < expected:
+            return False, "gang assembling (%d/%d coordination containers live)" % (
+                live, expected
+            )
+
+    return True, "released"
+
+
+def frontier_state(job: api.TpuJob, child_pods: List[dict]) -> dict:
+    """Debug view: per-role running counts + the current release frontier."""
+    order = job.get_resource_order()
+    specs = job.get_specs()
+    running = {r: 0 for r in order}
+    for p in child_pods:
+        r = p["metadata"].get("annotations", {}).get(api.ANNOT_RESOURCE)
+        if r in running and helper.is_pod_real_running(p):
+            running[r] += 1
+    frontier = None
+    for r in order:
+        spec = specs.get(r)
+        if spec is not None and running[r] < spec["replicas"]:
+            frontier = r
+            break
+    return {
+        "order": [r for r in order if specs.get(r) is not None],
+        "running": {r: running[r] for r in order if specs.get(r) is not None},
+        "frontier": frontier,
+    }
+
+
+class CoordinationServer:
+    """Serves release decisions over HTTP from a KubeClient's view of the
+    world. One instance per manager; share-nothing per request."""
+
+    def __init__(self, client: KubeClient, bind: str = ":8082"):
+        self.client = client
+        host, _, port = bind.rpartition(":")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                outer._handle(self)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "CoordinationServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="coordination"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return "http://127.0.0.1:%d" % self.port
+
+    # -- request handling ----------------------------------------------
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path
+        if path.startswith(RELEASE_PATH_PREFIX):
+            parts = path[len(RELEASE_PATH_PREFIX):].strip("/").split("/")
+            if len(parts) != 3:
+                self._send(req, 404, "expected /release/{ns}/{job}/{pod}\n")
+                return
+            ns, job_name, pod_name = parts
+            try:
+                obj = self.client.get(api.KIND, ns, job_name)
+                job = api.TpuJob(obj)
+                pods = self.client.list_owned("Pod", obj)
+            except NotFoundError:
+                self._send(req, 404, "job not found\n")
+                return
+            except ApiError as e:
+                self._send(req, 500, "apiserver error: %s\n" % e)
+                return
+            ok, reason = compute_release(job, pods, pod_name)
+            if ok:
+                self._send(req, 200, "go\n")
+            else:
+                # 503 + Retry-After: busybox wget exits nonzero, the init
+                # container loop sleeps and re-polls.
+                self._send(req, 503, reason + "\n", retry_after="1")
+            return
+        if path.startswith(FRONTIER_PATH_PREFIX):
+            parts = path[len(FRONTIER_PATH_PREFIX):].strip("/").split("/")
+            if len(parts) != 2:
+                self._send(req, 404, "expected /frontier/{ns}/{job}\n")
+                return
+            ns, job_name = parts
+            try:
+                obj = self.client.get(api.KIND, ns, job_name)
+                job = api.TpuJob(obj)
+                pods = self.client.list_owned("Pod", obj)
+            except NotFoundError:
+                self._send(req, 404, "job not found\n")
+                return
+            body = json.dumps(frontier_state(job, pods)) + "\n"
+            self._send(req, 200, body, ctype="application/json")
+            return
+        self._send(req, 404, "not found\n")
+
+    @staticmethod
+    def _send(req, code: int, body: str, ctype: str = "text/plain",
+              retry_after: Optional[str] = None) -> None:
+        data = body.encode()
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(data)))
+        if retry_after:
+            req.send_header("Retry-After", retry_after)
+        req.end_headers()
+        try:
+            req.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass

@@ -44,6 +44,13 @@ COORD_CONTAINER_CMD = [
     "sh", "-c",
     "while true; do if [ -f goon ]; then exit 0; else sleep 0.1; fi; done",
 ]
+# HTTP-pull variant (production): poll the operator's coordination endpoint
+# until it answers 200, then exit 0 so the main containers start. busybox
+# wget exits nonzero on 503, so the loop is a plain retry.
+COORD_CONTAINER_HTTP_CMD = [
+    "sh", "-c",
+    'until wget -q -T 2 -O /dev/null "$TPUJOB_RELEASE_URL"; do sleep 1; done',
+]
 
 TPU_RESOURCE = "google.com/tpu"
 GKE_TPU_ACCEL_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
@@ -490,17 +497,25 @@ def construct_service_for_pod(pod: dict, device: str = api.Device.CPU) -> dict:
     return svc
 
 
-def gen_coordinate_init_container(image: str) -> dict:
-    """Busybox gate container released by the operator (reference :379-394)."""
-    return {
+def gen_coordinate_init_container(image: str, release_url: str = "") -> dict:
+    """Busybox gate container (reference :379-394).
+
+    With ``release_url`` (production) the container polls the operator's HTTP
+    coordination endpoint until released; without it, the legacy file gate the
+    operator pokes via exec (fake-client harness parity).
+    """
+    c = {
         "name": COORD_CONTAINER_NAME,
         "image": image,
         "imagePullPolicy": "IfNotPresent",
-        "command": list(COORD_CONTAINER_CMD),
+        "command": list(COORD_CONTAINER_HTTP_CMD if release_url else COORD_CONTAINER_CMD),
         "resources": {
             "requests": {"cpu": COORD_CONTAINER_CPU, "memory": COORD_CONTAINER_MEM}
         },
     }
+    if release_url:
+        c["env"] = [{"name": "TPUJOB_RELEASE_URL", "value": release_url}]
+    return c
 
 
 # ---------------------------------------------------------------------------

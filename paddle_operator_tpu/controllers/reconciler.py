@@ -46,6 +46,7 @@ class TpuJobReconciler:
         init_image: str = "docker.io/library/busybox:1",
         port_allocator: Optional[PortRangeAllocator] = None,
         kv_store: Optional[KVStore] = None,
+        coordination_url: str = "",
     ):
         self.client = client
         self.recorder = recorder or EventRecorder(client, "tpujob-controller")
@@ -53,6 +54,12 @@ class TpuJobReconciler:
         self.init_image = init_image
         self.ports = port_allocator
         self.kv = kv_store
+        # Base URL of the operator's HTTP coordination endpoint (see
+        # controllers/coordination.py). When set, coord init containers pull
+        # their release decision over HTTP and the exec channel is never
+        # used; when empty, the legacy exec-push release applies (fake-client
+        # harness parity only — HttpKubeClient cannot exec).
+        self.coordination_url = coordination_url
 
     # ------------------------------------------------------------------
     # main loop
@@ -290,8 +297,12 @@ class TpuJobReconciler:
         pod = helper.construct_pod(job, res_type, idx)
 
         if self.init_image:
+            url = ""
+            if self.coordination_url:
+                from .coordination import release_url
+                url = release_url(self.coordination_url, job.namespace, job.name, name)
             pod["spec"].setdefault("initContainers", []).append(
-                helper.gen_coordinate_init_container(self.init_image)
+                helper.gen_coordinate_init_container(self.init_image, url)
             )
 
         if self.scheduling == helper.SCHEDULER_VOLCANO and not helper.without_volcano(job):
@@ -320,8 +331,22 @@ class TpuJobReconciler:
         return True
 
     def _coordinate_startup(self, job, child_pods, specs, statuses) -> Result:
-        """Release roles in order (ps → worker → heter) by exec'ing the gate
-        file into coord containers (reference :308-330)."""
+        """Release roles in order (ps → worker → heter), reference :308-330.
+
+        HTTP mode (production): release is pull-based — each coord init
+        container polls the coordination endpoint, whose decision is a pure
+        function of current pod state — so this method only keeps the requeue
+        cadence while Starting (status freshness drives the frontier forward).
+        Exec mode (fake-client harness): push the gate file per pass.
+        """
+        if self.coordination_url:
+            for res in job.get_resource_order():
+                st = statuses.get(res)
+                if specs.get(res) is not None and (
+                    st is None or st.get("running", 0) < specs[res]["replicas"]
+                ):
+                    return Result(requeue_after=1.0)
+            return Result()
         order = job.get_resource_order()
         for i, res in enumerate(order):
             st = statuses.get(res)

@@ -17,6 +17,8 @@ Running) and handles the operator's exec calls ("touch goon").
 
 from __future__ import annotations
 
+import urllib.error
+import urllib.request
 from typing import Dict, Optional
 
 from .errors import NotFoundError
@@ -91,10 +93,28 @@ class PodSimulator:
             self._write(ns, name, new_status)
             return True
 
-        has_coord = any(
-            c.get("name") == self.coord_name
-            for c in pod["spec"].get("initContainers", [])
+        coord = next(
+            (c for c in pod["spec"].get("initContainers", [])
+             if c.get("name") == self.coord_name),
+            None,
         )
+        has_coord = coord is not None
+        if has_coord and not self._released.get(name):
+            # HTTP-pull variant: the container polls TPUJOB_RELEASE_URL until
+            # the operator's coordination endpoint answers 200. Simulate one
+            # poll per lifecycle step over real HTTP.
+            url = next(
+                (e.get("value") for e in coord.get("env", []) or []
+                 if e.get("name") == "TPUJOB_RELEASE_URL"),
+                None,
+            )
+            if url:
+                try:
+                    with urllib.request.urlopen(url, timeout=2) as resp:
+                        if resp.status == 200:
+                            self._released[name] = True
+                except (urllib.error.URLError, OSError):
+                    pass
         coord_released = self._released.get(name, False) or not has_coord
 
         if phase == "Pending":

@@ -16,7 +16,10 @@ import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import os
+
 from .api import types as api
+from .controllers.coordination import CoordinationServer
 from .controllers.hostport import PortRangeAllocator
 from .controllers.reconciler import TpuJobReconciler
 from .elastic.store import connect as kv_connect
@@ -43,6 +46,13 @@ def main(argv=None):
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--metrics-bind-address", default=":8080")
     ap.add_argument("--health-probe-bind-address", default=":8081")
+    ap.add_argument("--coordination-bind-address", default=":8082",
+                    help="bind for the HTTP startup-release endpoint "
+                         "('' disables; falls back to legacy exec release)")
+    ap.add_argument("--coordination-url", default="",
+                    help="base URL pods use to reach the coordination "
+                         "endpoint; default derives from "
+                         "$COORD_SERVICE_NAME.$POD_NAMESPACE.svc")
     ap.add_argument("--kube-api", default=None, help="apiserver URL override")
     ap.add_argument("--insecure-skip-tls-verify", action="store_true")
     args = ap.parse_args(argv)
@@ -61,12 +71,27 @@ def main(argv=None):
     start, end = (int(p) for p in args.port_range.split(","))
     kv = kv_connect(args.membership) if args.membership else None
 
+    coord_srv = None
+    coord_url = args.coordination_url
+    if args.coordination_bind_address:
+        coord_srv = CoordinationServer(client, args.coordination_bind_address)
+        coord_srv.start()
+        if not coord_url:
+            # In-cluster default: the operator's coordination Service FQDN
+            # (deploy/v1/operator.yaml publishes these env vars). The port is
+            # the SERVICE port, which is independent of the container bind.
+            svc = os.environ.get("COORD_SERVICE_NAME", "tpujob-operator-coord")
+            ns = os.environ.get("POD_NAMESPACE", "tpujob-system")
+            port = os.environ.get("COORD_SERVICE_PORT", "8082")
+            coord_url = "http://%s.%s.svc:%s" % (svc, ns, port)
+
     reconciler = TpuJobReconciler(
         client,
         scheduling=args.scheduling,
         init_image=args.init_image,
         port_allocator=PortRangeAllocator(start, end),
         kv_store=kv,
+        coordination_url=coord_url,
     )
     mgr = Manager(
         client,
@@ -122,6 +147,8 @@ def main(argv=None):
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     mgr.stop()
+    if coord_srv is not None:
+        coord_srv.stop()
     return 0
 
 

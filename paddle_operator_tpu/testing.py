@@ -29,6 +29,7 @@ class OperatorHarness:
         port_range=(35000, 65000),
         auto_admit_podgroups: bool = True,
         namespace: Optional[str] = None,
+        http_coordination: bool = False,
     ):
         self.client = FakeKubeClient()
         self.client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
@@ -38,12 +39,22 @@ class OperatorHarness:
             coord_container_name=helper.COORD_CONTAINER_NAME,
         )
         self.kv = kv_store if kv_store is not None else MemoryKVStore()
+        # Production release channel: a real CoordinationServer on localhost;
+        # the pod simulator polls it over real HTTP like the init container.
+        self.coord_server = None
+        coord_url = ""
+        if http_coordination:
+            from .controllers.coordination import CoordinationServer
+
+            self.coord_server = CoordinationServer(self.client, ":0").start()
+            coord_url = self.coord_server.url
         self.reconciler = TpuJobReconciler(
             self.client,
             scheduling=scheduling,
             init_image=init_image,
             port_allocator=PortRangeAllocator(*port_range),
             kv_store=self.kv,
+            coordination_url=coord_url,
         )
         self.manager = Manager(self.client, namespace=namespace)
         self.controller = self.manager.add_controller(
@@ -54,6 +65,10 @@ class OperatorHarness:
             owner_api_version=api.API_VERSION,
             owner_kind=api.KIND,
         )
+
+    def close(self) -> None:
+        if self.coord_server is not None:
+            self.coord_server.stop()
 
     # -- convenience -----------------------------------------------------
 

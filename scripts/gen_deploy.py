@@ -126,17 +126,39 @@ def operator_manifests(namespace=NAMESPACE, image=IMAGE, jobnamespace=""):
                             "httpGet": {"path": "/readyz", "port": 8081},
                             "initialDelaySeconds": 5, "periodSeconds": 10,
                         },
-                        "ports": [{"containerPort": 8080, "name": "metrics"}],
+                        "ports": [{"containerPort": 8080, "name": "metrics"},
+                                  {"containerPort": 8082, "name": "coordination"}],
+                        "env": [
+                            {"name": "POD_NAMESPACE", "valueFrom": {
+                                "fieldRef": {"fieldPath": "metadata.namespace"}}},
+                            {"name": "COORD_SERVICE_NAME",
+                             "value": "tpujob-operator-coord"},
+                        ],
                     }],
                 },
             },
         },
     }
 
+    # Job pods reach the startup-release endpoint (controllers/coordination.py)
+    # through this Service from any namespace; replaces the reference's
+    # pods/exec push channel.
+    coord_service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "tpujob-operator-coord", "namespace": namespace,
+                     "labels": {"control-plane": "tpujob-operator"}},
+        "spec": {
+            "selector": {"control-plane": "tpujob-operator"},
+            "ports": [{"name": "coordination", "port": 8082,
+                       "targetPort": 8082}],
+        },
+    }
+
     namespace_obj = {"apiVersion": "v1", "kind": "Namespace",
                      "metadata": {"name": namespace}}
     return [namespace_obj, sa, cluster_role, binding, leader_role,
-            leader_binding, deployment]
+            leader_binding, coord_service, deployment]
 
 
 def dump_all(objs):

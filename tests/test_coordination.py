@@ -1,0 +1,234 @@
+"""HTTP startup-coordination channel tests.
+
+The production release path (controllers/coordination.py): coord init
+containers pull their release decision from the operator's HTTP endpoint
+instead of the reference's SPDY exec push (paddlejob_controller.go:491-518).
+Covers the pure decision function, the live HTTP server, and full-lifecycle
+convergence with the pod simulator polling over real HTTP — with zero
+exec calls.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import coordination, helper
+from paddle_operator_tpu.testing import OperatorHarness
+
+
+def role_spec(replicas):
+    return {
+        "replicas": replicas,
+        "template": {"spec": {"containers": [{"name": "main", "image": "img"}]}},
+    }
+
+
+def make_job(ps=2, workers=2):
+    job = api.new_tpujob("wd", spec={
+        "ps": role_spec(ps), "worker": role_spec(workers),
+    })
+    job["metadata"]["namespace"] = "default"
+    return api.TpuJob(job)
+
+
+def make_pod(name, role, coord_running=False, running=False):
+    pod = {
+        "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": "default",
+            "annotations": {api.ANNOT_RESOURCE: role},
+        },
+        "spec": {"containers": [{"name": "main"}]},
+        "status": {},
+    }
+    if coord_running:
+        pod["status"]["initContainerStatuses"] = [
+            {"name": helper.COORD_CONTAINER_NAME, "state": {"running": {}}}
+        ]
+        pod["status"]["phase"] = "Pending"
+    if running:
+        pod["status"] = {
+            "phase": "Running",
+            "containerStatuses": [
+                {"name": "main", "ready": True, "state": {"running": {}}}
+            ],
+        }
+    return pod
+
+
+# ---------------------------------------------------------------------------
+# pure decision function
+# ---------------------------------------------------------------------------
+
+class TestComputeRelease:
+    def test_worker_blocked_until_ps_running(self):
+        job = make_job(ps=1, workers=1)
+        pods = [
+            make_pod("wd-ps-0", "ps", coord_running=True),
+            make_pod("wd-worker-0", "worker", coord_running=True),
+        ]
+        ok, reason = coordination.compute_release(job, pods, "wd-worker-0")
+        assert not ok and "waiting for role ps" in reason
+
+    def test_first_role_held_until_gang_assembled(self):
+        job = make_job(ps=2, workers=1)
+        pods = [
+            make_pod("wd-ps-0", "ps", coord_running=True),
+            # wd-ps-1 and the worker not scheduled yet
+        ]
+        ok, reason = coordination.compute_release(job, pods, "wd-ps-0")
+        assert not ok and "gang assembling" in reason
+
+    def test_first_role_released_when_gang_assembled(self):
+        job = make_job(ps=2, workers=1)
+        pods = [
+            make_pod("wd-ps-0", "ps", coord_running=True),
+            make_pod("wd-ps-1", "ps", coord_running=True),
+            make_pod("wd-worker-0", "worker", coord_running=True),
+        ]
+        ok, _ = coordination.compute_release(job, pods, "wd-ps-0")
+        assert ok
+
+    def test_worker_released_once_ps_fully_running(self):
+        job = make_job(ps=2, workers=2)
+        pods = [
+            make_pod("wd-ps-0", "ps", running=True),
+            make_pod("wd-ps-1", "ps", running=True),
+            make_pod("wd-worker-0", "worker", coord_running=True),
+            make_pod("wd-worker-1", "worker", coord_running=True),
+        ]
+        ok, _ = coordination.compute_release(job, pods, "wd-worker-0")
+        assert ok
+
+    def test_worker_blocked_while_one_ps_starting(self):
+        job = make_job(ps=2, workers=1)
+        pods = [
+            make_pod("wd-ps-0", "ps", running=True),
+            make_pod("wd-ps-1", "ps", coord_running=True),
+            make_pod("wd-worker-0", "worker", coord_running=True),
+        ]
+        ok, reason = coordination.compute_release(job, pods, "wd-worker-0")
+        assert not ok and "1/2" in reason
+
+    def test_unknown_pod_denied(self):
+        job = make_job()
+        ok, reason = coordination.compute_release(job, [], "nope")
+        assert not ok and "not found" in reason
+
+    def test_collective_single_role_gang_gate(self):
+        job = api.TpuJob(api.new_tpujob("res", spec={"worker": role_spec(2)}))
+        pods = [make_pod("res-worker-0", "worker", coord_running=True)]
+        ok, reason = coordination.compute_release(job, pods, "res-worker-0")
+        assert not ok and "gang assembling" in reason
+        pods.append(make_pod("res-worker-1", "worker", coord_running=True))
+        ok, _ = coordination.compute_release(job, pods, "res-worker-0")
+        assert ok
+
+
+# ---------------------------------------------------------------------------
+# live HTTP server + end-to-end convergence through real HTTP polling
+# ---------------------------------------------------------------------------
+
+def http_status(url):
+    try:
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_ps_job_converges_via_http_release_without_exec():
+    h = OperatorHarness(http_coordination=True)
+    try:
+        h.create_job(api.new_tpujob("wd", spec={
+            "ps": role_spec(2), "worker": role_spec(2), "intranet": "Service",
+        }))
+        h.converge()
+
+        job = h.get_job("wd")
+        assert job.phase == api.Phase.RUNNING
+        # every coord init container carried a release URL...
+        for pod in h.pods():
+            coord = next(
+                c for c in pod["spec"]["initContainers"]
+                if c["name"] == helper.COORD_CONTAINER_NAME
+            )
+            env = {e["name"]: e["value"] for e in coord.get("env", [])}
+            assert env["TPUJOB_RELEASE_URL"].startswith(h.coord_server.url)
+            assert coord["command"] == helper.COORD_CONTAINER_HTTP_CMD
+        # ...and the exec channel was never touched.
+        assert h.client.exec_calls == []
+    finally:
+        h.close()
+
+
+def test_tpu_collective_converges_via_http_release():
+    h = OperatorHarness(http_coordination=True)
+    try:
+        h.create_job(api.new_tpujob("bert", spec={
+            "device": "tpu",
+            "tpu": {"accelerator": "v5e", "topology": "4x8"},
+            "worker": role_spec(4),
+        }))
+        h.converge()
+        assert h.get_job("bert").phase == api.Phase.RUNNING
+        assert h.client.exec_calls == []
+    finally:
+        h.close()
+
+
+def test_release_endpoint_answers_http_semantics():
+    h = OperatorHarness(http_coordination=True)
+    try:
+        h.create_job(api.new_tpujob("wd", spec={
+            "ps": role_spec(1), "worker": role_spec(1),
+        }))
+        # run controller only (no kubelet): pods exist but nothing is live
+        h.manager.drain()
+
+        base = h.coord_server.url
+        # worker blocked -> 503
+        code, body = http_status(
+            coordination.release_url(base, "default", "wd", "wd-worker-0"))
+        assert code == 503
+        # unknown job -> 404
+        code, _ = http_status(
+            coordination.release_url(base, "default", "nope", "p"))
+        assert code == 404
+        # malformed path -> 404
+        code, _ = http_status(base + "/coordination/v1/release/onlyns")
+        assert code == 404
+
+        # frontier debug endpoint
+        code, body = http_status(
+            base + "/coordination/v1/frontier/default/wd")
+        assert code == 200
+        state = json.loads(body)
+        assert state["frontier"] == "ps"
+        assert state["running"] == {"ps": 0, "worker": 0}
+
+        # let the world converge; then the frontier clears and pods release
+        h.converge()
+        code, body = http_status(
+            base + "/coordination/v1/frontier/default/wd")
+        assert json.loads(body)["frontier"] is None
+        code, _ = http_status(
+            coordination.release_url(base, "default", "wd", "wd-worker-0"))
+        assert code == 200
+    finally:
+        h.close()
+
+
+def test_legacy_exec_mode_still_converges():
+    """Without a coordination URL the harness keeps the exec-push channel
+    (interface parity with the reference; FakeKubeClient implements exec)."""
+    h = OperatorHarness(http_coordination=False)
+    h.create_job(api.new_tpujob("wd", spec={
+        "ps": role_spec(1), "worker": role_spec(1),
+    }))
+    h.converge()
+    assert h.get_job("wd").phase == api.Phase.RUNNING
+    assert len(h.client.exec_calls) > 0
