@@ -138,7 +138,6 @@ def build_train_step(
     batch_sh = jax.tree_util.tree_map(
         lambda leaf: named(mesh, batch_spec(leaf)), sample_batch
     )
-    metric_sh = named(mesh, P())
 
     step_fn = jax.jit(
         step,

@@ -117,13 +117,19 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         )
 
         start_step = 0
-        if job.checkpoint_dir and latest_step(job.checkpoint_dir) is not None:
-            if read_manifest(job.checkpoint_dir).get("format") == "sharded":
+        # resolve the step ONCE: a checkpoint published between two
+        # latest_step() calls must not mix one step's manifest with another's
+        resume_step = (latest_step(job.checkpoint_dir)
+                       if job.checkpoint_dir else None)
+        if resume_step is not None:
+            if read_manifest(job.checkpoint_dir,
+                             resume_step).get("format") == "sharded":
                 # shard-wise: each process reads only its devices' blocks
                 state, manifest = restore_checkpoint_sharded(
-                    job.checkpoint_dir, state)
+                    job.checkpoint_dir, state, step=resume_step)
             else:
-                restored, manifest = restore_checkpoint(job.checkpoint_dir)
+                restored, manifest = restore_checkpoint(
+                    job.checkpoint_dir, step=resume_step)
                 state = jax.device_put(
                     restored,
                     jax.tree_util.tree_map(lambda leaf: leaf.sharding, state),

@@ -206,6 +206,15 @@ def save_checkpoint_sharded(ckpt_dir: str, step: int, state: Any,
         for old in steps[:-keep]:
             shutil.rmtree(os.path.join(ckpt_dir, "step_%012d" % old),
                           ignore_errors=True)
+    if jax.process_count() > 1:  # pragma: no cover - needs real multihost
+        from jax.experimental import multihost_utils
+
+        # Publish barrier: without it a non-zero process can return from the
+        # index barrier above, call latest_step() on shared storage while p0
+        # is still mid-rename/prune, and restore a DIFFERENT step than its
+        # peers — a collective desync. After this barrier every process sees
+        # the final dir and the pruned listing.
+        multihost_utils.sync_global_devices("ckpt_published_%d" % step)
     return final
 
 

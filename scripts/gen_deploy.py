@@ -39,7 +39,8 @@ def operator_manifests(namespace=NAMESPACE, image=IMAGE, jobnamespace=""):
             {"apiGroups": [""], "resources": ["pods"],
              "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
             {"apiGroups": [""], "resources": ["pods/status"], "verbs": ["get"]},
-            {"apiGroups": [""], "resources": ["pods/exec"], "verbs": ["get", "create"]},
+            # no pods/exec: the HTTP coordination channel replaced the
+            # reference's exec push (controllers/coordination.py)
             {"apiGroups": [""], "resources": ["services"],
              "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
             {"apiGroups": [""], "resources": ["services/status"], "verbs": ["get"]},

@@ -282,3 +282,43 @@ def test_sharded_save_wipes_stale_staging(tmp_path):
 def test_checkpoint_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         restore_checkpoint(str(tmp_path / "none"))
+
+
+def test_sharded_save_publish_barrier_after_rename(tmp_path, monkeypatch):
+    """Multi-host publish race (advisor medium): the final cross-host barrier
+    must fire AFTER process 0 renames staging->final, so a non-zero process
+    that calls latest_step() on shared storage after save_checkpoint_sharded
+    returns cannot observe a mid-publish directory and restore a different
+    step than its peers.
+
+    Simulated 2-process run: process_count/index and sync_global_devices are
+    stubbed; each barrier records whether the final dir was visible yet.
+    """
+    import numpy as np
+
+    from jax.experimental import multihost_utils
+    from paddle_operator_tpu.utils.checkpoint import save_checkpoint_sharded
+
+    final = tmp_path / "step_000000000003"
+    barriers = []
+
+    def fake_sync(name):
+        if name.startswith("ckpt_index_written"):
+            # peer process "wrote" its (empty) index partial at this barrier
+            staging = tmp_path / ".partial_step_000000000003"
+            (staging / "index.p1.json").write_text("{}")
+        barriers.append((name, final.exists()))
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices", fake_sync)
+
+    state = {"w": np.arange(6, dtype=np.float32)}
+    save_checkpoint_sharded(str(tmp_path), 3, state)
+
+    names = [n for n, _ in barriers]
+    assert names[-1] == "ckpt_published_3"
+    # every pre-publish barrier ran before the final dir existed; the
+    # publish barrier ran after the rename made it visible
+    assert all(not seen for n, seen in barriers[:-1])
+    assert barriers[-1][1] is True
