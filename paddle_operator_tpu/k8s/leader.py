@@ -1,0 +1,232 @@
+"""Lease-based leader election.
+
+The reference delegates this to controller-runtime (``main.go:93-94``,
+``LeaderElection: enableLeaderElection`` with lease id
+``b2a304f2.paddlepaddle.org``), which uses client-go's leaderelection
+package. This module implements the same algorithm against the
+:class:`~paddle_operator_tpu.k8s.client.KubeClient` dict API:
+
+* A candidate never steals an **unexpired** lease. Expiry is judged with the
+  candidate's *local* clock from the moment it first observed the current
+  lease record (client-go's ``observedTime``) — never by comparing the
+  holder's ``renewTime`` to local time, which would break under clock skew.
+* The holder renews at ``retry_period`` (< duration/3 by default); if it
+  cannot renew for ``renew_deadline`` seconds it **steps down**: stops
+  reporting leadership and invokes ``on_stopped_leading`` so the caller can
+  halt its workers.
+* Takeover and renewal both go through ``update`` carrying the lease's
+  ``resourceVersion``, so two candidates racing resolve via optimistic
+  concurrency (exactly one wins; the loser backs off).
+* Graceful shutdown can ``release()`` the lease (empty ``holderIdentity``)
+  so a successor acquires immediately instead of waiting out the duration.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import math
+import threading
+import time
+from typing import Callable, Optional
+
+from .client import KubeClient
+from .errors import AlreadyExistsError, ApiError, ConflictError, NotFoundError
+from .objects import deep_copy, new_object
+
+log = logging.getLogger("tpujob.leader")
+
+DEFAULT_LEASE_NAME = "tpujob-operator-lock"
+
+
+def _iso(ts: float) -> str:
+    return (
+        datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+class LeaderElector:
+    """One candidate's view of one Lease. Thread-compatible: the renewal
+    loop runs on its own thread; ``is_leader`` is safe to read anywhere."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        identity: str,
+        lease_name: str = DEFAULT_LEASE_NAME,
+        namespace: str = "default",
+        lease_duration: float = 15.0,
+        renew_deadline: float = 10.0,
+        retry_period: float = 2.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        if not (retry_period < renew_deadline < lease_duration):
+            raise ValueError(
+                "need retry_period < renew_deadline < lease_duration, got "
+                "%s < %s < %s" % (retry_period, renew_deadline, lease_duration)
+            )
+        self.client = client
+        self.identity = identity
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        # Lease.spec.leaseDurationSeconds is an integer field: never write 0
+        # for a fractional duration — a conforming peer would read an
+        # instantly-expired lease and steal it from a live holder
+        self._advertised_duration = max(1, int(math.ceil(lease_duration)))
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self._clock = clock
+        self._is_leader = False
+        # the lease spec as last observed + the local time of first
+        # observation of that exact record (client-go observedRecord/Time)
+        self._observed_spec: Optional[dict] = None
+        self._observed_time: float = 0.0
+
+    # -- state ---------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def _observe(self, spec: dict, now: float) -> None:
+        if spec != self._observed_spec:
+            self._observed_spec = deep_copy(spec)
+            self._observed_time = now
+
+    # -- the core step -------------------------------------------------
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election step. Returns True iff we hold the lease after it."""
+        now = self._clock()
+        try:
+            lease = self.client.get("Lease", self.namespace, self.lease_name)
+        except NotFoundError:
+            lease = new_object(
+                "coordination.k8s.io/v1", "Lease", self.lease_name, self.namespace
+            )
+            lease["spec"] = {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": self._advertised_duration,
+                "acquireTime": _iso(now),
+                "renewTime": _iso(now),
+                "leaseTransitions": 0,
+            }
+            try:
+                created = self.client.create(lease)
+            except (AlreadyExistsError, ApiError):
+                return False
+            self._observe(created["spec"], now)
+            self._is_leader = True
+            log.info("%s: acquired fresh lease %s", self.identity, self.lease_name)
+            return True
+        except ApiError as e:
+            log.warning("%s: lease get failed: %s", self.identity, e)
+            return self._is_leader and self._within_renew_deadline(now)
+
+        spec = lease.get("spec", {}) or {}
+        self._observe(spec, now)
+        holder = spec.get("holderIdentity") or ""
+        duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
+
+        if holder and holder != self.identity:
+            # Someone else holds it: only contend once the record has gone
+            # stale for a full duration ON OUR CLOCK since we first saw it.
+            if now < self._observed_time + duration:
+                self._is_leader = False
+                return False
+            log.info(
+                "%s: lease held by %s expired (unrenewed for %.1fs); taking over",
+                self.identity, holder, now - self._observed_time,
+            )
+
+        new_spec = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": self._advertised_duration,
+            "renewTime": _iso(now),
+        }
+        if holder == self.identity:
+            new_spec["acquireTime"] = spec.get("acquireTime", _iso(now))
+            new_spec["leaseTransitions"] = spec.get("leaseTransitions", 0)
+        else:
+            new_spec["acquireTime"] = _iso(now)
+            new_spec["leaseTransitions"] = int(spec.get("leaseTransitions", 0)) + 1
+        lease["spec"] = new_spec
+        try:
+            self.client.update(lease)  # resourceVersion carried: CAS
+        except (ConflictError, NotFoundError):
+            return False  # lost the race; re-observe next step
+        except ApiError as e:
+            log.warning("%s: lease update failed: %s", self.identity, e)
+            return self._is_leader and self._within_renew_deadline(now)
+        became = not self._is_leader or holder != self.identity
+        self._observe(new_spec, now)
+        self._is_leader = True
+        if became and holder != self.identity:
+            log.info("%s: became leader of %s", self.identity, self.lease_name)
+        return True
+
+    def _within_renew_deadline(self, now: float) -> bool:
+        """While the apiserver is flaky, a current holder keeps leading until
+        its own record is renew_deadline stale — then it must step down."""
+        ok = now < self._observed_time + self.renew_deadline
+        if not ok:
+            self._is_leader = False
+        return ok
+
+    # -- blocking loops ------------------------------------------------
+
+    def acquire(self, stop: Optional[threading.Event] = None) -> bool:
+        """Block until we are leader (True) or ``stop`` is set (False)."""
+        while stop is None or not stop.is_set():
+            if self.try_acquire_or_renew():
+                return True
+            if stop is None:
+                time.sleep(self.retry_period)
+            elif stop.wait(self.retry_period):
+                return False
+        return False
+
+    def run_renewal(
+        self,
+        stop: threading.Event,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Renew every ``retry_period`` until ``stop`` or leadership is lost.
+
+        Loss means either (a) another candidate's identity shows up on the
+        lease, or (b) we failed to renew for ``renew_deadline`` seconds.
+        Either way the callback fires exactly once and the loop exits.
+        """
+        last_renew = self._clock()
+        while not stop.wait(self.retry_period):
+            if self.try_acquire_or_renew():
+                last_renew = self._clock()
+                continue
+            if not self._is_leader or (
+                self._clock() - last_renew >= self.renew_deadline
+            ):
+                self._is_leader = False
+                log.error("%s: leadership lost; stepping down", self.identity)
+                if on_stopped_leading is not None:
+                    on_stopped_leading()
+                return
+
+    def release(self) -> None:
+        """Give up the lease on graceful shutdown so a successor doesn't
+        have to wait out the lease duration (client-go ReleaseOnCancel)."""
+        if not self._is_leader:
+            return
+        self._is_leader = False
+        try:
+            lease = self.client.get("Lease", self.namespace, self.lease_name)
+            if (lease.get("spec", {}) or {}).get("holderIdentity") != self.identity:
+                return
+            lease["spec"]["holderIdentity"] = ""
+            lease["spec"]["renewTime"] = _iso(self._clock())
+            self.client.update(lease)
+            log.info("%s: released lease %s", self.identity, self.lease_name)
+        except ApiError:
+            pass  # best effort; the lease will expire on its own

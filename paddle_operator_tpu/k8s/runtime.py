@@ -113,6 +113,7 @@ class Controller:
         self.name = name
         self.reconcile = reconcile
         self.queue = WorkQueue()
+        self.for_kind = ""  # primary kind; set by Manager.add_controller
         self.max_retries = max_retries
         self._failures: Dict[Tuple[str, str], int] = {}
         self.metrics = {"reconcile_total": 0, "reconcile_errors_total": 0,
@@ -178,12 +179,27 @@ class Manager:
     """Hosts controllers; wires watches; optional leader election."""
 
     def __init__(self, client: KubeClient, leader_election: bool = False,
-                 leader_identity: str = "", namespace: Optional[str] = None):
+                 leader_identity: str = "", namespace: Optional[str] = None,
+                 lease_name: str = "tpujob-operator-lock",
+                 lease_duration: float = 15.0, renew_deadline: float = 10.0,
+                 retry_period: float = 2.0,
+                 on_lost_lease: Optional[Callable[[], None]] = None):
         self.client = client
         self.namespace = namespace
         self.controllers: List[Controller] = []
         self.leader_election = leader_election
         self.leader_identity = leader_identity or ("mgr-%d" % id(self))
+        self.elector = None
+        if leader_election:
+            from .leader import LeaderElector
+
+            self.elector = LeaderElector(
+                client, identity=self.leader_identity, lease_name=lease_name,
+                namespace=namespace or "default",
+                lease_duration=lease_duration, renew_deadline=renew_deadline,
+                retry_period=retry_period,
+            )
+        self.on_lost_lease = on_lost_lease
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -197,6 +213,7 @@ class Manager:
         owner_kind: str = "",
     ) -> Controller:
         ctrl = Controller(name, reconcile)
+        ctrl.for_kind = for_kind
         ctrl.watch(self.client, for_kind, self_key_mapper, self.namespace)
         for kind in owns or []:
             ctrl.watch(
@@ -230,15 +247,41 @@ class Manager:
         return ran
 
     def enqueue_all(self) -> None:
-        """Seed queues with every primary object (initial list)."""
+        """Seed every controller's queue with its primary objects — the
+        initial-list replay a fresh informer performs on startup (and what a
+        new leader does after failover so jobs mutated during the previous
+        leader's reign converge)."""
         for ctrl in self.controllers:
-            pass  # primary kind not tracked per-controller; callers use drain after create
+            if not ctrl.for_kind:
+                continue
+            try:
+                objs = self.client.list(ctrl.for_kind, self.namespace)
+            except Exception as e:
+                log.warning("enqueue_all: list %s failed: %s", ctrl.for_kind, e)
+                continue
+            for obj in objs:
+                key = self_key_mapper(obj)
+                if key[1]:
+                    ctrl.queue.add(key)
 
     # -- threaded mode (production) ------------------------------------
 
     def start(self) -> None:
-        if self.leader_election:
-            self._acquire_leadership()
+        """Blocks on leadership (if enabled), then starts workers. On a lost
+        lease all workers halt and ``on_lost_lease`` fires (reference:
+        controller-runtime exits the binary; main.py wires that)."""
+        if self.elector is not None:
+            if not self.elector.acquire(self._stop):
+                return  # stopped before winning
+            # a failed-over leader must reconcile everything it missed
+            self.enqueue_all()
+            t = threading.Thread(
+                target=self.elector.run_renewal,
+                args=(self._stop, self._lost_leadership),
+                daemon=True, name="lease-renewal",
+            )
+            t.start()
+            self._threads.append(t)
         for ctrl in self.controllers:
             t = threading.Thread(
                 target=self._worker, args=(ctrl,), daemon=True,
@@ -247,56 +290,28 @@ class Manager:
             t.start()
             self._threads.append(t)
 
+    def _lost_leadership(self) -> None:
+        self._stop.set()  # halt all workers: we no longer own the objects
+        if self.on_lost_lease is not None:
+            self.on_lost_lease()
+
     def _worker(self, ctrl: Controller) -> None:
         while not self._stop.is_set():
             ctrl.queue.promote_due()
             key = ctrl.queue.pop(timeout=0.2)
-            if key is not None:
+            # re-check after the blocking pop: a deposed leader must not
+            # reconcile work that arrived while it was being stopped
+            if key is not None and not self._stop.is_set():
                 ctrl.process_one(key)
 
-    def stop(self) -> None:
+    def stop(self, release_lease: bool = True) -> None:
+        """Graceful shutdown. ``release_lease=False`` models a crash (the
+        lease is left to expire; used by failover tests)."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
-
-    # -- leader election (Lease-based, reference: main.go:93-94) -------
-
-    def _acquire_leadership(self, lease_name: str = "tpujob-operator-lock",
-                            lease_seconds: int = 15) -> None:
-        from .errors import AlreadyExistsError, ConflictError, NotFoundError
-        from .objects import new_object, now_iso
-
-        ns = self.namespace or "default"
-        while not self._stop.is_set():
-            try:
-                lease = self.client.get("Lease", ns, lease_name)
-                holder = lease.get("spec", {}).get("holderIdentity")
-                if holder == self.leader_identity:
-                    break
-                renew = lease.get("spec", {}).get("renewTime", "")
-                # crude expiry check: if we can't parse, contend anyway
-                lease["spec"] = {
-                    "holderIdentity": self.leader_identity,
-                    "leaseDurationSeconds": lease_seconds,
-                    "renewTime": now_iso(),
-                }
-                try:
-                    self.client.update(lease)
-                    break
-                except ConflictError:
-                    time.sleep(2)
-            except NotFoundError:
-                lease = new_object("coordination.k8s.io/v1", "Lease", lease_name, ns)
-                lease["spec"] = {
-                    "holderIdentity": self.leader_identity,
-                    "leaseDurationSeconds": lease_seconds,
-                    "renewTime": now_iso(),
-                }
-                try:
-                    self.client.create(lease)
-                    break
-                except AlreadyExistsError:
-                    continue
+        if self.elector is not None and release_lease:
+            self.elector.release()
 
     # -- metrics -------------------------------------------------------
 

@@ -93,10 +93,23 @@ def main(argv=None):
         kv_store=kv,
         coordination_url=coord_url,
     )
+    stop = threading.Event()
+    exit_code = [0]
+
+    def lost_lease():
+        # a deposed leader must not keep mutating pods it no longer owns;
+        # controller-runtime exits the binary here — so do we (workers are
+        # already halted by the Manager before this fires)
+        log.error("leader lease lost; shutting down")
+        exit_code[0] = 1
+        stop.set()
+
     mgr = Manager(
         client,
         leader_election=args.leader_elect,
         namespace=args.namespace or None,
+        leader_identity=os.environ.get("POD_NAME", ""),
+        on_lost_lease=lost_lease,
     )
     mgr.add_controller(
         "tpujob", reconciler.reconcile,
@@ -140,16 +153,17 @@ def main(argv=None):
 
     log.info("starting manager (scheduling=%r, membership=%r)",
              args.scheduling, args.membership)
-    mgr.start()
-
-    stop = threading.Event()
+    # handlers BEFORE start(): with --leader-elect a standby replica blocks
+    # in start() on lease acquisition and must still die gracefully
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
+    mgr.start()
+
     stop.wait()
-    mgr.stop()
+    mgr.stop()  # releases the lease so a successor takes over immediately
     if coord_srv is not None:
         coord_srv.stop()
-    return 0
+    return exit_code[0]
 
 
 if __name__ == "__main__":

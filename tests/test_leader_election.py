@@ -1,0 +1,261 @@
+"""Leader election (k8s/leader.py) — the semantics controller-runtime gives
+the reference for free (main.go:93-94): never steal an unexpired lease,
+renew continuously, step down on renewal failure, failover after expiry.
+
+Fake-clock tests drive try_acquire_or_renew directly (deterministic);
+the two-Manager tests run the real threaded loops with sub-second leases.
+"""
+
+import threading
+import time
+
+import pytest
+
+from paddle_operator_tpu.k8s.errors import ApiError
+from paddle_operator_tpu.k8s.fake import FakeKubeClient
+from paddle_operator_tpu.k8s.leader import LeaderElector
+from paddle_operator_tpu.k8s.runtime import Manager
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def elector(client, ident, clock, **kw):
+    kw.setdefault("lease_duration", 15.0)
+    kw.setdefault("renew_deadline", 10.0)
+    kw.setdefault("retry_period", 2.0)
+    return LeaderElector(client, identity=ident, clock=clock, **kw)
+
+
+# -- fake-clock core semantics ------------------------------------------
+
+
+def test_fresh_lease_acquired_and_populated():
+    c, clk = FakeKubeClient(), Clock()
+    a = elector(c, "a", clk)
+    assert a.try_acquire_or_renew()
+    assert a.is_leader
+    spec = c.get("Lease", "default", "tpujob-operator-lock")["spec"]
+    assert spec["holderIdentity"] == "a"
+    assert spec["leaseDurationSeconds"] == 15
+    assert spec["leaseTransitions"] == 0
+    assert spec["renewTime"] and spec["acquireTime"]
+
+
+def test_stale_candidate_never_steals_unexpired_lease():
+    c, clk = FakeKubeClient(), Clock()
+    a, b = elector(c, "a", clk), elector(c, "b", clk)
+    assert a.try_acquire_or_renew()
+    # b contends repeatedly inside the lease duration: always refused
+    for dt in (0.0, 5.0, 9.0):
+        clk.advance(dt)
+        assert not b.try_acquire_or_renew()
+        assert not b.is_leader
+    spec = c.get("Lease", "default", "tpujob-operator-lock")["spec"]
+    assert spec["holderIdentity"] == "a"
+
+
+def test_takeover_after_expiry_increments_transitions():
+    c, clk = FakeKubeClient(), Clock()
+    a, b = elector(c, "a", clk), elector(c, "b", clk)
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()  # observe the record at t0
+    clk.advance(15.1)  # a never renewed: expired on b's clock
+    assert b.try_acquire_or_renew()
+    assert b.is_leader
+    spec = c.get("Lease", "default", "tpujob-operator-lock")["spec"]
+    assert spec["holderIdentity"] == "b"
+    assert spec["leaseTransitions"] == 1
+
+
+def test_renewal_resets_other_candidates_expiry_countdown():
+    """b's expiry countdown must restart whenever the observed record
+    changes — judging by the holder's renewTime timestamp instead would
+    break under clock skew (the client-go observedTime rule)."""
+    c, clk = FakeKubeClient(), Clock()
+    a, b = elector(c, "a", clk), elector(c, "b", clk)
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()
+    clk.advance(10.0)
+    assert a.try_acquire_or_renew()  # renew at t+10
+    clk.advance(6.0)  # t+16: past the ORIGINAL expiry, not the renewed one
+    assert not b.try_acquire_or_renew()
+    clk.advance(15.1)  # now a full duration since the renewal b observed
+    assert b.try_acquire_or_renew()
+
+
+def test_release_allows_immediate_takeover():
+    c, clk = FakeKubeClient(), Clock()
+    a, b = elector(c, "a", clk), elector(c, "b", clk)
+    assert a.try_acquire_or_renew()
+    a.release()
+    assert not a.is_leader
+    assert b.try_acquire_or_renew()  # no waiting out the duration
+    assert b.is_leader
+
+
+def test_update_race_resolved_by_resource_version():
+    """Two candidates both see an expired lease; optimistic concurrency
+    lets exactly one win the takeover update."""
+    c, clk = FakeKubeClient(), Clock()
+    a = elector(c, "a", clk)
+    assert a.try_acquire_or_renew()
+    b1, b2 = elector(c, "b1", clk), elector(c, "b2", clk)
+    assert not b1.try_acquire_or_renew()
+    assert not b2.try_acquire_or_renew()
+    clk.advance(20.0)
+    r1 = b1.try_acquire_or_renew()
+    r2 = b2.try_acquire_or_renew()  # sees b1's fresh record -> refused
+    assert (r1, r2) == (True, False)
+    assert b1.is_leader and not b2.is_leader
+
+
+def test_holder_steps_down_when_apiserver_unreachable():
+    """A leader that cannot renew past renew_deadline must stop claiming
+    leadership even though nobody else took the lease."""
+    c, clk = FakeKubeClient(), Clock()
+    a = elector(c, "a", clk)
+    assert a.try_acquire_or_renew()
+
+    real_get = c.get
+
+    def broken_get(*args, **kw):
+        raise ApiError("apiserver down")
+
+    c.get = broken_get
+    clk.advance(5.0)
+    assert a.try_acquire_or_renew()  # within renew_deadline: keep leading
+    assert a.is_leader
+    clk.advance(6.0)  # 11s since last good observation > 10s deadline
+    assert not a.try_acquire_or_renew()
+    assert not a.is_leader
+    c.get = real_get
+
+
+def test_bad_timing_config_rejected():
+    with pytest.raises(ValueError):
+        LeaderElector(FakeKubeClient(), identity="x",
+                      lease_duration=5.0, renew_deadline=5.0, retry_period=1.0)
+
+
+# -- two managers, threaded: exactly one reconciles; failover ------------
+
+
+def _mk_job(client, name):
+    client.register_kind("batch.test/v1", "TestJob", "testjobs")
+    client.create({
+        "apiVersion": "batch.test/v1", "kind": "TestJob",
+        "metadata": {"name": name, "namespace": "default"},
+    })
+
+
+def _manager(client, ident, seen, **kw):
+    mgr = Manager(client, leader_election=True, leader_identity=ident,
+                  lease_duration=0.8, renew_deadline=0.5, retry_period=0.1,
+                  **kw)
+
+    def reconcile(ns, name):
+        seen.append((ident, name))
+        return None
+
+    mgr.add_controller("test", reconcile, for_kind="TestJob")
+    return mgr
+
+
+def test_two_managers_exactly_one_reconciles_then_failover():
+    client = FakeKubeClient()
+    seen = []
+    m1 = _manager(client, "m1", seen)
+    m2 = _manager(client, "m2", seen)
+
+    m1.start()  # wins the fresh lease immediately
+    t2 = threading.Thread(target=m2.start, daemon=True)
+    t2.start()  # blocks in acquire while m1 holds
+
+    _mk_job(client, "job-a")
+    deadline = time.time() + 5
+    while not any(n == "job-a" for _, n in seen) and time.time() < deadline:
+        time.sleep(0.02)
+    assert ("m1", "job-a") in seen
+    assert not any(who == "m2" for who, _ in seen), \
+        "standby manager must not reconcile while m1 holds the lease"
+
+    # m1 crashes WITHOUT releasing: m2 must take over only after expiry
+    m1.stop(release_lease=False)
+    crash_t = time.time()
+    _mk_job(client, "job-b")  # mutated during the interregnum
+    deadline = time.time() + 10
+    while not any(who == "m2" for who, _ in seen) and time.time() < deadline:
+        time.sleep(0.02)
+    waited = time.time() - crash_t
+    assert any(who == "m2" and n == "job-b" for who, n in seen), \
+        "m2 never reconciled after failover: %r" % seen
+    # enqueue_all on takeover replays pre-existing objects too
+    deadline = time.time() + 5
+    while not any(who == "m2" and n == "job-a" for who, n in seen) \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert any(who == "m2" and n == "job-a" for who, n in seen)
+    assert waited >= 0.3, \
+        "m2 stole the lease before expiry (%.2fs < lease_duration)" % waited
+    spec = client.get("Lease", "default", "tpujob-operator-lock")["spec"]
+    assert spec["holderIdentity"] == "m2"
+    assert spec["leaseTransitions"] >= 1
+    m2.stop()
+    t2.join(timeout=5)
+
+
+def test_graceful_stop_releases_and_successor_takes_over_fast():
+    client = FakeKubeClient()
+    seen = []
+    m1 = _manager(client, "m1", seen)
+    m2 = _manager(client, "m2", seen)
+    m1.start()
+    t2 = threading.Thread(target=m2.start, daemon=True)
+    t2.start()
+    time.sleep(0.25)  # let m2 observe m1's record
+    m1.stop()  # graceful: releases the lease
+    t0 = time.time()
+    deadline = time.time() + 5
+    while not m2.elector.is_leader and time.time() < deadline:
+        time.sleep(0.02)
+    assert m2.elector.is_leader
+    # released lease is taken on the next retry tick, well under a duration
+    assert time.time() - t0 < 0.8
+    m2.stop()
+    t2.join(timeout=5)
+
+
+def test_lost_lease_halts_workers_and_fires_callback():
+    """If another identity appears on the lease (e.g. the holder was
+    network-partitioned and someone took over), the deposed manager must
+    stop reconciling and fire on_lost_lease."""
+    client = FakeKubeClient()
+    seen, lost = [], threading.Event()
+    m1 = _manager(client, "m1", seen, on_lost_lease=lost.set)
+    m1.start()
+    _mk_job(client, "job-a")
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        time.sleep(0.02)
+    assert seen
+
+    # usurper writes itself onto the lease (partition heals the other way)
+    lease = client.get("Lease", "default", "tpujob-operator-lock")
+    lease["spec"]["holderIdentity"] = "usurper"
+    client.update(lease)
+
+    assert lost.wait(5), "on_lost_lease never fired"
+    before = list(seen)
+    _mk_job(client, "job-c")
+    time.sleep(0.5)
+    assert seen == before, "deposed manager kept reconciling"
+    m1.stop()

@@ -170,10 +170,10 @@ def test_leader_election_lease():
     client = FakeKubeClient()
     m1 = Manager(client, leader_election=True, leader_identity="a",
                  namespace="default")
-    m1._acquire_leadership()
+    assert m1.elector.try_acquire_or_renew()
     lease = client.get("Lease", "default", "tpujob-operator-lock")
     assert lease["spec"]["holderIdentity"] == "a"
-    # same identity re-acquires trivially
-    m1._acquire_leadership()
+    # same identity re-acquires (renews) trivially
+    assert m1.elector.try_acquire_or_renew()
     assert client.get("Lease", "default", "tpujob-operator-lock")["spec"][
         "holderIdentity"] == "a"
