@@ -20,7 +20,10 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Iterator, List, Optional, Tuple
 
-from .errors import AlreadyExistsError, ApiError, ConflictError, NotFoundError
+from .errors import (
+    AlreadyExistsError, ApiError, ConflictError, GoneError, NotFoundError,
+    UnauthorizedError,
+)
 
 # kind -> (api prefix, plural).  Core v1 kinds plus the CRDs we manage.
 _BUILTIN_ROUTES = {
@@ -63,7 +66,9 @@ class KubeClient:
         raise NotImplementedError
 
     def watch(
-        self, kind: str, namespace: Optional[str] = None
+        self, kind: str, namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        timeout_seconds: int = 300,
     ) -> "Iterator[Tuple[str, dict]]":
         raise NotImplementedError
 
@@ -131,6 +136,33 @@ class EventRecorder:
             self._client.create(ev)
         except ApiError:
             pass  # events are best-effort
+
+
+def _map_http_error(e: "urllib.error.HTTPError") -> ApiError:
+    """HTTPError -> the ApiError taxonomy, preferring the apimachinery
+    Status `reason` over status-code guessing (409 is both AlreadyExists
+    and Conflict; only the reason disambiguates reliably)."""
+    msg = e.read().decode(errors="replace")
+    reason = ""
+    try:
+        body = json.loads(msg)
+        if isinstance(body, dict):
+            reason = body.get("reason", "")
+    except ValueError:
+        pass
+    if e.code == 401:
+        return UnauthorizedError(msg)
+    if e.code == 404:
+        return NotFoundError(msg)
+    if e.code == 409:
+        if reason == "AlreadyExists" or (not reason and "AlreadyExists" in msg):
+            return AlreadyExistsError(msg)
+        return ConflictError(msg)
+    if e.code == 410:
+        return GoneError(msg)
+    err = ApiError(msg)
+    err.code = e.code
+    return err
 
 
 class HttpKubeClient(KubeClient):
@@ -202,16 +234,7 @@ class HttpKubeClient(KubeClient):
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
-            msg = e.read().decode(errors="replace")
-            if e.code == 404:
-                raise NotFoundError(msg)
-            if e.code == 409:
-                if "AlreadyExists" in msg:
-                    raise AlreadyExistsError(msg)
-                raise ConflictError(msg)
-            err = ApiError(msg)
-            err.code = e.code
-            raise err
+            raise _map_http_error(e)
 
     # -- CRUD --------------------------------------------------------------
 
@@ -219,13 +242,17 @@ class HttpKubeClient(KubeClient):
         return self._request("GET", self._url(kind, namespace, name))
 
     def list(self, kind, namespace=None, label_selector=None):
+        return self.list_raw(kind, namespace, label_selector).get("items", [])
+
+    def list_raw(self, kind, namespace=None, label_selector=None) -> dict:
+        """Full List response incl. metadata.resourceVersion — the rv a
+        list-then-watch informer resumes its watch from."""
         query = {}
         if label_selector:
             query["labelSelector"] = ",".join(
                 "%s=%s" % (k, v) for k, v in sorted(label_selector.items())
             )
-        out = self._request("GET", self._url(kind, namespace, query=query or None))
-        return out.get("items", [])
+        return self._request("GET", self._url(kind, namespace, query=query or None))
 
     def create(self, obj: dict) -> dict:
         m = obj["metadata"]
@@ -254,19 +281,50 @@ class HttpKubeClient(KubeClient):
             {"propagationPolicy": "Background"},
         )
 
-    def watch(self, kind, namespace=None):
-        """Streaming watch; yields (eventType, object) tuples."""
-        url = self._url(kind, namespace, query={"watch": "1"})
+    def watch(self, kind, namespace=None, resource_version=None,
+              timeout_seconds=300):
+        """Streaming watch; yields (eventType, object) tuples.
+
+        ``resource_version`` resumes from a prior position (events after
+        that rv are replayed). The stream ends cleanly at the server-side
+        ``timeout_seconds``; the socket read timeout is set slightly past
+        it so a silently dead connection raises instead of stalling the
+        watcher forever. Callers reconnect with the last object rv seen
+        (see runtime.Controller._watch_loop); 410 Gone surfaces as
+        :class:`GoneError` meaning re-list + fresh watch.
+        """
+        query = {"watch": "1", "timeoutSeconds": int(timeout_seconds)}
+        if resource_version:
+            query["resourceVersion"] = str(resource_version)
+        url = self._url(kind, namespace, query=query)
         req = urllib.request.Request(url)
         req.add_header("Accept", "application/json")
         if self._token:
             req.add_header("Authorization", "Bearer " + self._token)
-        with urllib.request.urlopen(req, context=self._ssl) as resp:
+        try:
+            resp = urllib.request.urlopen(
+                req, context=self._ssl, timeout=timeout_seconds + 15
+            )
+        except urllib.error.HTTPError as e:
+            raise _map_http_error(e)
+        with resp:
             for line in resp:
                 if not line.strip():
                     continue
                 ev = json.loads(line)
-                yield ev.get("type", ""), ev.get("object", {})
+                etype, obj = ev.get("type", ""), ev.get("object", {})
+                if etype == "ERROR":
+                    # real apiservers report expired rv MID-STREAM: HTTP 200
+                    # + {"type":"ERROR","object":<Status code=410>} — it must
+                    # surface as GoneError (re-list), never as a normal event
+                    code = obj.get("code") if isinstance(obj, dict) else None
+                    msg = obj.get("message", "") if isinstance(obj, dict) else ""
+                    if code == 410:
+                        raise GoneError(msg or "watch resourceVersion expired")
+                    err = ApiError(msg or "watch stream error")
+                    err.code = code or 500
+                    raise err
+                yield etype, obj
 
     def exec_in_pod(self, namespace, pod_name, container, command):
         # Pod exec requires SPDY/WebSocket upgrade; stdlib has neither. The

@@ -1,0 +1,342 @@
+"""Hermetic stub kube-apiserver — the envtest analog, over real HTTP.
+
+The reference exercises its client surface against a real apiserver+etcd
+booted by envtest (``controllers/suite_test.go:51-88``, ``Makefile:17-22``).
+This module gives the same guarantee hermetically: a stdlib HTTP server
+speaking enough of the Kubernetes REST API that :class:`HttpKubeClient`
+runs against it unmodified — CRUD + status subresource, label selectors,
+bearer-token auth, apimachinery Status error bodies (401/404/409/410), and
+**streaming watch** with resourceVersion resume and server-side timeout.
+
+Storage semantics (optimistic concurrency, finalizers, cascade GC) are the
+in-memory :class:`FakeKubeClient`'s; this layer adds the wire protocol.
+Every request is appended to ``self.requests`` so tests can assert traffic
+shape (e.g. the informer cache performing ZERO lists at steady state).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .errors import ApiError
+from .fake import FakeKubeClient
+from .objects import deep_copy
+
+# plural -> kind for the core routes HttpKubeClient knows out of the box
+_BUILTIN_PLURALS = {
+    "pods": "Pod",
+    "services": "Service",
+    "configmaps": "ConfigMap",
+    "events": "Event",
+    "leases": "Lease",
+    "podgroups": "PodGroup",
+}
+
+
+class StubApiServer:
+    """One instance = one apiserver on 127.0.0.1:<ephemeral port>."""
+
+    def __init__(self, token: Optional[str] = None):
+        self.store = FakeKubeClient()
+        self.token = token
+        self.requests: List[Tuple[str, str]] = []  # (method, path?query)
+        self._plurals: Dict[str, str] = dict(_BUILTIN_PLURALS)
+        # watch history: (seq, etype, obj). seq is the global rv counter;
+        # DELETED events get a fresh seq (real apiservers bump rv on delete)
+        self._history: List[Tuple[int, str, dict]] = []
+        self._compacted_below = 0  # seqs < this are gone -> 410 on resume
+        self._cv = threading.Condition()
+        self.store.event_sink = self._record
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                outer._dispatch(self, "GET")
+
+            def do_POST(self):  # noqa: N802
+                outer._dispatch(self, "POST")
+
+            def do_PUT(self):  # noqa: N802
+                outer._dispatch(self, "PUT")
+
+            def do_DELETE(self):  # noqa: N802
+                outer._dispatch(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "StubApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="stub-apiserver"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return "http://127.0.0.1:%d" % self._httpd.server_address[1]
+
+    def register_kind(self, api_version: str, kind: str, plural: str) -> None:
+        self._plurals[plural] = kind
+        self.store.register_kind(api_version, kind, plural)
+
+    def compact(self) -> None:
+        """Drop retained watch history — stale resumers now get 410 Gone
+        (models apiserver etcd compaction)."""
+        with self._cv:
+            if self._history:
+                self._compacted_below = self._history[-1][0] + 1
+            self._history.clear()
+
+    def clear_requests(self) -> None:
+        self.requests.clear()
+
+    def inject_error_event(self, code: int = 410, reason: str = "Expired",
+                           message: str = "injected") -> None:
+        """Append an in-stream ERROR event (how real apiservers report an
+        expired rv on an ESTABLISHED watch: HTTP 200 + Status object)."""
+        with self._cv:
+            seq = int(self.store._next_rv())
+            self._history.append((seq, "ERROR", {
+                "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                "code": code, "reason": reason, "message": message,
+            }))
+            self._cv.notify_all()
+
+    # -- watch history -----------------------------------------------------
+
+    def _record(self, etype: str, obj: dict) -> None:
+        with self._cv:
+            if etype == "DELETED":
+                seq = int(self.store._next_rv())
+                obj = deep_copy(obj)
+                obj.setdefault("metadata", {})["resourceVersion"] = str(seq)
+            else:
+                seq = int(obj.get("metadata", {}).get("resourceVersion", 0))
+            self._history.append((seq, etype, obj))
+            self._cv.notify_all()
+
+    # -- request plumbing ----------------------------------------------------
+
+    def _dispatch(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        self.requests.append((method, req.path))
+        if self.token is not None:
+            if req.headers.get("Authorization") != "Bearer %s" % self.token:
+                self._status(req, 401, "Unauthorized", "invalid bearer token")
+                return
+        parsed = urllib.parse.urlparse(req.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        route = self._parse_path(parsed.path)
+        if route is None:
+            self._status(req, 404, "NotFound", "unrecognized path %s" % parsed.path)
+            return
+        kind, namespace, name, subresource = route
+        try:
+            if method == "GET" and name is None and query.get("watch"):
+                self._serve_watch(req, kind, namespace, query)
+            elif method == "GET" and name is None:
+                self._serve_list(req, kind, namespace, query)
+            elif method == "GET":
+                self._send_json(req, 200, self.store.get(kind, namespace, name))
+            elif method == "POST":
+                obj = self._read_body(req)
+                self._send_json(req, 201, self.store.create(obj))
+            elif method == "PUT" and subresource == "status":
+                obj = self._read_body(req)
+                self._send_json(req, 200, self.store.update_status(obj))
+            elif method == "PUT":
+                obj = self._read_body(req)
+                self._send_json(req, 200, self.store.update(obj))
+            elif method == "DELETE":
+                self._read_body(req)  # DeleteOptions: accepted, ignored
+                self.store.delete(kind, namespace, name)
+                self._status(req, 200, "Success", "deleted")
+            else:
+                self._status(req, 405, "MethodNotAllowed", method)
+        except ApiError as e:
+            self._status(req, e.code, e.reason, e.message)
+
+    def _parse_path(self, path: str):
+        """/api/v1/... or /apis/{group}/{version}/... ->
+        (kind, namespace|None, name|None, subresource|None)"""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return None
+        if parts[0] == "api" and len(parts) >= 2:
+            rest = parts[2:]
+        elif parts[0] == "apis" and len(parts) >= 3:
+            rest = parts[3:]
+        else:
+            return None
+        namespace = None
+        if len(rest) >= 2 and rest[0] == "namespaces":
+            namespace = rest[1]
+            rest = rest[2:]
+        if not rest:
+            return None
+        plural, rest = rest[0], rest[1:]
+        kind = self._plurals.get(plural)
+        if kind is None:
+            return None
+        name = rest[0] if rest else None
+        subresource = rest[1] if len(rest) > 1 else None
+        return kind, namespace, name, subresource
+
+    @staticmethod
+    def _read_body(req: BaseHTTPRequestHandler) -> dict:
+        n = int(req.headers.get("Content-Length") or 0)
+        if n == 0:
+            return {}
+        return json.loads(req.rfile.read(n))
+
+    @staticmethod
+    def _parse_selector(query: dict) -> Optional[dict]:
+        raw = query.get("labelSelector")
+        if not raw:
+            return None
+        out = {}
+        for clause in raw.split(","):
+            k, _, v = clause.partition("=")
+            out[k] = v
+        return out
+
+    # -- GET handlers --------------------------------------------------------
+
+    def _serve_list(self, req, kind, namespace, query) -> None:
+        # rv snapshots BEFORE the list (same rule as the watch initial sync):
+        # an event racing in between is then both in the items and replayed
+        # by a watch resuming from this rv — duplicated, never lost
+        with self._cv:
+            rv = str(self.store._rv)
+        items = self.store.list(kind, namespace, self._parse_selector(query))
+        body = {
+            "apiVersion": "v1",
+            "kind": "%sList" % kind,
+            "metadata": {"resourceVersion": rv},
+            "items": items,
+        }
+        self._send_json(req, 200, body)
+
+    def _serve_watch(self, req, kind, namespace, query) -> None:
+        """Chunked event stream: replay history after `resourceVersion`,
+        then stream live until timeoutSeconds (then clean EOF — the client
+        is expected to re-watch from its last seen rv)."""
+        since = int(query.get("resourceVersion") or 0)
+        timeout = float(query.get("timeoutSeconds") or 60)
+        selector = self._parse_selector(query)
+        with self._cv:
+            if since and since + 1 < self._compacted_below:
+                pass_410 = True
+            else:
+                pass_410 = False
+        if pass_410:
+            self._status(req, 410, "Expired", "resourceVersion too old")
+            return
+
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Transfer-Encoding", "chunked")
+        req.end_headers()
+
+        def emit(etype, obj) -> bool:
+            if etype != "ERROR":  # ERROR carries a Status, not the kind
+                if namespace and obj.get("metadata", {}).get(
+                        "namespace") != namespace:
+                    return True
+                if obj.get("kind") != kind:
+                    return True
+                from .objects import match_labels
+
+                if not match_labels(obj, selector):
+                    return True
+            data = json.dumps({"type": etype, "object": obj}).encode() + b"\n"
+            try:
+                req.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                req.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return False
+
+        if since == 0:
+            # no rv: synthetic ADDED for current state (watch-from-now +
+            # initial sync, what list-then-watch collapses to here).
+            # cursor snapshots BEFORE the list: an event racing in between
+            # is delivered twice (idempotent for informers), never lost.
+            with self._cv:
+                cursor = len(self._history)
+            for obj in self.store.list(kind, namespace):
+                if not emit("ADDED", obj):
+                    return
+        else:
+            with self._cv:
+                cursor = 0
+                while (cursor < len(self._history)
+                       and self._history[cursor][0] <= since):
+                    cursor += 1
+
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cv:
+                while cursor >= len(self._history):
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cv.wait(min(left, 0.5)):
+                        if time.monotonic() >= deadline:
+                            try:
+                                req.wfile.write(b"0\r\n\r\n")  # clean EOF
+                            except OSError:
+                                pass
+                            return
+                batch = self._history[cursor:]
+                cursor = len(self._history)
+            for _seq, etype, obj in batch:
+                if not emit(etype, obj):
+                    return
+            if time.monotonic() >= deadline:
+                try:
+                    req.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+                return
+
+    # -- response helpers ------------------------------------------------
+
+    @staticmethod
+    def _send_json(req, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        try:
+            req.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    @staticmethod
+    def _status(req, code: int, reason: str, message: str) -> None:
+        """apimachinery metav1.Status error body — what client-go (and our
+        HttpKubeClient) parses `reason` out of."""
+        StubApiServer._send_json(req, code, {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "message": message,
+            "reason": reason,
+            "code": code,
+        })

@@ -34,6 +34,19 @@ class InvalidError(ApiError):
     code = 422
 
 
+class UnauthorizedError(ApiError):
+    reason = "Unauthorized"
+    code = 401
+
+
+class GoneError(ApiError):
+    """Watch resourceVersion fell behind apiserver compaction (410):
+    the watcher must re-list and restart the watch."""
+
+    reason = "Expired"
+    code = 410
+
+
 def ignore_not_found(exc: Exception) -> None:
     """Re-raise unless the error is NotFound (client.IgnoreNotFound analog)."""
     if isinstance(exc, NotFoundError):

@@ -31,6 +31,9 @@ class FakeKubeClient(KubeClient):
         self.exec_handler: Optional[Callable] = None
         self.exec_calls: List[Tuple[str, str, str, tuple]] = []
         self._registered: Dict[str, str] = {}
+        # kind-agnostic event tap: fn(etype, obj) — used by the envtest
+        # stub apiserver to build its watch event history
+        self.event_sink: Optional[Callable] = None
 
     # -- registration ------------------------------------------------------
 
@@ -48,6 +51,8 @@ class FakeKubeClient(KubeClient):
         return (obj.get("kind", ""), m.get("namespace", "default"), m.get("name", ""))
 
     def _notify(self, etype: str, obj: dict) -> None:
+        if self.event_sink is not None:
+            self.event_sink(etype, deep_copy(obj))
         for kind, ns, cb in list(self._watchers):
             if kind != obj.get("kind"):
                 continue

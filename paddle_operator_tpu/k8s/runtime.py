@@ -119,36 +119,32 @@ class Controller:
         self.metrics = {"reconcile_total": 0, "reconcile_errors_total": 0,
                         "requeue_total": 0}
 
-    def watch(self, client, kind: str, mapper: Callable, namespace=None) -> None:
-        if isinstance(client, FakeKubeClient):
+    def watch(self, client, kind: str, mapper: Callable, namespace=None,
+              cache=None) -> None:
+        if cache is not None:
+            # informer-fed: one shared watch per kind feeds the cache; the
+            # controller just subscribes for key-mapping (reference: the
+            # Watches/Owns wiring at paddlejob_controller.go:555-567 on top
+            # of the manager's shared cache)
+            def handler(etype, obj, mapper=mapper):
+                key = mapper(obj)
+                if key is not None:
+                    self.queue.add(key)
+            cache.informer(kind).add_handler(handler)
+        elif isinstance(client, FakeKubeClient):
             def cb(etype, obj, mapper=mapper):
                 key = mapper(obj)
                 if key is not None:
                     self.queue.add(key)
             client.add_watch_callback(kind, namespace, cb)
         else:
-            threading.Thread(
-                target=self._watch_loop, args=(client, kind, mapper, namespace),
-                daemon=True,
-            ).start()
-
-    def _watch_loop(self, client, kind, mapper, namespace):
-        while True:
-            try:
-                for _etype, obj in client.watch(kind, namespace):
-                    key = mapper(obj)
-                    if key is not None:
-                        self.queue.add(key)
-            except Exception as e:
-                log.warning("watch %s dropped (%s); re-listing", kind, e)
-                time.sleep(2)
-                try:
-                    for obj in client.list(kind, namespace):
-                        key = mapper(obj)
-                        if key is not None:
-                            self.queue.add(key)
-                except Exception as e2:
-                    log.warning("re-list %s failed: %s", kind, e2)
+            # there is exactly ONE list-then-watch/rv-resume/410 protocol
+            # implementation (InformerCache._run_watch); Manager provides an
+            # implicit cache for real clients rather than duplicating it here
+            raise ValueError(
+                "watching a real client requires an informer cache; "
+                "construct the Controller through Manager.add_controller"
+            )
 
     def process_one(self, key: Tuple[str, str]) -> bool:
         """Run one reconcile; enqueue follow-ups per the Result contract."""
@@ -183,9 +179,20 @@ class Manager:
                  lease_name: str = "tpujob-operator-lock",
                  lease_duration: float = 15.0, renew_deadline: float = 10.0,
                  retry_period: float = 2.0,
-                 on_lost_lease: Optional[Callable[[], None]] = None):
+                 on_lost_lease: Optional[Callable[[], None]] = None,
+                 cache=None):
         self.client = client
         self.namespace = namespace
+        if cache is None and not isinstance(client, FakeKubeClient):
+            from .informer import CachedKubeClient, InformerCache
+
+            if isinstance(client, CachedKubeClient):
+                cache = client.cache
+            else:
+                # real client, no cache given: controllers still need the
+                # shared watch plumbing (the only watch-loop implementation)
+                cache = InformerCache(client, namespace)
+        self.cache = cache
         self.controllers: List[Controller] = []
         self.leader_election = leader_election
         self.leader_identity = leader_identity or ("mgr-%d" % id(self))
@@ -214,11 +221,13 @@ class Manager:
     ) -> Controller:
         ctrl = Controller(name, reconcile)
         ctrl.for_kind = for_kind
-        ctrl.watch(self.client, for_kind, self_key_mapper, self.namespace)
+        ctrl.watch(self.client, for_kind, self_key_mapper, self.namespace,
+                   cache=self.cache)
         for kind in owns or []:
             ctrl.watch(
                 self.client, kind,
                 owner_key_mapper(owner_api_version, owner_kind), self.namespace,
+                cache=self.cache,
             )
         self.controllers.append(ctrl)
         return ctrl
@@ -270,6 +279,9 @@ class Manager:
         """Blocks on leadership (if enabled), then starts workers. On a lost
         lease all workers halt and ``on_lost_lease`` fires (reference:
         controller-runtime exits the binary; main.py wires that)."""
+        if self.cache is not None:
+            self.cache.start()  # idempotent; may already serve coordination
+            self.cache.wait_for_sync()
         if self.elector is not None:
             if not self.elector.acquire(self._stop):
                 return  # stopped before winning
@@ -289,6 +301,11 @@ class Manager:
             )
             t.start()
             self._threads.append(t)
+
+    def request_stop(self) -> None:
+        """Signal-handler-safe stop: unblocks lease acquisition, renewal and
+        workers without joining threads (stop() does the joining)."""
+        self._stop.set()
 
     def _lost_leadership(self) -> None:
         self._stop.set()  # halt all workers: we no longer own the objects

@@ -19,11 +19,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import os
 
 from .api import types as api
+from .controllers import helper
 from .controllers.coordination import CoordinationServer
 from .controllers.hostport import PortRangeAllocator
 from .controllers.reconciler import TpuJobReconciler
 from .elastic.store import connect as kv_connect
 from .k8s.client import HttpKubeClient
+from .k8s.informer import CachedKubeClient, InformerCache
 from .k8s.runtime import Manager
 
 
@@ -68,13 +70,30 @@ def main(argv=None):
     )
     client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
 
+    # Informer cache: reconciles and coordination polls read from here —
+    # steady state performs zero apiserver LISTs (reference relies on
+    # controller-runtime's cache the same way). Leases are deliberately NOT
+    # cached: leader election needs fresh reads.
+    cache = InformerCache(client, namespace=args.namespace or None)
+    cached_kinds = [api.KIND, "Pod", "Service", "ConfigMap"]
+    if args.scheduling == helper.SCHEDULER_VOLCANO:
+        # only watch podgroups when volcano is installed — otherwise the
+        # informer list 404s forever and wait_for_sync stalls (the reference
+        # gates Owns(PodGroup) the same way, paddlejob_controller.go:560-567)
+        cached_kinds.append("PodGroup")
+    for kind in cached_kinds:
+        cache.informer(kind)
+    cached_client = CachedKubeClient(client, cache)
+    cache.start()
+
     start, end = (int(p) for p in args.port_range.split(","))
     kv = kv_connect(args.membership) if args.membership else None
 
     coord_srv = None
     coord_url = args.coordination_url
     if args.coordination_bind_address:
-        coord_srv = CoordinationServer(client, args.coordination_bind_address)
+        coord_srv = CoordinationServer(
+            cached_client, args.coordination_bind_address)
         coord_srv.start()
         if not coord_url:
             # In-cluster default: the operator's coordination Service FQDN
@@ -86,7 +105,7 @@ def main(argv=None):
             coord_url = "http://%s.%s.svc:%s" % (svc, ns, port)
 
     reconciler = TpuJobReconciler(
-        client,
+        cached_client,
         scheduling=args.scheduling,
         init_image=args.init_image,
         port_allocator=PortRangeAllocator(start, end),
@@ -105,16 +124,17 @@ def main(argv=None):
         stop.set()
 
     mgr = Manager(
-        client,
+        cached_client,
         leader_election=args.leader_elect,
         namespace=args.namespace or None,
         leader_identity=os.environ.get("POD_NAME", ""),
         on_lost_lease=lost_lease,
+        cache=cache,
     )
     mgr.add_controller(
         "tpujob", reconciler.reconcile,
         for_kind=api.KIND,
-        owns=["Pod", "Service", "ConfigMap", "PodGroup"],
+        owns=[k for k in cached_kinds if k != api.KIND],
         owner_api_version=api.API_VERSION, owner_kind=api.KIND,
     )
 
@@ -154,9 +174,15 @@ def main(argv=None):
     log.info("starting manager (scheduling=%r, membership=%r)",
              args.scheduling, args.membership)
     # handlers BEFORE start(): with --leader-elect a standby replica blocks
-    # in start() on lease acquisition and must still die gracefully
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    # in start() on lease acquisition and must still die gracefully — the
+    # handler must unblock BOTH the manager's internal stop (acquire loop)
+    # and main's wait
+    def on_signal(*_a):
+        mgr.request_stop()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
     mgr.start()
 
     stop.wait()

@@ -15,6 +15,7 @@ from .controllers.hostport import PortRangeAllocator
 from .controllers.reconciler import TpuJobReconciler
 from .elastic.store import KVStore, MemoryKVStore
 from .k8s.fake import FakeKubeClient
+from .k8s.informer import CachedKubeClient, InformerCache
 from .k8s.podsim import PodSimulator
 from .k8s.runtime import Manager
 from .controllers import helper
@@ -39,6 +40,17 @@ class OperatorHarness:
             coord_container_name=helper.COORD_CONTAINER_NAME,
         )
         self.kv = kv_store if kv_store is not None else MemoryKVStore()
+        # The production read path: reconciler + coordination server read
+        # from the informer cache (fed synchronously by the fake's watch
+        # callbacks), writes pass through to the apiserver.
+        self.cache = InformerCache(self.client, namespace=namespace)
+        cached_kinds = [api.KIND, "Pod", "Service", "ConfigMap"]
+        if scheduling == helper.SCHEDULER_VOLCANO:
+            cached_kinds.append("PodGroup")  # gated like manager.py
+        for kind in cached_kinds:
+            self.cache.informer(kind)
+        self.cached_client = CachedKubeClient(self.client, self.cache)
+        self.cache.start()
         # Production release channel: a real CoordinationServer on localhost;
         # the pod simulator polls it over real HTTP like the init container.
         self.coord_server = None
@@ -46,22 +58,24 @@ class OperatorHarness:
         if http_coordination:
             from .controllers.coordination import CoordinationServer
 
-            self.coord_server = CoordinationServer(self.client, ":0").start()
+            self.coord_server = CoordinationServer(
+                self.cached_client, ":0").start()
             coord_url = self.coord_server.url
         self.reconciler = TpuJobReconciler(
-            self.client,
+            self.cached_client,
             scheduling=scheduling,
             init_image=init_image,
             port_allocator=PortRangeAllocator(*port_range),
             kv_store=self.kv,
             coordination_url=coord_url,
         )
-        self.manager = Manager(self.client, namespace=namespace)
+        self.manager = Manager(self.cached_client, namespace=namespace,
+                               cache=self.cache)
         self.controller = self.manager.add_controller(
             "tpujob",
             self.reconciler.reconcile,
             for_kind=api.KIND,
-            owns=["Pod", "Service", "ConfigMap", "PodGroup"],
+            owns=[k for k in cached_kinds if k != api.KIND],
             owner_api_version=api.API_VERSION,
             owner_kind=api.KIND,
         )
