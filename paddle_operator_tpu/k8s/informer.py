@@ -35,6 +35,18 @@ from .objects import deep_copy, get_controller_of, match_labels
 log = logging.getLogger("tpujob.informer")
 
 Key = Tuple[str, str]  # (namespace, name)
+
+
+def cached_kinds(primary_kind: str, scheduling: str = "") -> List[str]:
+    """The kinds the operator caches — single source for manager.py and the
+    test harness so they can't drift. PodGroup only when volcano is the
+    scheduler: otherwise its informer 404s forever and blocks cache sync
+    (the reference gates Owns(PodGroup) identically,
+    paddlejob_controller.go:560-567)."""
+    kinds = [primary_kind, "Pod", "Service", "ConfigMap"]
+    if scheduling == "volcano":
+        kinds.append("PodGroup")
+    return kinds
 OwnerKey = Tuple[str, str, str, str]  # (apiVersion, kind, ns, owner name)
 
 

@@ -281,7 +281,14 @@ class Manager:
         controller-runtime exits the binary; main.py wires that)."""
         if self.cache is not None:
             self.cache.start()  # idempotent; may already serve coordination
-            self.cache.wait_for_sync()
+            # workers must NOT start on an unsynced cache: a reconciler that
+            # reads an empty Pod informer re-creates every child. Block like
+            # controller-runtime does, retrying until sync or shutdown.
+            while not self.cache.wait_for_sync(timeout=30.0):
+                if self._stop.is_set():
+                    return
+                log.warning("informer cache still not synced after 30s; "
+                            "waiting before starting workers")
         if self.elector is not None:
             if not self.elector.acquire(self._stop):
                 return  # stopped before winning

@@ -19,13 +19,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import os
 
 from .api import types as api
-from .controllers import helper
 from .controllers.coordination import CoordinationServer
 from .controllers.hostport import PortRangeAllocator
 from .controllers.reconciler import TpuJobReconciler
 from .elastic.store import connect as kv_connect
 from .k8s.client import HttpKubeClient
-from .k8s.informer import CachedKubeClient, InformerCache
+from .k8s.informer import CachedKubeClient, InformerCache, cached_kinds
 from .k8s.runtime import Manager
 
 
@@ -75,13 +74,8 @@ def main(argv=None):
     # controller-runtime's cache the same way). Leases are deliberately NOT
     # cached: leader election needs fresh reads.
     cache = InformerCache(client, namespace=args.namespace or None)
-    cached_kinds = [api.KIND, "Pod", "Service", "ConfigMap"]
-    if args.scheduling == helper.SCHEDULER_VOLCANO:
-        # only watch podgroups when volcano is installed — otherwise the
-        # informer list 404s forever and wait_for_sync stalls (the reference
-        # gates Owns(PodGroup) the same way, paddlejob_controller.go:560-567)
-        cached_kinds.append("PodGroup")
-    for kind in cached_kinds:
+    kinds = cached_kinds(api.KIND, args.scheduling)
+    for kind in kinds:
         cache.informer(kind)
     cached_client = CachedKubeClient(client, cache)
     cache.start()
@@ -134,7 +128,7 @@ def main(argv=None):
     mgr.add_controller(
         "tpujob", reconciler.reconcile,
         for_kind=api.KIND,
-        owns=[k for k in cached_kinds if k != api.KIND],
+        owns=[k for k in kinds if k != api.KIND],
         owner_api_version=api.API_VERSION, owner_kind=api.KIND,
     )
 

@@ -125,6 +125,45 @@ def ring_attention(
     return run(q, k, v)
 
 
+def _local_flash_blockwise(q, k, v, scale, causal, block_k=512,
+                           vary_axis=None):
+    """Blockwise online-softmax attention on ONE device, dense inputs.
+
+    Same memory discipline as the ring's per-hop update but over local KV
+    blocks: peak score memory is O(S·block_k) instead of O(S²), and each
+    block step is rematerialised under ``jax.checkpoint``. Used by Ulysses
+    after its all-to-all (where the full sequence is local) so the
+    long-context path never materialises S×S scores.
+    """
+    b, h, s, d = q.shape
+    blk = min(block_k, s)
+    while s % blk:
+        blk -= 1  # largest divisor <= block_k; degenerates to 1 worst-case
+    nb = s // blk
+    q_pos = jnp.arange(s)
+    step_fn = jax.checkpoint(
+        functools.partial(_block_update, scale=scale, causal=causal)
+    )
+
+    def body(carry, i):
+        acc, m, l = carry
+        kb = lax.dynamic_slice_in_dim(k, i * blk, blk, axis=2)
+        vb = lax.dynamic_slice_in_dim(v, i * blk, blk, axis=2)
+        k_pos = i * blk + jnp.arange(blk)
+        acc, m, l = step_fn(q, kb, vb, acc, m, l, q_pos, k_pos)
+        return (acc, m, l), None
+
+    init = (
+        jnp.zeros(q.shape, jnp.float32),
+        jnp.full(q.shape[:-1], NEG_INF, jnp.float32),
+        jnp.zeros(q.shape[:-1], jnp.float32),
+    )
+    if vary_axis is not None:  # inside shard_map: carries must be sp-varying
+        init = lax.pcast(init, (vary_axis,), to="varying")
+    (acc, m, l), _ = lax.scan(body, init, jnp.arange(nb))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
 def ulysses_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -133,12 +172,20 @@ def ulysses_attention(
     axis: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "auto",
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """All-to-all sequence parallelism (Ulysses). BHSD layout.
 
     Re-shards [B, H, S/n, D] -> [B, H/n, S, D] with one all_to_all, runs
-    dense local attention over the full sequence for H/n heads, then swaps
-    back. Requires H % n == 0 and S % n == 0.
+    memory-disciplined local attention over the full sequence for H/n
+    heads, then swaps back. Requires H % n == 0 and S % n == 0.
+
+    ``impl``: "auto" routes through the Pallas flash kernel when on the TPU
+    backend and :func:`ops.attention_pallas.supports` passes, else the
+    blockwise online-softmax scan ("blockwise"); "flash" forces the kernel
+    (interpret mode off-TPU). Either way peak memory is O(S·block) per
+    device — never the S² dense scores the sequence axis exists to avoid.
     """
     n = mesh.shape[axis]
     b, h, s, d = q.shape
@@ -147,6 +194,14 @@ def ulysses_attention(
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     spec = P(None, None, axis, None)
+
+    from ..ops import attention_pallas
+
+    use_flash = impl == "flash" or (
+        impl == "auto"
+        and jax.default_backend() == "tpu"
+        and attention_pallas.supports((b, h // n, s, d), q.dtype)
+    )
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -162,17 +217,18 @@ def ulysses_attention(
                                   tiled=True)
 
         qh, kh, vh = to_heads(ql), to_heads(kl), to_heads(vl)
-        scores = jnp.einsum(
-            "bhqd,bhkd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)
-        ) * scale
-        if causal:
-            pos = jnp.arange(s)
-            scores = jnp.where(
-                (pos[:, None] >= pos[None, :])[None, None], scores, NEG_INF
+        if use_flash:
+            out = attention_pallas.flash_attention(
+                qh, kh, vh, scale=scale, causal=causal,
+                # the kernel is Pallas-TPU: anywhere else (cpu mesh, gpu)
+                # it must run in interpret mode or fail to lower
+                interpret=jax.default_backend() != "tpu",
             )
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh.astype(jnp.float32))
-        return to_seq(out.astype(ql.dtype))
+        else:
+            out = _local_flash_blockwise(
+                qh, kh, vh, scale, causal, block_k=block_k, vary_axis=axis,
+            )
+        return to_seq(out)
 
     return run(q, k, v)
 

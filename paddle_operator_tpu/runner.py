@@ -30,6 +30,28 @@ from .utils.trace import profile_steps, tracer
 log = logging.getLogger("tpujob.runner")
 
 
+def _cycle_mesh(axes, elastic=False):
+    """Mesh for one elastic cycle. A shrunk world may name fewer devices
+    than exist (single-host model of np-resize): use the leading subset —
+    on real multi-host the device set itself shrank at re-init."""
+    if axes and any(s == -1 for s in axes.values()):
+        if elastic:
+            # -1 would silently infer against ALL devices, defeating the
+            # shrink; the mesh_axes callable knows `world` — make it say so
+            raise ValueError(
+                "elastic mesh_axes must be fully specified (no -1 sizes); "
+                "compute them from the world size, got %r" % (axes,))
+        return make_mesh(axes)
+    if axes:
+        total = 1
+        for s in axes.values():
+            total *= s
+        devs = jax.devices()
+        if total < len(devs):
+            return make_mesh(axes, devices=devs[:total])
+    return make_mesh(axes)
+
+
 @dataclass
 class TrainJob:
     """Everything the runner needs to train one model."""
@@ -39,7 +61,13 @@ class TrainJob:
     optimizer: Optimizer
     make_batch: Callable[[jax.Array, int], Any]       # (rng, step) -> batch
     rules: Optional[Rules] = None
-    mesh_axes: Optional[Dict[str, int]] = None
+    # dict, or callable world_size -> dict so an elastic resize (np change)
+    # rebuilds the next cycle's mesh at the new world (SURVEY §3.4: EDL is
+    # np-resize; the shrunk cycle must train on the smaller mesh)
+    mesh_axes: Any = None
+    # force per-shard checkpoint format even single-process (avoids the
+    # host-side full gather; required for restore onto a different mesh)
+    sharded_checkpoint: bool = False
     seq_axis: Optional[str] = None
     merge_stats: Optional[Callable] = None
     grad_clip: Optional[float] = None
@@ -66,8 +94,9 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
 
     def save(step: int, state, epoch: int) -> None:
         """Multi-host: every process writes its own shards (a full gather of
-        a sharded model is impossible); single-host: worker 0 writes npz."""
-        if jax.process_count() > 1:
+        a sharded model is impossible); single-host: worker 0 writes npz
+        (or shards too, when the job opts in)."""
+        if jax.process_count() > 1 or job.sharded_checkpoint:
             save_checkpoint_sharded(job.checkpoint_dir, step, state,
                                     meta={"epoch": epoch})
         elif cfg.worker_id == 0:
@@ -96,9 +125,12 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
 
     def train_cycle(world: int, epoch: int, should_stop: Callable[[], bool]) -> bool:
         should_stop = agreed_stop(should_stop)
-        mesh = make_mesh(job.mesh_axes) if (
-            job.mesh_axes or len(jax.devices()) > 1
+        axes = job.mesh_axes(world) if callable(job.mesh_axes) else job.mesh_axes
+        mesh = _cycle_mesh(axes, elastic=callable(job.mesh_axes)) if (
+            axes or len(jax.devices()) > 1
         ) else None
+        result.setdefault("mesh_history", []).append(
+            dict(mesh.shape) if mesh is not None else None)
         rng = jax.random.PRNGKey(job.seed)
         params = job.init_params(rng)
         loss_fn = job.loss_fn

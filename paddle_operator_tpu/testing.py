@@ -15,7 +15,7 @@ from .controllers.hostport import PortRangeAllocator
 from .controllers.reconciler import TpuJobReconciler
 from .elastic.store import KVStore, MemoryKVStore
 from .k8s.fake import FakeKubeClient
-from .k8s.informer import CachedKubeClient, InformerCache
+from .k8s.informer import CachedKubeClient, InformerCache, cached_kinds
 from .k8s.podsim import PodSimulator
 from .k8s.runtime import Manager
 from .controllers import helper
@@ -44,10 +44,8 @@ class OperatorHarness:
         # from the informer cache (fed synchronously by the fake's watch
         # callbacks), writes pass through to the apiserver.
         self.cache = InformerCache(self.client, namespace=namespace)
-        cached_kinds = [api.KIND, "Pod", "Service", "ConfigMap"]
-        if scheduling == helper.SCHEDULER_VOLCANO:
-            cached_kinds.append("PodGroup")  # gated like manager.py
-        for kind in cached_kinds:
+        kinds = cached_kinds(api.KIND, scheduling)
+        for kind in kinds:
             self.cache.informer(kind)
         self.cached_client = CachedKubeClient(self.client, self.cache)
         self.cache.start()
@@ -75,7 +73,7 @@ class OperatorHarness:
             "tpujob",
             self.reconciler.reconcile,
             for_kind=api.KIND,
-            owns=[k for k in cached_kinds if k != api.KIND],
+            owns=[k for k in kinds if k != api.KIND],
             owner_api_version=api.API_VERSION,
             owner_kind=api.KIND,
         )
