@@ -113,7 +113,11 @@ def run(client, args) -> int:
         for doc in docs:
             doc.setdefault("metadata", {}).setdefault("namespace",
                                                       args.namespace)
-            errs = api.TpuJob(doc).validate()
+            # semantic checks + structural schema (what CRD admission will
+            # enforce server-side — catch typo'd pod templates pre-submit)
+            from .api.crd import validate_tpujob
+
+            errs = api.TpuJob(doc).validate() + validate_tpujob(doc)
             if errs:
                 print("invalid %s: %s" % (doc["metadata"].get("name"),
                                           "; ".join(errs)), file=sys.stderr)

@@ -20,6 +20,14 @@ Release semantics match the reference exactly: roles are released in
 only when every earlier role is fully Running; and the first role is held
 until every pod's coordination container is live, so the whole gang is
 scheduled before anyone starts.
+
+Trust posture: the endpoint is **read-only and unauthenticated** by design —
+a GET can only observe job/pod names and per-role running counts, never
+mutate anything, and the busybox-wget pollers can't carry credentials
+without distributing a cluster-wide shared secret into every job pod.
+Restrict reachability with a NetworkPolicy if needed (docs/design.md
+"Security posture"). Reads are served from the informer cache, so polling
+load never reaches the apiserver.
 """
 
 from __future__ import annotations

@@ -42,7 +42,10 @@ def _cycle_mesh(axes, elastic=False):
                 "elastic mesh_axes must be fully specified (no -1 sizes); "
                 "compute them from the world size, got %r" % (axes,))
         return make_mesh(axes)
-    if axes:
+    if axes and elastic:
+        # device-subset meshes model np-resize ONLY for elastic jobs; a
+        # static mesh smaller than the device count stays a loud
+        # make_mesh error (it's a misconfiguration, not a shrink)
         total = 1
         for s in axes.values():
             total *= s
@@ -96,12 +99,19 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         """Multi-host: every process writes its own shards (a full gather of
         a sharded model is impossible); single-host: worker 0 writes npz
         (or shards too, when the job opts in)."""
-        if jax.process_count() > 1 or job.sharded_checkpoint:
+        if jax.process_count() > 1:
             save_checkpoint_sharded(job.checkpoint_dir, step, state,
                                     meta={"epoch": epoch})
         elif cfg.worker_id == 0:
-            save_checkpoint(job.checkpoint_dir, step,
-                            jax.device_get(state), meta={"epoch": epoch})
+            # single-process: only worker 0 writes — a multi-worker launch
+            # that never initialized jax.distributed must not have every
+            # worker rmtree/rewrite the same staging dir concurrently
+            if job.sharded_checkpoint:
+                save_checkpoint_sharded(job.checkpoint_dir, step, state,
+                                        meta={"epoch": epoch})
+            else:
+                save_checkpoint(job.checkpoint_dir, step,
+                                jax.device_get(state), meta={"epoch": epoch})
 
     def agreed_stop(should_stop: Callable[[], bool]) -> Callable[[], bool]:
         """Multi-host: the stop decision must be identical on every process

@@ -3,6 +3,7 @@ manifest generation checks."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import yaml
 
 from paddle_operator_tpu.api import types as api
@@ -114,9 +115,12 @@ def test_crd_yaml_parses():
 
 
 def test_example_manifests_validate(pytestconfig):
-    """Every shipped example must pass TpuJob.validate()."""
+    """Every shipped example must pass TpuJob.validate() AND the typed
+    CRD schema (spec side)."""
     import glob
     import os
+
+    from paddle_operator_tpu.api.crd import validate_tpujob
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = glob.glob(os.path.join(root, "deploy", "examples", "*.yaml"))
@@ -129,3 +133,121 @@ def test_example_manifests_validate(pytestconfig):
                     continue
                 job = api.TpuJob(doc)
                 assert job.validate() == [], (path, job.validate())
+                assert validate_tpujob(doc) == [], (path, validate_tpujob(doc))
+
+
+# ---------------------------------------------------------------------------
+# typed pod-template schema (reference: the ~4.7k-line PodTemplateSpec in
+# config/crd/bases/batch.paddlepaddle.org_paddlejobs.yaml)
+# ---------------------------------------------------------------------------
+
+def _job_with_template(template):
+    return {
+        "apiVersion": api.API_VERSION, "kind": api.KIND,
+        "metadata": {"name": "t", "namespace": "default"},
+        "spec": {"worker": {"replicas": 1, "template": template}},
+    }
+
+
+def _good_template():
+    return {
+        "metadata": {"labels": {"app": "x"}},
+        "spec": {
+            "containers": [{
+                "name": "w", "image": "img:1",
+                "command": ["python", "train.py"],
+                "env": [{"name": "A", "value": "1"}],
+                "resources": {"limits": {"google.com/tpu": 4,
+                                         "memory": "8Gi"}},
+                "volumeMounts": [{"name": "ckpt", "mountPath": "/ckpt"}],
+                "ports": [{"containerPort": 8080, "protocol": "TCP"}],
+            }],
+            "volumes": [{"name": "ckpt", "emptyDir": {}}],
+            "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x4"},
+            "restartPolicy": "Never",
+            "tolerations": [{"key": "tpu", "operator": "Exists"}],
+        },
+    }
+
+
+def test_typed_template_roundtrip():
+    from paddle_operator_tpu.api.crd import validate_tpujob
+
+    job = _job_with_template(_good_template())
+    assert validate_tpujob(job) == []
+    # schema survives YAML round-trip
+    assert validate_tpujob(yaml.safe_load(yaml.safe_dump(job))) == []
+
+
+@pytest.mark.parametrize("mutate, expect", [
+    (lambda t: t["spec"]["containers"][0].update(imagee="typo"),
+     "unknown field 'imagee'"),
+    (lambda t: t["spec"]["containers"][0].pop("name"),
+     "missing required field 'name'"),
+    (lambda t: t["spec"]["containers"][0].update(command="not-a-list"),
+     "expected array"),
+    (lambda t: t["spec"].update(restartPolicy="Sometimes"),
+     "not one of"),
+    (lambda t: t["spec"]["containers"][0]["volumeMounts"][0].pop("mountPath"),
+     "missing required field 'mountPath'"),
+    (lambda t: t["spec"]["containers"][0]["ports"][0].update(
+        containerPort="eighty"), "expected integer"),
+    (lambda t: t["spec"].update(hostNetwork="yes"), "expected boolean"),
+    (lambda t: t["spec"].pop("containers"),
+     "missing required field 'containers'"),
+])
+def test_typed_template_rejects_bad_specs(mutate, expect):
+    """The round-2 gap: typo'd container specs passed admission and failed
+    at runtime. Now they fail schema validation."""
+    from paddle_operator_tpu.api.crd import validate_tpujob
+
+    t = _good_template()
+    mutate(t)
+    errs = validate_tpujob(_job_with_template(t))
+    assert errs, "expected a schema error for %s" % expect
+    assert any(expect in e for e in errs), (expect, errs)
+
+
+def test_typed_template_accepts_kubectl_dry_run_artifacts():
+    """kubectl --dry-run / Go marshaling emit `creationTimestamp: null`
+    and use generateName; native sidecars set initContainer restartPolicy.
+    All must validate."""
+    from paddle_operator_tpu.api.crd import validate_tpujob
+
+    t = _good_template()
+    t["metadata"]["creationTimestamp"] = None
+    t["metadata"]["generateName"] = "w-"
+    t["spec"]["initContainers"] = [{
+        "name": "sidecar", "image": "log:1", "restartPolicy": "Always"}]
+    assert validate_tpujob(_job_with_template(t)) == []
+
+
+def test_typed_template_preserves_polymorphic_corners():
+    """Affinity / probes / volume sources stay open (preserve-unknown):
+    the apiserver re-validates them at pod creation."""
+    from paddle_operator_tpu.api.crd import validate_tpujob
+
+    t = _good_template()
+    t["spec"]["affinity"] = {"nodeAffinity": {"weird": {"nested": [1, 2]}}}
+    t["spec"]["containers"][0]["livenessProbe"] = {
+        "httpGet": {"path": "/healthz", "port": 8080}}
+    t["spec"]["volumes"].append({"name": "x", "hostPath": {"path": "/x"}})
+    assert validate_tpujob(_job_with_template(t)) == []
+
+
+def test_cli_submit_rejects_typoed_template(tmp_path):
+    import argparse
+
+    from paddle_operator_tpu.cli import run
+    from paddle_operator_tpu.k8s.fake import FakeKubeClient
+
+    t = _good_template()
+    t["spec"]["containers"][0]["imagee"] = "typo"
+    path = tmp_path / "bad.yaml"
+    path.write_text(yaml.safe_dump(_job_with_template(t)))
+    client = FakeKubeClient()
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    args = argparse.Namespace(cmd="submit", filename=str(path),
+                              namespace="default")
+    assert run(client, args) == 1
+    assert client.all_objects(api.KIND) == []
