@@ -23,8 +23,14 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# lse/delta are lane-replicated to this width: TPU blocks must have a
+# 128-multiple (or full-dim) minor axis, so per-row vectors are stored as
+# [rows, 128] with the value broadcast across lanes (the layout the
+# official jax.experimental.pallas TPU flash kernel uses for l/m).
+MIN_BLOCK = 128
 
 
 def _reference_attention(q, k, v, scale, causal=False):
@@ -88,7 +94,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_steps, body, (acc, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, MIN_BLOCK))
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -100,11 +106,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     """
     q = q_ref[0].astype(jnp.float32)                     # [block_q, d]
     do = do_ref[0].astype(jnp.float32)                   # [block_q, d]
-    lse = lse_ref[0][:, None]                            # [block_q, 1]
-    delta = delta_ref[0][:, None]                        # [block_q, 1]
     block_q, head_dim = q.shape
     qi = pl.program_id(1)
     q_start = qi * block_q
+    # lane-replicated [block_q, MIN_BLOCK] -> tiled to [block_q, block_k]
+    # so the subtraction below stays lane-aligned (no sub-128 slicing)
+    reps = block_k // MIN_BLOCK
+    lse = jnp.tile(lse_ref[0], (1, reps))
+    delta = jnp.tile(delta_ref[0], (1, reps))
 
     def body(i, dq):
         k_start = i * block_k
@@ -141,26 +150,40 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, block_q, seq_len, causal):
-    """dK/dV pass, one (batch·head, kv-tile) cell: stream Q tiles.
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, n_q_tiles,
+                causal):
+    """dK/dV pass over a (batch·head, kv-tile, q-tile) grid.
 
-    dV_j = Σ_i P_ij dO_i;  dK_j = scale * Σ_i dS_ij Q_i.
-    Causal: Q tiles strictly above the diagonal are skipped (dynamic lower
-    loop bound), mirroring the forward's FLOP saving.
+    dV_j = Σ_i P_ij dO_i;  dK_j = scale · Σ_i dS_ij Q_i. The q-tile axis is
+    the FASTEST grid axis, so the dk/dv output blocks (indexed by kv-tile
+    only) are revisited consecutively: partial sums accumulate in fp32 VMEM
+    scratch and are written back once on the last q-tile — the canonical
+    Pallas-TPU accumulation pattern. Causal: q-tiles strictly above the
+    diagonal contribute nothing and are skipped via pl.when.
     """
     k = k_ref[0].astype(jnp.float32)                     # [block_k, d]
     v = v_ref[0].astype(jnp.float32)                     # [block_k, d]
     block_k, head_dim = k.shape
+    block_q = q_ref.shape[1]
     ki = pl.program_id(1)
+    qi = pl.program_id(2)
     k_start = ki * block_k
+    q_start = qi * block_q
 
-    def body(i, carry):
-        dk, dv = carry
-        q_start = i * block_q
-        q_tile = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        do_tile = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(q_start, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(q_start, block_q)][:, None]
+    @pl.when(qi == 0)
+    def _zero():
+        dk_acc[...] = jnp.zeros((block_k, head_dim), jnp.float32)
+        dv_acc[...] = jnp.zeros((block_k, head_dim), jnp.float32)
+
+    live = (q_start + block_q - 1 >= k_start) if causal else (qi >= 0)
+
+    @pl.when(live)
+    def _accumulate():
+        q_tile = q_ref[0].astype(jnp.float32)            # [block_q, d]
+        do_tile = do_ref[0].astype(jnp.float32)
+        reps = block_k // MIN_BLOCK
+        lse = jnp.tile(lse_ref[0], (1, reps))            # [block_q, block_k]
+        delta = jnp.tile(delta_ref[0], (1, reps))
         s = jax.lax.dot_general(                         # [block_q, block_k]
             q_tile, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -172,7 +195,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)                             # [block_q, block_k]
-        dv = dv + jax.lax.dot_general(                   # P^T dO
+        dv_acc[...] += jax.lax.dot_general(              # P^T dO
             p, do_tile, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -181,19 +204,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dov - delta)
-        dk = dk + jax.lax.dot_general(                   # dS^T Q
+        dk_acc[...] += jax.lax.dot_general(              # dS^T Q
             ds, q_tile, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
-    n_q_tiles = seq_len // block_q
-    start = k_start // block_q if causal else 0
-    dk0 = jnp.zeros((block_k, head_dim), jnp.float32)
-    dv0 = jnp.zeros((block_k, head_dim), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, n_q_tiles, body, (dk0, dv0))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_q_tiles - 1)
+    def _write():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
@@ -205,9 +224,6 @@ def _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
 
     def kv_index(bh, qi):
         return (bh, 0, 0)
-
-    def lse_index(bh, qi):
-        return (bh, qi)
 
     q3 = q.reshape(b * h, s, d)
     k3 = k.reshape(b * h, s, d)
@@ -224,11 +240,12 @@ def _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), qo_index),
-            pl.BlockSpec((1, block_q), lse_index),
+            pl.BlockSpec((1, block_q, MIN_BLOCK), qo_index),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+            # lane-replicated lse (see MIN_BLOCK comment at top)
+            jax.ShapeDtypeStruct((b * h, s, MIN_BLOCK), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
@@ -240,21 +257,17 @@ def _flash_bwd(q, k, v, out, lse, g, scale, block_q, block_k, interpret,
     b, h, s, d = q.shape
     q3, k3, v3 = (x.reshape(b * h, s, d) for x in (q, k, v))
     do3 = g.reshape(b * h, s, d)
-    # delta_i = Σ_d dO_i O_i — O(S·D) rowwise reduce, fused by XLA
+    # delta_i = Σ_d dO_i O_i — O(S·D) rowwise reduce, fused by XLA;
+    # lane-replicated like the lse so kernel reads stay 128-aligned
     delta = jnp.sum(do3.astype(jnp.float32)
                     * out.reshape(b * h, s, d).astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b * h, s, MIN_BLOCK))
 
     def qo_index(bh, qi):
         return (bh, qi, 0)
 
     def full_index(bh, qi):
         return (bh, 0, 0)
-
-    def row_tile_index(bh, qi):
-        return (bh, qi)
-
-    def row_full_index(bh, qi):
-        return (bh, 0)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_k=block_k,
@@ -265,36 +278,43 @@ def _flash_bwd(q, k, v, out, lse, g, scale, block_q, block_k, interpret,
             pl.BlockSpec((1, s, d), full_index),
             pl.BlockSpec((1, s, d), full_index),
             pl.BlockSpec((1, block_q, d), qo_index),
-            pl.BlockSpec((1, block_q), row_tile_index),
-            pl.BlockSpec((1, block_q), row_tile_index),
+            pl.BlockSpec((1, block_q, MIN_BLOCK), qo_index),
+            pl.BlockSpec((1, block_q, MIN_BLOCK), qo_index),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), qo_index),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
 
-    def kv_tile_index(bh, ki):
+    def dkv_q_index(bh, ki, qi):
+        return (bh, qi, 0)
+
+    def dkv_kv_index(bh, ki, qi):
         return (bh, ki, 0)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
-                          seq_len=s, causal=causal),
-        grid=(b * h, s // block_k),
+        functools.partial(_dkv_kernel, scale=scale,
+                          n_q_tiles=s // block_q, causal=causal),
+        grid=(b * h, s // block_k, s // block_q),
         in_specs=[
-            pl.BlockSpec((1, s, d), full_index),
-            pl.BlockSpec((1, block_k, d), kv_tile_index),
-            pl.BlockSpec((1, block_k, d), kv_tile_index),
-            pl.BlockSpec((1, s, d), full_index),
-            pl.BlockSpec((1, s), row_full_index),
-            pl.BlockSpec((1, s), row_full_index),
+            pl.BlockSpec((1, block_q, d), dkv_q_index),
+            pl.BlockSpec((1, block_k, d), dkv_kv_index),
+            pl.BlockSpec((1, block_k, d), dkv_kv_index),
+            pl.BlockSpec((1, block_q, d), dkv_q_index),
+            pl.BlockSpec((1, block_q, MIN_BLOCK), dkv_q_index),
+            pl.BlockSpec((1, block_q, MIN_BLOCK), dkv_q_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), kv_tile_index),
-            pl.BlockSpec((1, block_k, d), kv_tile_index),
+            pl.BlockSpec((1, block_k, d), dkv_kv_index),
+            pl.BlockSpec((1, block_k, d), dkv_kv_index),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
@@ -337,4 +357,10 @@ def flash_attention(q, k, v, scale=None, block_q: int = 128,
     """q,k,v: [B, H, S, D] → [B, H, S, D]. Differentiable."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if block_q % MIN_BLOCK or block_k % MIN_BLOCK:
+        # the lane-replicated lse/delta layout tiles by MIN_BLOCK; smaller
+        # blocks would silently produce zero-width tiles in the backward
+        raise ValueError(
+            "block_q/block_k must be multiples of %d, got %d/%d"
+            % (MIN_BLOCK, block_q, block_k))
     return _flash_attention(q, k, v, scale, block_q, block_k, interpret, causal)
