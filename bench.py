@@ -40,7 +40,9 @@ RESNET50_TRAIN_FLOPS_PER_IMAGE = 12.4e9
 
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
-STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+# 50 steps: per-dispatch jitter through the TPU relay dominates short
+# windows; a longer async-dispatched window stabilizes the mean
+STEPS = int(os.environ.get("BENCH_STEPS", "50"))
 
 # Per-stage deadlines (seconds). `child_up` covers interpreter start incl.
 # the axon sitecustomize TPU claim -- the exact spot round 1 wedged.
@@ -104,20 +106,26 @@ def child_main():
     # so the bench measures ITS OWN matmul ceiling in the same process and
     # reports MFU against that — comparable across rounds by construction.
     _stage("calibrate")
-    calib_dim = int(os.environ.get("BENCH_CALIB_DIM", "4096"))
+    calib_dim = int(os.environ.get("BENCH_CALIB_DIM", "8192"))
+    calib_iters = int(os.environ.get("BENCH_CALIB_ITERS", "16"))
     a = jnp.ones((calib_dim, calib_dim), jnp.bfloat16)
-    mm = jax.jit(lambda m: m @ m)
-    jax.block_until_ready(mm(a))  # compile
-    iters = 8
+
+    # ONE dispatch containing `calib_iters` chained matmuls: per-call
+    # dispatch latency (which dominates wall-clock through the relay) is
+    # amortized away, so this measures the device's matmul ceiling, not
+    # the link's round-trip — without it MFU can exceed 1.0
+    @jax.jit
+    def mm_chain(x):
+        return jax.lax.fori_loop(
+            0, calib_iters, lambda i, y: x @ y, x)
+
+    jax.block_until_ready(mm_chain(a))  # compile
     t0 = time.perf_counter()
-    r = a
-    for _ in range(iters):
-        r = mm(a)
-    jax.block_until_ready(r)
+    jax.block_until_ready(mm_chain(a))
     dt_c = time.perf_counter() - t0
-    calib_tflops = 2.0 * calib_dim ** 3 * iters / dt_c / 1e12
-    _log("calibration: %.1f TFLOP/s sustained on %d^3 bf16 matmul"
-         % (calib_tflops, calib_dim))
+    calib_tflops = 2.0 * calib_dim ** 3 * calib_iters / dt_c / 1e12
+    _log("calibration: %.1f TFLOP/s sustained over %d chained %d^3 "
+         "bf16 matmuls" % (calib_tflops, calib_iters, calib_dim))
 
     from paddle_operator_tpu.models import resnet
     from paddle_operator_tpu.ops import optim
@@ -166,8 +174,11 @@ def child_main():
         "batch": batch,
         "step_ms": round(1000.0 * dt / STEPS, 2),
         "calib_matmul_tflops": round(calib_tflops, 1),
-        # model FLOPs achieved / this environment's OWN matmul ceiling —
-        # the efficiency number that survives the relay's unphysical clock
+        # model FLOPs achieved / this environment's OWN matmul ceiling
+        # (measured as a single dispatch of chained matmuls, so the ceiling
+        # is device-bound, not dispatch-latency-bound). In this relay
+        # environment the ceiling is not physically a v5e — treat mfu as a
+        # cross-round-comparable efficiency ratio, not hardware utilization.
         "mfu": round(images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE
                      / (calib_tflops * 1e12), 4),
     }
@@ -248,7 +259,9 @@ def _attention_bench(backend):
 
         entry = {"seq": s, "batch": b, "heads": h, "head_dim": d,
                  "mode": "fwd+bwd", "causal": True}
-        iters = 3
+        # per-iter device time is tiny relative to relay dispatch jitter
+        # (~ms); a long async-dispatched train amortizes it
+        iters = int(os.environ.get("BENCH_ATTN_ITERS", "100"))
         flash_s = _time_fn(
             jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2))), (q, k, v),
             iters)
@@ -286,13 +299,21 @@ def _pipeline_bench(step, state, batch_data):
     img = int(batch_data["image"].shape[1])
     n_steps = int(os.environ.get("BENCH_PIPELINE_STEPS", "8"))
 
-    def host_batch(i):
+    # pre-generate a small rotation of host batches: generating 512x224^2
+    # fresh every step costs ~300ms of HOST time in the loader thread,
+    # which would dominate both modes and hide the H2D/dispatch overlap
+    # this bench exists to measure
+    pool = []
+    for i in range(4):
         rng = np.random.default_rng(i)
-        return {
+        pool.append({
             "image": rng.standard_normal(
                 (bsz, img, img, 3), dtype=np.float32).astype(jnp.bfloat16),
             "label": rng.integers(0, 1000, (bsz,), dtype=np.int32),
-        }
+        })
+
+    def host_batch(i):
+        return pool[i % len(pool)]
 
     shardings = jax.tree_util.tree_map(lambda l: l.sharding, batch_data)
 
@@ -456,8 +477,10 @@ def _parse_result(att):
 def parent_main():
     total_budget = float(os.environ.get("BENCH_TIMEOUT", "840"))
     t_start = time.monotonic()
-    first_batch = int(os.environ.get("BENCH_BATCH", "256"))
-    ladder = [b for b in (first_batch, 64, 8) if b <= first_batch]
+    # 512 is the measured single-chip sweet spot (step time is dispatch-
+    # latency-bound, so images/step is the lever; 1024 OOMs)
+    first_batch = int(os.environ.get("BENCH_BATCH", "512"))
+    ladder = [b for b in (first_batch, 256, 64, 8) if b <= first_batch]
     ladder = sorted(set(ladder), reverse=True)
 
     attempts = []

@@ -326,11 +326,72 @@ class HttpKubeClient(KubeClient):
                     raise err
                 yield etype, obj
 
-    def exec_in_pod(self, namespace, pod_name, container, command):
-        # Pod exec requires SPDY/WebSocket upgrade; stdlib has neither. The
-        # production deployment uses the coordinator sidecar's HTTP release
-        # endpoint instead (see controllers/coordination.py), which supersedes
-        # exec entirely on TPU — kept for interface parity.
-        raise NotImplementedError(
-            "exec requires SPDY; use the HTTP coordination channel instead"
+    def exec_in_pod(self, namespace, pod_name, container, command,
+                    timeout=60.0):
+        """Exec over the apiserver's WebSocket transport (v4.channel.k8s.io:
+        binary frames, first byte = stream id; 1 stdout, 2 stderr, 3 error
+        Status). The reference does this over SPDY via client-go
+        (paddlejob_controller.go:491-518); WebSocket is the equivalent the
+        apiserver serves that stdlib sockets can speak (k8s/websocket.py).
+        The startup path normally uses the HTTP coordination channel
+        instead (controllers/coordination.py); this exists for parity and
+        ad-hoc diagnostics. Returns stdout; raises ApiError on failure.
+        """
+        from . import websocket as ws
+
+        query = [("container", container), ("stdout", "1"), ("stderr", "1")]
+        query += [("command", c) for c in command]
+        prefix, plural = self._routes["Pod"]
+        url = "%s/%s/namespaces/%s/%s/%s/exec?%s" % (
+            self.base_url, prefix, namespace, plural, pod_name,
+            urllib.parse.urlencode(query),
         )
+        headers = []
+        if self._token:
+            headers.append(("Authorization", "Bearer " + self._token))
+        try:
+            conn = ws.connect(
+                url, headers=headers,
+                subprotocols=["v4.channel.k8s.io"],
+                ssl_context=self._ssl if url.startswith("https") else None,
+                timeout=timeout,
+            )
+        except ws.WebSocketError as e:
+            if e.status_code == 404:
+                raise NotFoundError("exec: %s" % e)
+            if e.status_code == 401:
+                raise UnauthorizedError("exec: %s" % e)
+            raise ApiError("exec upgrade failed: %s" % e)
+        except OSError as e:  # DNS, refused, TLS, socket timeout
+            raise ApiError("exec connect failed: %s" % e)
+        stdout, stderr, status = [], [], None
+        try:
+            for _op, payload in conn.frames():
+                if not payload:
+                    continue
+                channel, data = payload[0], payload[1:]
+                if channel == 1:
+                    stdout.append(data)
+                elif channel == 2:
+                    stderr.append(data)
+                elif channel == 3:
+                    try:
+                        status = json.loads(data.decode())
+                    except ValueError:
+                        status = {"status": "Failure",
+                                  "message": data.decode(errors="replace")}
+        except (ws.WebSocketError, OSError) as e:
+            raise ApiError("exec stream dropped: %s (partial stdout: %r)"
+                           % (e, b"".join(stdout)[:200]))
+        finally:
+            conn.close()
+        if status is None:
+            # stream ended without the terminal Status frame: treat as
+            # failure — partial output must never masquerade as success
+            raise ApiError("exec ended without a status frame "
+                           "(partial stdout: %r)" % b"".join(stdout)[:200])
+        if status.get("status") == "Failure":
+            raise ApiError("exec failed: %s (stderr: %s)" % (
+                status.get("message", ""),
+                b"".join(stderr).decode(errors="replace")))
+        return b"".join(stdout).decode(errors="replace")

@@ -45,6 +45,11 @@ class StubApiServer:
         self.store = FakeKubeClient()
         self.token = token
         self.requests: List[Tuple[str, str]] = []  # (method, path?query)
+        # WebSocket exec route: fn(ns, pod, container, command) -> stdout.
+        # Raising -> Failure status on channel 3 (like a real kubelet).
+        self.exec_handler = None
+        self.exec_calls: List[Tuple[str, str, str, tuple]] = []
+        self.fragment_exec_frames = False  # test RFC6455 reassembly
         self._plurals: Dict[str, str] = dict(_BUILTIN_PLURALS)
         # watch history: (seq, etype, obj). seq is the global rv counter;
         # DELETED events get a fresh seq (real apiservers bump rv on delete)
@@ -148,7 +153,11 @@ class StubApiServer:
             return
         kind, namespace, name, subresource = route
         try:
-            if method == "GET" and name is None and query.get("watch"):
+            if (method == "GET" and kind == "Pod" and subresource == "exec"
+                    and "websocket" in req.headers.get("Upgrade", "").lower()):
+                raw_query = urllib.parse.parse_qsl(parsed.query)
+                self._serve_exec(req, namespace, name, raw_query)
+            elif method == "GET" and name is None and query.get("watch"):
                 self._serve_watch(req, kind, namespace, query)
             elif method == "GET" and name is None:
                 self._serve_list(req, kind, namespace, query)
@@ -313,6 +322,64 @@ class StubApiServer:
                 except OSError:
                     pass
                 return
+
+    def _serve_exec(self, req, namespace, name, raw_query) -> None:
+        """Kubelet-style exec over WebSocket (v4.channel.k8s.io): upgrade,
+        stream stdout on channel 1, final Status on channel 3, close."""
+        from . import websocket as ws
+
+        self.store.get("Pod", namespace, name)  # 404s before upgrading
+        key = req.headers.get("Sec-WebSocket-Key")
+        if not key:
+            raise ApiError("missing Sec-WebSocket-Key")
+        command = tuple(v for k, v in raw_query if k == "command")
+        container = next((v for k, v in raw_query if k == "container"), "")
+        self.exec_calls.append((namespace, name, container, command))
+
+        proto = (req.headers.get("Sec-WebSocket-Protocol") or
+                 "").split(",")[0].strip()
+        lines = [
+            "HTTP/1.1 101 Switching Protocols",
+            "Upgrade: websocket",
+            "Connection: Upgrade",
+            "Sec-WebSocket-Accept: %s" % ws.accept_key(key),
+        ]
+        if proto:
+            lines.append("Sec-WebSocket-Protocol: %s" % proto)
+        req.wfile.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+
+        status = {"status": "Success",
+                  "metadata": {}, "kind": "Status", "apiVersion": "v1"}
+        out = ""
+        try:
+            if self.exec_handler is not None:
+                out = self.exec_handler(namespace, name, container,
+                                        list(command)) or ""
+            else:
+                out = " ".join(command) + "\n"  # echo, like a shell would
+        except Exception as e:
+            status = {"status": "Failure", "message": str(e),
+                      "kind": "Status", "apiVersion": "v1"}
+        frames = []
+        if out:
+            data = b"\x01" + out.encode()
+            if self.fragment_exec_frames and len(data) > 2:
+                mid = len(data) // 2
+                frames.append(ws.encode_frame(
+                    ws.OP_BINARY, data[:mid], mask=False, fin=False))
+                frames.append(ws.encode_frame(
+                    ws.OP_CONT, data[mid:], mask=False))
+            else:
+                frames.append(ws.encode_frame(ws.OP_BINARY, data, mask=False))
+        frames.append(ws.encode_frame(
+            ws.OP_BINARY, b"\x03" + json.dumps(status).encode(), mask=False))
+        frames.append(ws.encode_frame(ws.OP_CLOSE, b"", mask=False))
+        try:
+            req.wfile.write(b"".join(frames))
+            req.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        req.close_connection = True
 
     # -- response helpers ------------------------------------------------
 

@@ -1,0 +1,232 @@
+"""Minimal RFC 6455 WebSocket client — enough for Kubernetes exec.
+
+The reference execs into pods over SPDY via client-go
+(``paddlejob_controller.go:491-518``); SPDY needs a full transport stack,
+but the apiserver ALSO serves exec over WebSocket (subprotocol
+``v4.channel.k8s.io``: binary frames whose first byte is the stream id —
+0 stdin, 1 stdout, 2 stderr, 3 error/status). That is implementable on
+stdlib sockets, which is what this module does: HTTP/1.1 Upgrade
+handshake, client-masked frames, server frame parsing (FIN/opcode/
+extended lengths), ping/pong, close.
+
+Used by :meth:`HttpKubeClient.exec_in_pod`; exercised hermetically
+against the stub apiserver's WebSocket exec route (k8s/envtest.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import socket
+import ssl as ssl_mod
+import struct
+import urllib.parse
+from typing import Iterator, List, Optional, Tuple
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+class WebSocketError(Exception):
+    def __init__(self, message: str, status_code: Optional[int] = None):
+        super().__init__(message)
+        self.status_code = status_code
+
+
+def accept_key(client_key: str) -> str:
+    """Sec-WebSocket-Accept for a Sec-WebSocket-Key (shared with servers)."""
+    digest = hashlib.sha1((client_key + _WS_GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def encode_frame(opcode: int, payload: bytes, mask: bool,
+                 fin: bool = True) -> bytes:
+    """One frame (``fin=False`` starts/continues a fragmented message).
+    Clients MUST mask (RFC 6455 §5.3)."""
+    head = bytes([(0x80 if fin else 0x00) | opcode])
+    n = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if n < 126:
+        head += bytes([mask_bit | n])
+    elif n < (1 << 16):
+        head += bytes([mask_bit | 126]) + struct.pack(">H", n)
+    else:
+        head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
+    if not mask:
+        return head + payload
+    key = os.urandom(4)
+    masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return head + key + masked
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WebSocketError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def read_frame(sock) -> Tuple[bool, int, bytes]:
+    """-> (fin, opcode, payload); handles masked and unmasked frames."""
+    b0, b1 = _read_exact(sock, 2)
+    fin = bool(b0 & 0x80)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        (n,) = struct.unpack(">H", _read_exact(sock, 2))
+    elif n == 127:
+        (n,) = struct.unpack(">Q", _read_exact(sock, 8))
+    key = _read_exact(sock, 4) if masked else None
+    payload = _read_exact(sock, n) if n else b""
+    if key:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return fin, opcode, payload
+
+
+class WebSocket:
+    """Client-side connection (already upgraded)."""
+
+    def __init__(self, sock, subprotocol: str = ""):
+        self._sock = sock
+        self.subprotocol = subprotocol
+        self.closed_cleanly = False
+
+    def send(self, payload: bytes, opcode: int = OP_BINARY) -> None:
+        self._sock.sendall(encode_frame(opcode, payload, mask=True))
+
+    def frames(self) -> Iterator[Tuple[int, bytes]]:
+        """Yield complete data MESSAGES (fragments reassembled per RFC 6455
+        §5.4) until the peer sends Close. Pings are answered. A connection
+        that drops mid-stream raises; callers must not mistake a truncated
+        stream for a clean end (closed_cleanly tells them which it was)."""
+        self.closed_cleanly = False
+        msg_opcode: Optional[int] = None
+        parts: List[bytes] = []
+        while True:
+            fin, opcode, payload = read_frame(self._sock)
+            if opcode == OP_CLOSE:  # control frames are never fragmented
+                self.closed_cleanly = True
+                try:
+                    self._sock.sendall(
+                        encode_frame(OP_CLOSE, payload, mask=True))
+                except OSError:
+                    pass
+                return
+            if opcode == OP_PING:
+                self.send(payload, OP_PONG)
+                continue
+            if opcode == OP_PONG:
+                continue
+            if opcode == OP_CONT:
+                if msg_opcode is None:
+                    raise WebSocketError("continuation without a message")
+                parts.append(payload)
+            else:
+                if msg_opcode is not None:
+                    raise WebSocketError("interleaved fragmented messages")
+                msg_opcode = opcode
+                parts = [payload]
+            if fin:
+                yield msg_opcode, b"".join(parts)
+                msg_opcode, parts = None, []
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(encode_frame(OP_CLOSE, b"", mask=True))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(url: str, headers: Optional[List[Tuple[str, str]]] = None,
+            subprotocols: Optional[List[str]] = None,
+            ssl_context: Optional["ssl_mod.SSLContext"] = None,
+            timeout: float = 30.0) -> WebSocket:
+    """Open + upgrade. ``url`` uses http(s) or ws(s) scheme."""
+    parts = urllib.parse.urlsplit(url)
+    secure = parts.scheme in ("https", "wss")
+    host = parts.hostname or "localhost"
+    port = parts.port or (443 if secure else 80)
+    path = parts.path + ("?" + parts.query if parts.query else "")
+
+    sock = socket.create_connection((host, port), timeout=timeout)
+    if secure:
+        ctx = ssl_context or ssl_mod.create_default_context()
+        sock = ctx.wrap_socket(sock, server_hostname=host)
+
+    key = base64.b64encode(os.urandom(16)).decode()
+    lines = [
+        "GET %s HTTP/1.1" % (path or "/"),
+        "Host: %s:%d" % (host, port),
+        "Upgrade: websocket",
+        "Connection: Upgrade",
+        "Sec-WebSocket-Key: %s" % key,
+        "Sec-WebSocket-Version: 13",
+    ]
+    if subprotocols:
+        lines.append("Sec-WebSocket-Protocol: %s" % ", ".join(subprotocols))
+    for name, value in headers or []:
+        lines.append("%s: %s" % (name, value))
+    sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+
+    # read the 101 response headers
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise WebSocketError("connection closed during handshake")
+        buf += chunk
+        if len(buf) > 65536:
+            raise WebSocketError("oversized handshake response")
+    head, _, extra = buf.partition(b"\r\n\r\n")
+    status_line, *header_lines = head.decode("latin1").split("\r\n")
+    if " 101 " not in status_line + " ":
+        code = None
+        parts_sl = status_line.split()
+        if len(parts_sl) >= 2 and parts_sl[1].isdigit():
+            code = int(parts_sl[1])
+        raise WebSocketError("upgrade refused: %s" % status_line, code)
+    got = {}
+    for line in header_lines:
+        name, _, value = line.partition(":")
+        got[name.strip().lower()] = value.strip()
+    if got.get("sec-websocket-accept") != accept_key(key):
+        raise WebSocketError("bad Sec-WebSocket-Accept")
+    if extra:
+        # data arriving with the handshake: push back via a buffer wrapper
+        sock = _PushbackSocket(sock, extra)
+    return WebSocket(sock, got.get("sec-websocket-protocol", ""))
+
+
+class _PushbackSocket:
+    """Socket facade replaying bytes that arrived glued to the handshake."""
+
+    def __init__(self, sock, pending: bytes):
+        self._sock = sock
+        self._pending = pending
+
+    def recv(self, n: int) -> bytes:
+        if self._pending:
+            out, self._pending = self._pending[:n], self._pending[n:]
+            return out
+        return self._sock.recv(n)
+
+    def sendall(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def close(self) -> None:
+        self._sock.close()

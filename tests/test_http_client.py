@@ -264,6 +264,63 @@ def test_watch_midstream_error_other_code_raises_apierror(srv, client):
     assert not isinstance(exc.value, GoneError)
 
 
+# -- exec over WebSocket -------------------------------------------------
+
+
+def test_exec_over_websocket_roundtrip(srv, client):
+    client.create(pod("a"))
+    srv.exec_handler = lambda ns, name, ctr, cmd: "hello from %s\n" % name
+    out = client.exec_in_pod("default", "a", "c", ["sh", "-c", "echo hi"])
+    assert out == "hello from a\n"
+    assert srv.exec_calls == [
+        ("default", "a", "c", ("sh", "-c", "echo hi"))]
+
+
+def test_exec_default_echo_and_url_shape(srv, client):
+    client.create(pod("a"))
+    out = client.exec_in_pod("default", "a", "c", ["touch", "goon"])
+    assert out == "touch goon\n"
+    assert any("/pods/a/exec" in path and "command=touch" in path
+               for _, path in srv.requests)
+
+
+def test_exec_failure_status_raises(srv, client):
+    from paddle_operator_tpu.k8s.errors import ApiError
+
+    client.create(pod("a"))
+
+    def boom(ns, name, ctr, cmd):
+        raise RuntimeError("container not running")
+
+    srv.exec_handler = boom
+    with pytest.raises(ApiError, match="container not running"):
+        client.exec_in_pod("default", "a", "c", ["true"])
+
+
+def test_exec_reassembles_fragmented_frames(srv, client):
+    """A peer may legally split one message across FIN=0 + continuation
+    frames; the channel id must be read once per MESSAGE, not per frame."""
+    client.create(pod("a"))
+    srv.fragment_exec_frames = True
+    srv.exec_handler = lambda ns, name, ctr, cmd: "abcdefghij\n"
+    assert client.exec_in_pod("default", "a", "c", ["cat"]) == "abcdefghij\n"
+
+
+def test_exec_missing_pod_404(client):
+    with pytest.raises(NotFoundError):
+        client.exec_in_pod("default", "ghost", "c", ["true"])
+
+
+def test_exec_with_bearer_token():
+    srv = StubApiServer(token="tok").start()
+    try:
+        good = HttpKubeClient(base_url=srv.url, token="tok")
+        good.create(pod("a"))
+        assert good.exec_in_pod("default", "a", "c", ["id"]) == "id\n"
+    finally:
+        srv.stop()
+
+
 def test_watch_namespace_filter(srv, client):
     client.create(pod("a", ns="ns1"))
     client.create(pod("b", ns="ns2"))
