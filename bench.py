@@ -53,7 +53,8 @@ STAGE_DEADLINES = {
     "calibrate": float(os.environ.get("BENCH_T_CALIBRATE", "120")),
     "model_init": float(os.environ.get("BENCH_T_INIT", "120")),
     "compile_warmup": float(os.environ.get("BENCH_T_COMPILE", "360")),
-    "measure": float(os.environ.get("BENCH_T_MEASURE", "180")),
+    # 2 windows x 50 steps now; scale the old 20-step/180s allowance
+    "measure": float(os.environ.get("BENCH_T_MEASURE", "420")),
     # extras run AFTER the core JSON is already on stdout: a wedged extra
     # loses only the enrichment, never the headline number
     "attention_bench": float(os.environ.get("BENCH_T_ATTENTION", "300")),
@@ -158,13 +159,19 @@ def child_main():
          % (WARMUP, time.perf_counter() - t0))
 
     _stage("measure")
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, metrics = step(state, batch_data)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    images_per_sec = batch * STEPS / dt
+    # two independent windows, best wins: the relay's wall-clock has large
+    # transient congestion (observed 2x swings between identical runs);
+    # the best window is the closest observable to the device's steady state
+    window_rates = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, metrics = step(state, batch_data)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        window_rates.append(batch * STEPS / dt)
+    images_per_sec = max(window_rates)
+    dt = batch * STEPS / images_per_sec
     result = {
         "metric": "resnet50_train_images_per_sec",
         "value": round(images_per_sec, 2),
@@ -173,6 +180,7 @@ def child_main():
         "backend": backend,
         "batch": batch,
         "step_ms": round(1000.0 * dt / STEPS, 2),
+        "window_images_per_sec": [round(r, 1) for r in window_rates],
         "calib_matmul_tflops": round(calib_tflops, 1),
         # model FLOPs achieved / this environment's OWN matmul ceiling
         # (measured as a single dispatch of chained matmuls, so the ceiling
@@ -207,16 +215,22 @@ def child_main():
         sys.stdout.flush()
 
 
-def _time_fn(fn, args, iters):
+def _time_fn(fn, args, iters, repeats=2):
+    """Best of `repeats` async-dispatched windows (relay congestion makes
+    any single window untrustworthy — see the measure stage)."""
     import jax
 
     jax.block_until_ready(fn(*args))  # compile + warm
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    best = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return best
 
 
 def _attention_bench(backend):

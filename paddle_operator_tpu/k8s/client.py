@@ -73,8 +73,12 @@ class KubeClient:
         raise NotImplementedError
 
     def exec_in_pod(
-        self, namespace: str, pod_name: str, container: str, command: List[str]
+        self, namespace: str, pod_name: str, container: str,
+        command: List[str], timeout: float = 60.0,
     ) -> str:
+        """``timeout`` is an IDLE timeout: the max silence between frames
+        from the peer, not a total deadline (a long-running command that
+        keeps producing output is fine; one silent past it fails)."""
         raise NotImplementedError
 
     # -- helpers shared by implementations ---------------------------------
@@ -336,6 +340,8 @@ class HttpKubeClient(KubeClient):
         The startup path normally uses the HTTP coordination channel
         instead (controllers/coordination.py); this exists for parity and
         ad-hoc diagnostics. Returns stdout; raises ApiError on failure.
+        ``timeout`` bounds connect AND per-frame silence (idle timeout) —
+        it is not a total deadline; see the base-class docstring.
         """
         from . import websocket as ws
 

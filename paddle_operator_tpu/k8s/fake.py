@@ -200,7 +200,8 @@ class FakeKubeClient(KubeClient):
 
     # -- exec --------------------------------------------------------------
 
-    def exec_in_pod(self, namespace, pod_name, container, command):
+    def exec_in_pod(self, namespace, pod_name, container, command,
+                    timeout=60.0):
         self.exec_calls.append((namespace, pod_name, container, tuple(command)))
         if self.exec_handler is not None:
             return self.exec_handler(namespace, pod_name, container, command)

@@ -314,5 +314,7 @@ class CachedKubeClient(KubeClient):
         return self.inner.watch(kind, namespace, resource_version,
                                 timeout_seconds)
 
-    def exec_in_pod(self, namespace, pod_name, container, command):
-        return self.inner.exec_in_pod(namespace, pod_name, container, command)
+    def exec_in_pod(self, namespace, pod_name, container, command,
+                    timeout=60.0):
+        return self.inner.exec_in_pod(namespace, pod_name, container,
+                                      command, timeout)

@@ -70,7 +70,7 @@ def init(key, config: Optional[dict] = None) -> Dict:
     return params
 
 
-def _encoder_layer(layer, x, mask, dtype, attn_impl="einsum"):
+def _encoder_layer(layer, x, mask, dtype, attn_impl="auto"):
     from ..ops.moe import moe_apply
 
     y = nn.mha(layer["attn"], x, mask, dtype=dtype, impl=attn_impl)
@@ -87,7 +87,7 @@ def _encoder_layer(layer, x, mask, dtype, attn_impl="einsum"):
 
 
 def encode(params, input_ids, type_ids=None, attention_mask=None,
-           dtype=jnp.bfloat16, remat: bool = False, attn_impl: str = "einsum"):
+           dtype=jnp.bfloat16, remat: bool = False, attn_impl: str = "auto"):
     """input_ids: [B, S] -> (hidden states [B, S, H], aux loss scalar)."""
     b, s = input_ids.shape
     x = nn.embedding(params["embed"]["tok"], input_ids, dtype)
@@ -120,7 +120,7 @@ def mlm_logits(params, hidden, dtype=jnp.bfloat16):
 
 
 def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False,
-            attn_impl: str = "einsum", moe_aux_weight: float = 0.01):
+            attn_impl: str = "auto", moe_aux_weight: float = 0.01):
     """Masked-LM loss. batch = {input_ids, labels, [type_ids, attention_mask,
     loss_mask]}; labels [B,S] with ignored positions marked by loss_mask=0."""
     hidden, moe_aux = encode(

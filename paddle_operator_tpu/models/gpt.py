@@ -86,7 +86,7 @@ def _block(layer, x, dtype, attn_impl, positions):
 
 
 def apply(params, input_ids, dtype=jnp.bfloat16, remat: bool = False,
-          attn_impl="einsum", positions: Optional[jnp.ndarray] = None):
+          attn_impl="auto", positions: Optional[jnp.ndarray] = None):
     """input_ids: [B, S] -> (logits [B, S, V] fp32, moe aux loss scalar)."""
     x = nn.embedding(params["embed"]["tok"], input_ids, dtype)
 
@@ -103,7 +103,7 @@ def apply(params, input_ids, dtype=jnp.bfloat16, remat: bool = False,
 
 
 def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False,
-            attn_impl="einsum", moe_aux_weight: float = 0.01):
+            attn_impl="auto", moe_aux_weight: float = 0.01):
     """Next-token LM loss. batch = {"input_ids" [B,S], optional "loss_mask"}.
 
     Labels are input_ids shifted left; the final position is dropped. A

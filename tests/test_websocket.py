@@ -1,0 +1,141 @@
+"""RFC 6455 primitives (k8s/websocket.py): frame codec, handshake keys,
+and reassembly edge cases. The end-to-end exec path is covered in
+test_http_client.py against the stub apiserver.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from paddle_operator_tpu.k8s import websocket as ws
+
+
+def _pipe():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_accept_key_rfc_example():
+    # the worked example from RFC 6455 §1.3
+    assert ws.accept_key("dGhlIHNhbXBsZSBub25jZQ==") == \
+        "s3pPLMBiTxaQ9kYGzzhZRbK+xOo="
+
+
+@pytest.mark.parametrize("mask", [False, True])
+@pytest.mark.parametrize("size", [0, 5, 126, 70000])
+def test_frame_roundtrip_all_length_encodings(mask, size):
+    payload = bytes(i % 251 for i in range(size))
+    a, b = _pipe()
+    try:
+        a.sendall(ws.encode_frame(ws.OP_BINARY, payload, mask=mask))
+        fin, opcode, got = ws.read_frame(b)
+        assert (fin, opcode, got) == (True, ws.OP_BINARY, payload)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fragmented_message_reassembled():
+    a, b = _pipe()
+    try:
+        a.sendall(ws.encode_frame(ws.OP_BINARY, b"hel", mask=False,
+                                  fin=False))
+        a.sendall(ws.encode_frame(ws.OP_CONT, b"lo", mask=False))
+        a.sendall(ws.encode_frame(ws.OP_CLOSE, b"", mask=False))
+        conn = ws.WebSocket(b)
+        msgs = list(conn.frames())
+        assert msgs == [(ws.OP_BINARY, b"hello")]
+        assert conn.closed_cleanly
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ping_answered_with_pong_midstream():
+    a, b = _pipe()
+    try:
+        a.sendall(ws.encode_frame(ws.OP_PING, b"hb", mask=False))
+        a.sendall(ws.encode_frame(ws.OP_BINARY, b"data", mask=False))
+        a.sendall(ws.encode_frame(ws.OP_CLOSE, b"", mask=False))
+        conn = ws.WebSocket(b)
+        msgs = list(conn.frames())
+        assert msgs == [(ws.OP_BINARY, b"data")]
+        fin, opcode, payload = ws.read_frame(a)  # the pong (masked)
+        assert (fin, opcode, payload) == (True, ws.OP_PONG, b"hb")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_truncated_stream_raises_not_silent_eof():
+    a, b = _pipe()
+    try:
+        frame = ws.encode_frame(ws.OP_BINARY, b"0123456789", mask=False)
+        a.sendall(frame[: len(frame) - 4])  # drop the tail
+        a.close()
+        conn = ws.WebSocket(b)
+        with pytest.raises(ws.WebSocketError, match="mid-frame"):
+            list(conn.frames())
+        assert not conn.closed_cleanly
+    finally:
+        b.close()
+
+
+def test_continuation_without_start_rejected():
+    a, b = _pipe()
+    try:
+        a.sendall(ws.encode_frame(ws.OP_CONT, b"orphan", mask=False))
+        with pytest.raises(ws.WebSocketError, match="continuation"):
+            list(ws.WebSocket(b).frames())
+    finally:
+        a.close()
+        b.close()
+
+
+def test_handshake_refused_carries_status_code():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        conn.sendall(b"HTTP/1.1 403 Forbidden\r\n"
+                     b"Content-Length: 0\r\n\r\n")
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(ws.WebSocketError) as exc:
+            ws.connect("http://127.0.0.1:%d/x" % port, timeout=5)
+        assert exc.value.status_code == 403
+    finally:
+        srv.close()
+        t.join(timeout=5)
+
+
+def test_handshake_bad_accept_key_rejected():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        conn.sendall(b"HTTP/1.1 101 Switching Protocols\r\n"
+                     b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                     b"Sec-WebSocket-Accept: bogus\r\n\r\n")
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(ws.WebSocketError, match="Accept"):
+            ws.connect("http://127.0.0.1:%d/x" % port, timeout=5)
+    finally:
+        srv.close()
+        t.join(timeout=5)
