@@ -253,7 +253,11 @@ def _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
 
 
 def _flash_bwd(q, k, v, out, lse, g, scale, block_q, block_k, interpret,
-               causal):
+               causal, lse_cotangent=None):
+    """``lse_cotangent`` ([b,h,s] or None): cotangent of the log-sum-exp
+    output when differentiating :func:`flash_attention_lse`. Since
+    d(lse)/d(scores) = P, its whole contribution folds into the existing
+    kernels as a shift of delta: ds = P·(dO·V - (delta - ḡ_lse))."""
     b, h, s, d = q.shape
     q3, k3, v3 = (x.reshape(b * h, s, d) for x in (q, k, v))
     do3 = g.reshape(b * h, s, d)
@@ -261,6 +265,8 @@ def _flash_bwd(q, k, v, out, lse, g, scale, block_q, block_k, interpret,
     # lane-replicated like the lse so kernel reads stay 128-aligned
     delta = jnp.sum(do3.astype(jnp.float32)
                     * out.reshape(b * h, s, d).astype(jnp.float32), axis=-1)
+    if lse_cotangent is not None:
+        delta = delta - lse_cotangent.reshape(b * h, s).astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (b * h, s, MIN_BLOCK))
 
     def qo_index(bh, qi):
@@ -343,6 +349,47 @@ def _flash_attention_bwd(scale, block_q, block_k, interpret, causal, res, g):
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_lse(q, k, v, scale, block_q, block_k, interpret, causal):
+    out, lse = _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal)
+    b, h, s, d = q.shape
+    return out, lse.reshape(b, h, s, MIN_BLOCK)[..., 0]
+
+
+def _flash_attention_lse_fwd(q, k, v, scale, block_q, block_k, interpret,
+                             causal):
+    out, lse = _flash_fwd(q, k, v, scale, block_q, block_k, interpret, causal)
+    b, h, s, d = q.shape
+    lse_row = lse.reshape(b, h, s, MIN_BLOCK)[..., 0]
+    return (out, lse_row), (q, k, v, out, lse)
+
+
+def _flash_attention_lse_bwd(scale, block_q, block_k, interpret, causal,
+                             res, cots):
+    q, k, v, out, lse = res
+    g_out, g_lse = cots
+    return _flash_bwd(q, k, v, out, lse, g_out, scale, block_q, block_k,
+                      interpret, causal, lse_cotangent=g_lse)
+
+
+_flash_attention_lse.defvjp(_flash_attention_lse_fwd, _flash_attention_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, scale=None, block_q: int = 128,
+                        block_k: int = 128, interpret: bool = False,
+                        causal: bool = False):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ([B, H, S], fp32) — the quantity that lets independently
+    computed attention blocks be merged exactly (ring/blockwise
+    composition): out = Σ_b softmax-weight(lse_b) · out_b. Differentiable
+    in both outputs."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    _check_blocks(q.shape, block_q, block_k)
+    return _flash_attention_lse(q, k, v, scale, block_q, block_k, interpret,
+                                causal)
+
+
 def supports(q_shape, dtype) -> bool:
     """Kernel applicability: seq tiles by 128, head_dim lane-friendly."""
     if len(q_shape) != 4:
@@ -357,10 +404,21 @@ def flash_attention(q, k, v, scale=None, block_q: int = 128,
     """q,k,v: [B, H, S, D] → [B, H, S, D]. Differentiable."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    _check_blocks(q.shape, block_q, block_k)
+    return _flash_attention(q, k, v, scale, block_q, block_k, interpret, causal)
+
+
+def _check_blocks(q_shape, block_q, block_k):
     if block_q % MIN_BLOCK or block_k % MIN_BLOCK:
         # the lane-replicated lse/delta layout tiles by MIN_BLOCK; smaller
         # blocks would silently produce zero-width tiles in the backward
         raise ValueError(
             "block_q/block_k must be multiples of %d, got %d/%d"
             % (MIN_BLOCK, block_q, block_k))
-    return _flash_attention(q, k, v, scale, block_q, block_k, interpret, causal)
+    s = q_shape[2]
+    if s % block_q or s % block_k:
+        # the grid floor-divides: a remainder would be silently DROPPED
+        # (garbage rows, not an error) — refuse loudly instead
+        raise ValueError(
+            "seq len %d must divide block_q=%d and block_k=%d"
+            % (s, block_q, block_k))

@@ -15,4 +15,6 @@ from .sharding import (  # noqa: F401
 )
 from .train import build_train_step  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
-from .context import ring_attention, ulysses_attention  # noqa: F401
+from .context import (  # noqa: F401
+    ring_attention, ring_flash_attention, ulysses_attention,
+)

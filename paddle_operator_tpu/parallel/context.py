@@ -71,15 +71,33 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Sequence-parallel attention over the ``axis`` ring. BHSD layout.
 
     S must divide by mesh.shape[axis]; each device computes its local Q
     shard's attention over the full sequence as KV blocks rotate past.
+
+    ``impl``: "auto" routes each hop through the fused Pallas flash kernel
+    on the TPU backend when the local shard qualifies
+    (:func:`ring_flash_attention`); "flash" forces it (interpret mode
+    off-TPU); "blockwise" keeps the XLA online-softmax scan below.
     """
     n = mesh.shape[axis]
     b, h, s, d = q.shape
     assert s % n == 0, "seq len %d must divide ring size %d" % (s, n)
+
+    from ..ops import attention_pallas
+
+    if impl == "flash" or (
+        impl == "auto"
+        and jax.default_backend() == "tpu"
+        and attention_pallas.supports((b, h, s // n, d), q.dtype)
+    ):
+        return ring_flash_attention(
+            q, k, v, mesh, axis=axis, causal=causal, scale=scale,
+            interpret=None if impl == "auto" else
+            jax.default_backend() != "tpu")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     s_local = s // n
@@ -164,6 +182,82 @@ def _local_flash_blockwise(q, k, v, scale, causal, block_k=512,
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
+def ring_flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Ring attention where each hop's block runs in the fused Pallas
+    flash kernel. BHSD layout; S must divide the ring size.
+
+    Per hop the kernel returns (normalized block output, log-sum-exp);
+    blocks merge exactly by lse weighting — out = Σ_b exp(lse_b - LSE)·o_b
+    — so memory stays O(S·D/n) per device while the MXU-heavy inner loops
+    run inside the kernel instead of XLA-fused einsums. Causality across
+    blocks is positional: a rotated block born on an earlier ring position
+    is fully visible, a later one contributes -inf weight; only the local
+    (hop-0) block needs the kernel's in-tile causal mask — which keeps the
+    kernel's static shape/flag structure intact inside ``lax.scan``.
+    Differentiable end to end: the kernel's custom VJP handles both the
+    output and lse cotangents (the merge uses lse), and ``ppermute``
+    transposes itself.
+    """
+    from ..ops.attention_pallas import flash_attention_lse
+
+    n = mesh.shape[axis]
+    b, h, s, d = q.shape
+    assert s % n == 0, "seq len %d must divide ring size %d" % (s, n)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,  # pallas outputs carry no vma metadata
+    )
+    def run(ql, kl, vl):
+        my = lax.axis_index(axis)
+        # hop 0: the local block — the only one needing the in-tile
+        # causal mask (static kernel flag)
+        out0, lse0 = flash_attention_lse(
+            ql, kl, vl, scale=scale, causal=causal, interpret=interpret)
+
+        def hop(carry, r):
+            kb, vb, m, num, den = carry
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            src = (my - r) % n  # block born on ring position `src`
+            o_r, lse_r = flash_attention_lse(
+                ql, kb, vb, scale=scale, causal=False, interpret=interpret)
+            if causal:
+                # earlier ring position => every token strictly precedes
+                # ours => fully visible; later => invisible
+                lse_r = jnp.where(src < my, lse_r, NEG_INF)
+            m_new = jnp.maximum(m, lse_r)
+            c_old = jnp.exp(m - m_new)
+            c_new = jnp.exp(lse_r - m_new)
+            num = num * c_old[..., None] + \
+                o_r.astype(jnp.float32) * c_new[..., None]
+            den = den * c_old + c_new
+            return (kb, vb, m_new, num, den), None
+
+        init = (kl, vl, lse0, out0.astype(jnp.float32),
+                jnp.ones_like(lse0))
+        (_, _, _, num, den), _ = lax.scan(hop, init, jnp.arange(1, n))
+        return (num / den[..., None]).astype(ql.dtype)
+
+    return run(q, k, v)
+
+
 def ulysses_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -206,6 +300,9 @@ def ulysses_attention(
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axes metadata, so the
+        # kernel path cannot pass shard_map's vma checker
+        check_vma=not use_flash,
     )
     def run(ql, kl, vl):
         def to_heads(x):     # [B, H, S/n, D] -> [B, H/n, S, D]
