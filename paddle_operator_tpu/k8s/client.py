@@ -210,7 +210,9 @@ class HttpKubeClient(KubeClient):
     # -- plumbing ----------------------------------------------------------
 
     def _url(self, kind: str, namespace: Optional[str], name: Optional[str] = None,
-             subresource: Optional[str] = None, query: Optional[dict] = None) -> str:
+             subresource: Optional[str] = None, query=None) -> str:
+        """``query``: dict, or list of pairs when a key repeats (urlencode
+        accepts both)."""
         prefix, plural = self._routes[kind]
         parts = [self.base_url, prefix]
         if namespace:
@@ -347,11 +349,7 @@ class HttpKubeClient(KubeClient):
 
         query = [("container", container), ("stdout", "1"), ("stderr", "1")]
         query += [("command", c) for c in command]
-        prefix, plural = self._routes["Pod"]
-        url = "%s/%s/namespaces/%s/%s/%s/exec?%s" % (
-            self.base_url, prefix, namespace, plural, pod_name,
-            urllib.parse.urlencode(query),
-        )
+        url = self._url("Pod", namespace, pod_name, "exec", query)
         headers = []
         if self._token:
             headers.append(("Authorization", "Bearer " + self._token))

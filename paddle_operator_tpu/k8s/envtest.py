@@ -299,6 +299,12 @@ class StubApiServer:
                        and self._history[cursor][0] <= since):
                     cursor += 1
 
+        def clean_eof():  # zero-length chunk: client sees end-of-stream
+            try:
+                req.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
         deadline = time.monotonic() + timeout
         while True:
             with self._cv:
@@ -306,10 +312,7 @@ class StubApiServer:
                     left = deadline - time.monotonic()
                     if left <= 0 or not self._cv.wait(min(left, 0.5)):
                         if time.monotonic() >= deadline:
-                            try:
-                                req.wfile.write(b"0\r\n\r\n")  # clean EOF
-                            except OSError:
-                                pass
+                            clean_eof()
                             return
                 batch = self._history[cursor:]
                 cursor = len(self._history)
@@ -317,10 +320,7 @@ class StubApiServer:
                 if not emit(etype, obj):
                     return
             if time.monotonic() >= deadline:
-                try:
-                    req.wfile.write(b"0\r\n\r\n")
-                except OSError:
-                    pass
+                clean_eof()
                 return
 
     def _serve_exec(self, req, namespace, name, raw_query) -> None:

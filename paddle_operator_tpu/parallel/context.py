@@ -94,10 +94,10 @@ def ring_attention(
         and jax.default_backend() == "tpu"
         and attention_pallas.supports((b, h, s // n, d), q.dtype)
     ):
+        # interpret=None: ring_flash_attention picks interpret mode itself
+        # from the backend — same decision either way
         return ring_flash_attention(
-            q, k, v, mesh, axis=axis, causal=causal, scale=scale,
-            interpret=None if impl == "auto" else
-            jax.default_backend() != "tpu")
+            q, k, v, mesh, axis=axis, causal=causal, scale=scale)
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     s_local = s // n

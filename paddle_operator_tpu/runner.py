@@ -11,6 +11,7 @@ from __future__ import annotations
 import functools
 import inspect
 import logging
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -46,9 +47,7 @@ def _cycle_mesh(axes, elastic=False):
         # device-subset meshes model np-resize ONLY for elastic jobs; a
         # static mesh smaller than the device count stays a loud
         # make_mesh error (it's a misconfiguration, not a shrink)
-        total = 1
-        for s in axes.values():
-            total *= s
+        total = math.prod(axes.values())
         devs = jax.devices()
         if total < len(devs):
             return make_mesh(axes, devices=devs[:total])
