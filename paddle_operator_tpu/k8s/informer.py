@@ -58,6 +58,19 @@ def _owner_key_of(obj: dict) -> Optional[OwnerKey]:
     return (ref.get("apiVersion", ""), ref.get("kind", ""), ns, ref.get("name", ""))
 
 
+def _rv_of(obj: Optional[dict]) -> Optional[int]:
+    """Numeric resourceVersion, or None when absent/opaque. rvs are
+    formally opaque but are etcd revisions everywhere that matters; when
+    unparsable we fall back to unconditional (pre-guard) behavior."""
+    if obj is None:
+        return None
+    rv = obj.get("metadata", {}).get("resourceVersion")
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return None
+
+
 class Informer:
     """Store + owner index for one kind. Thread-safe."""
 
@@ -75,6 +88,12 @@ class Informer:
         key = (obj.get("metadata", {}).get("namespace", "default"),
                obj.get("metadata", {}).get("name", ""))
         with self._lock:
+            cur_rv, new_rv = _rv_of(self._store.get(key)), _rv_of(obj)
+            if cur_rv is not None and new_rv is not None and cur_rv > new_rv:
+                # the cache already holds a NEWER version (write-through or
+                # a faster watch won the race): a stale replay must not
+                # regress it — resync snapshots race with live writes
+                return
             if etype == "DELETED":
                 old = self._store.pop(key, None)
                 self._unindex(old, key)
@@ -88,22 +107,44 @@ class Informer:
         for h in list(self._handlers):
             h(etype, obj)
 
-    def replace_all(self, objs: List[dict]) -> None:
-        """Full resync after a (re-)list: the cache becomes exactly `objs`.
-        Emits DELETED for vanished keys and ADDED for everything current so
-        downstream queues reconcile both directions."""
+    def replace_all(self, objs: List[dict],
+                    list_rv: Optional[str] = None) -> None:
+        """Resync from a (re-)list snapshot taken at ``list_rv``.
+
+        client-go Replace semantics, rv-aware on both sides so a periodic
+        resync is cheap and race-safe against concurrent write-through:
+
+        * vanished keys emit DELETED — unless the cached entry is NEWER
+          than the snapshot (created after the LIST; the watch owns it);
+        * listed objects emit ADDED only when the cache doesn't already
+          hold that version — an unchanged cluster produces ZERO events
+          (no periodic full-requeue storm through the controllers).
+        """
+        try:
+            snapshot_rv = int(list_rv) if list_rv is not None else None
+        except (TypeError, ValueError):
+            snapshot_rv = None
         fresh = {}
         for o in objs:
             m = o.get("metadata", {})
             fresh[(m.get("namespace", "default"), m.get("name", ""))] = o
+        events = []
         with self._lock:
-            vanished = [
-                (k, self._store[k]) for k in self._store if k not in fresh
-            ]
-        for k, old in vanished:
-            self.apply_event("DELETED", old)
-        for o in fresh.values():
-            self.apply_event("ADDED", o)
+            for k, old in self._store.items():
+                if k in fresh:
+                    continue
+                orv = _rv_of(old)
+                if (snapshot_rv is not None and orv is not None
+                        and orv > snapshot_rv):
+                    continue  # written after the snapshot
+                events.append(("DELETED", old))
+            for k, o in fresh.items():
+                crv, frv = _rv_of(self._store.get(k)), _rv_of(o)
+                if crv is not None and frv is not None and crv >= frv:
+                    continue  # cache is current (or newer) for this object
+                events.append(("ADDED", o))
+        for etype, obj in events:
+            self.apply_event(etype, obj)
         self.synced.set()
 
     def _unindex(self, old: Optional[dict], key: Key) -> None:
@@ -152,11 +193,18 @@ class Informer:
 
 
 class InformerCache:
-    """All informers for one manager + the loops that feed them."""
+    """All informers for one manager + the loops that feed them.
 
-    def __init__(self, client: KubeClient, namespace: Optional[str] = None):
+    ``resync_period``: even with rv-resume a watch can in principle miss
+    events (apiserver bugs, proxies eating frames); a periodic full
+    re-list heals any divergence, like controller-runtime's resync.
+    """
+
+    def __init__(self, client: KubeClient, namespace: Optional[str] = None,
+                 resync_period: float = 600.0):
         self.client = client
         self.namespace = namespace
+        self.resync_period = resync_period
         self._informers: Dict[str, Informer] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -209,27 +257,36 @@ class InformerCache:
         return True
 
     def _run_watch(self, kind: str, inf: Informer) -> None:
-        """list-then-watch with rv resume; 410 -> full resync. The same
-        protocol as runtime.Controller._watch_loop, but feeding the store."""
+        """list-then-watch with rv resume; 410 or resync-period expiry ->
+        full re-list (the single watch-protocol implementation)."""
         rv = None
+        synced_at = 0.0
         while not self._stop.is_set():
             try:
-                if rv is None:
+                if rv is None or (
+                        time.monotonic() - synced_at > self.resync_period):
                     if hasattr(self.client, "list_raw"):
                         raw = self.client.list_raw(kind, self.namespace)
                     else:
                         raw = {"items": self.client.list(kind, self.namespace)}
-                    inf.replace_all(raw.get("items", []))
                     rv = raw.get("metadata", {}).get("resourceVersion")
+                    inf.replace_all(raw.get("items", []), list_rv=rv)
+                    synced_at = time.monotonic()
                 for etype, obj in self.client.watch(
-                        kind, self.namespace, resource_version=rv):
+                        kind, self.namespace, resource_version=rv,
+                        timeout_seconds=min(300, max(1, int(
+                            self.resync_period)))):
                     orv = obj.get("metadata", {}).get("resourceVersion")
                     if orv:
                         rv = orv
                     inf.apply_event(etype, obj)
-                    if self._stop.is_set():
-                        return
-                # clean server timeout: re-watch from rv
+                    if self._stop.is_set() or (
+                            time.monotonic() - synced_at
+                            > self.resync_period):
+                        break
+                if self._stop.is_set():
+                    return
+                # clean server timeout / resync break: loop re-checks
             except GoneError:
                 log.info("informer %s: rv %s compacted; re-listing", kind, rv)
                 rv = None

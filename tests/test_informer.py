@@ -84,6 +84,39 @@ def test_informer_replace_all_resync_emits_both_directions():
     assert inf.get("default", "new")
 
 
+def test_replace_all_unchanged_snapshot_emits_nothing():
+    """A resync of an unchanged cluster must be event-free — no periodic
+    full-requeue storm through the controller queues."""
+    inf = Informer("Pod")
+    p = pod("p1")
+    p["metadata"]["resourceVersion"] = "5"
+    inf.apply_event("ADDED", p)
+    events = []
+    inf.add_handler(lambda e, o: events.append((e, o["metadata"]["name"])))
+    inf.replace_all([p], list_rv="7")
+    assert events == []
+    assert inf.get("default", "p1")
+
+
+def test_replace_all_respects_newer_writethrough():
+    """An object created AFTER the list snapshot (write-through or a
+    faster watch) must survive the resync, and a stale snapshot version
+    must not regress a newer cached one."""
+    inf = Informer("Pod")
+    old = pod("seen")
+    old["metadata"]["resourceVersion"] = "4"
+    newer = pod("seen")
+    newer["metadata"]["resourceVersion"] = "9"  # written after snapshot
+    just_created = pod("fresh")
+    just_created["metadata"]["resourceVersion"] = "8"
+    inf.apply_event("ADDED", newer)
+    inf.apply_event("ADDED", just_created)
+    # snapshot taken at rv 6: contains only the stale version of "seen"
+    inf.replace_all([old], list_rv="6")
+    assert inf.get("default", "fresh")  # NOT deleted: newer than snapshot
+    assert inf.get("default", "seen")["metadata"]["resourceVersion"] == "9"
+
+
 def test_informer_reads_are_copies():
     inf = Informer("Pod")
     inf.apply_event("ADDED", pod("p"))
@@ -165,6 +198,27 @@ def test_cache_follows_watch_and_serves_reads_with_zero_requests(srv):
         # deletes propagate through the watch
         writer.delete("Pod", "default", "p1")
         assert _wait(lambda: cache.informer("Pod").list() == [])
+    finally:
+        cache.stop()
+
+
+def test_periodic_resync_heals_silently_missed_events(srv):
+    """A mutation that never produced a watch event (simulated by editing
+    the stub's store directly) leaves the cache stale — the periodic
+    re-list must heal it within resync_period."""
+    writer = HttpKubeClient(base_url=srv.url, token=None)
+    writer.create(pod("p1"))
+    c = HttpKubeClient(base_url=srv.url, token=None)
+    cache = InformerCache(c, resync_period=1.0)
+    cache.informer("Pod")
+    cache.start()
+    try:
+        assert cache.wait_for_sync(10)
+        assert cache.informer("Pod").get("default", "p1")
+        # vanish p1 without any watch event (no _notify fires)
+        srv.store._store.pop(("Pod", "default", "p1"))
+        assert _wait(lambda: cache.informer("Pod").list() == [], 15), \
+            "resync never healed the stale cache"
     finally:
         cache.stop()
 
