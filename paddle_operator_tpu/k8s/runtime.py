@@ -292,8 +292,6 @@ class Manager:
         if self.elector is not None:
             if not self.elector.acquire(self._stop):
                 return  # stopped before winning
-            # a failed-over leader must reconcile everything it missed
-            self.enqueue_all()
             t = threading.Thread(
                 target=self.elector.run_renewal,
                 args=(self._stop, self._lost_leadership),
@@ -301,6 +299,11 @@ class Manager:
             )
             t.start()
             self._threads.append(t)
+        # initial-list replay for EVERY start path (not just failover):
+        # objects that synced into the cache before handlers registered
+        # produced no enqueue, and the rv-aware resync intentionally
+        # re-emits nothing for unchanged objects — so seed the queues here
+        self.enqueue_all()
         for ctrl in self.controllers:
             t = threading.Thread(
                 target=self._worker, args=(ctrl,), daemon=True,

@@ -166,6 +166,28 @@ def test_metrics_text_exposition():
     assert count > 0
 
 
+def test_start_replays_preexisting_objects_without_leader_election():
+    """Objects that existed before any watch/handler registration produce
+    no events; Manager.start() must seed the queues for EVERY start path
+    (previously only failed-over leaders replayed the initial list)."""
+    import time as _time
+
+    client = FakeKubeClient()
+    client.register_kind("batch.test/v1", "TestJob", "testjobs")
+    client.create({"apiVersion": "batch.test/v1", "kind": "TestJob",
+                   "metadata": {"name": "pre", "namespace": "default"}})
+    seen = []
+    mgr = Manager(client)  # no leader election
+    mgr.add_controller("t", lambda ns, n: seen.append(n) or None,
+                       for_kind="TestJob")
+    mgr.start()
+    deadline = _time.time() + 5
+    while "pre" not in seen and _time.time() < deadline:
+        _time.sleep(0.02)
+    mgr.stop()
+    assert "pre" in seen
+
+
 def test_leader_election_lease():
     client = FakeKubeClient()
     m1 = Manager(client, leader_election=True, leader_identity="a",
