@@ -289,6 +289,13 @@ def _attention_bench(backend):
                 iters)
             entry["dense_ms"] = round(dense_s * 1000, 2)
             entry["flash_speedup"] = round(dense_s / flash_s, 2)
+            if flash_s < 2e-4 and dense_s < 2e-4:
+                # both finish inside the relay's per-dispatch jitter: the
+                # ratio flips run to run and must not be over-read — the
+                # kernel's demonstrable win is the 8k row (dense cannot
+                # run there at all)
+                entry["note"] = ("both below relay timing resolution; "
+                                 "speedup not meaningful at this size")
         else:
             entry["dense_ms"] = None  # S^2 fp32 residuals exceed HBM budget
         out.append(entry)

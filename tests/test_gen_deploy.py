@@ -25,14 +25,20 @@ def test_committed_manifests_match_generator(tmp_path):
         [sys.executable, str(work / "scripts" / "gen_deploy.py")],
         check=True, cwd=work, capture_output=True,
     )
-    for rel in ("deploy/v1/crd.yaml", "deploy/v1/operator.yaml",
-                "charts/paddle-operator-tpu/templates/crd.yaml",
-                "charts/paddle-operator-tpu/templates/controller.yaml",
-                "charts/paddle-operator-tpu/values.yaml",
-                "charts/paddle-operator-tpu/Chart.yaml"):
-        generated = work / rel
-        committed = os.path.join(ROOT, rel)
-        assert generated.exists(), "generator no longer renders %s" % rel
-        assert filecmp.cmp(str(generated), committed, shallow=False), (
-            "%s drifted from scripts/gen_deploy.py output — re-run the "
-            "generator (or port the hand edit into it)" % rel)
+    # diff the whole rendered trees, not a hardcoded file list, so a file
+    # the generator grows later is automatically under the guard too
+    for tree in ("deploy/v1", "charts/paddle-operator-tpu"):
+        generated = work / tree
+        committed = os.path.join(ROOT, tree)
+        assert generated.is_dir(), "generator no longer renders %s" % tree
+        for dirpath, _dirs, files in os.walk(generated):
+            for fname in files:
+                gen_file = os.path.join(dirpath, fname)
+                rel = os.path.relpath(gen_file, work)
+                com_file = os.path.join(ROOT, rel)
+                assert os.path.exists(com_file), (
+                    "%s is rendered but not committed — run the generator "
+                    "and commit its output" % rel)
+                assert filecmp.cmp(gen_file, com_file, shallow=False), (
+                    "%s drifted from scripts/gen_deploy.py output — re-run "
+                    "the generator (or port the hand edit into it)" % rel)
