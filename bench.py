@@ -59,6 +59,7 @@ STAGE_DEADLINES = {
     # loses only the enrichment, never the headline number
     "attention_bench": float(os.environ.get("BENCH_T_ATTENTION", "300")),
     "data_pipeline": float(os.environ.get("BENCH_T_PIPELINE", "150")),
+    "gang_latency": float(os.environ.get("BENCH_T_GANG", "300")),
 }
 
 STAGE_MARK = "BENCH_STAGE "
@@ -213,6 +214,86 @@ def child_main():
                 result["data_pipeline_error"] = repr(e)[:200]
         print(json.dumps(result))
         sys.stdout.flush()
+
+    # control-plane north-star (BASELINE.md): jax-free, backend-independent
+    # — runs even when the TPU was unreachable and extras were skipped
+    if os.environ.get("BENCH_GANG", "1") == "1":
+        _stage("gang_latency")
+        try:
+            result["gang_schedule_to_running_ms"] = _gang_latency_bench()
+        except Exception as e:
+            result["gang_latency_error"] = repr(e)[:200]
+        print(json.dumps(result))
+        sys.stdout.flush()
+
+
+def _gang_latency_bench():
+    """BASELINE.md's second north-star: gang-schedule -> Running latency.
+
+    Measured against the hermetic control plane with REAL wall clock: a
+    threaded Manager reconciles, the kubelet simulator steps on its own
+    thread, pods poll the real HTTP coordination endpoint — so the number
+    covers the full machinery (watch -> queue -> reconcile passes ->
+    PodGroup admission -> pod Running -> gang release), not the apiserver
+    fake's cost. Jax-free; runs identically on any backend.
+    """
+    import statistics
+    import threading
+
+    from paddle_operator_tpu.api import types as api
+    from paddle_operator_tpu.testing import OperatorHarness
+
+    import math
+
+    h = OperatorHarness(http_coordination=True, scheduling="volcano")
+    stop = threading.Event()
+
+    def kubelet():
+        while not stop.is_set():
+            h.sim.step()
+            time.sleep(0.005)
+
+    kt = threading.Thread(target=kubelet, daemon=True)
+    n_jobs = int(os.environ.get("BENCH_GANG_JOBS", "7"))
+    lats, timed_out = [], 0
+    try:
+        kt.start()
+        h.manager.start()
+        for i in range(n_jobs):
+            name = "lat-%d" % i
+            spec = {"worker": {"replicas": 2, "template": {"spec": {
+                "containers": [{"name": "w", "image": "x"}]}}}}
+            t0 = time.perf_counter()
+            h.create_job(api.new_tpujob(name, spec=spec))
+            deadline = t0 + 30
+            while time.perf_counter() < deadline:
+                try:
+                    obj = h.client.get(api.KIND, "default", name)
+                except Exception:
+                    obj = {}
+                if obj.get("status", {}).get("phase") == "Running":
+                    lats.append((time.perf_counter() - t0) * 1000)
+                    break
+                time.sleep(0.002)
+            else:
+                timed_out += 1  # visible in the artifact, never silent
+    finally:
+        stop.set()
+        h.manager.stop()
+        h.close()
+        kt.join(timeout=5)
+    if not lats:
+        raise RuntimeError("no job reached Running inside the deadline")
+    lats.sort()
+    return {
+        "jobs": len(lats),
+        "timed_out": timed_out,
+        "p50": round(statistics.median(lats), 1),
+        # nearest-rank percentile: ceil(0.9 n) is the p90 sample
+        "p90": round(lats[min(len(lats) - 1,
+                              math.ceil(0.9 * len(lats)) - 1)], 1),
+        "max": round(lats[-1], 1),
+    }
 
 
 def _time_fn(fn, args, iters, repeats=2):

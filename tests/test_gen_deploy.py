@@ -25,20 +25,34 @@ def test_committed_manifests_match_generator(tmp_path):
         [sys.executable, str(work / "scripts" / "gen_deploy.py")],
         check=True, cwd=work, capture_output=True,
     )
-    # diff the whole rendered trees, not a hardcoded file list, so a file
-    # the generator grows later is automatically under the guard too
-    for tree in ("deploy/v1", "charts/paddle-operator-tpu"):
-        generated = work / tree
-        committed = os.path.join(ROOT, tree)
-        assert generated.is_dir(), "generator no longer renders %s" % tree
-        for dirpath, _dirs, files in os.walk(generated):
+    # diff the whole rendered trees in BOTH directions, not a hardcoded
+    # file list: a file the generator grows later is automatically under
+    # the guard, and a committed file the generator stops rendering is
+    # flagged as orphaned instead of silently diverging
+    def file_set(root, tree):
+        out = set()
+        base = os.path.join(str(root), tree)
+        for dirpath, _dirs, files in os.walk(base):
             for fname in files:
-                gen_file = os.path.join(dirpath, fname)
-                rel = os.path.relpath(gen_file, work)
-                com_file = os.path.join(ROOT, rel)
-                assert os.path.exists(com_file), (
-                    "%s is rendered but not committed — run the generator "
-                    "and commit its output" % rel)
-                assert filecmp.cmp(gen_file, com_file, shallow=False), (
-                    "%s drifted from scripts/gen_deploy.py output — re-run "
-                    "the generator (or port the hand edit into it)" % rel)
+                out.add(os.path.relpath(os.path.join(dirpath, fname),
+                                        str(root)))
+        return out
+
+    for tree in ("deploy/v1", "charts/paddle-operator-tpu"):
+        assert (work / tree).is_dir(), "generator no longer renders %s" % tree
+        gen_files = file_set(work, tree)
+        com_files = file_set(ROOT, tree)
+        assert gen_files, "generator rendered nothing under %s" % tree
+        only_gen = sorted(gen_files - com_files)
+        only_com = sorted(com_files - gen_files)
+        assert not only_gen, (
+            "rendered but not committed (run the generator and commit): %s"
+            % only_gen)
+        assert not only_com, (
+            "committed but no longer rendered (stale manifests): %s"
+            % only_com)
+        for rel in sorted(gen_files):
+            assert filecmp.cmp(str(work / rel), os.path.join(ROOT, rel),
+                               shallow=False), (
+                "%s drifted from scripts/gen_deploy.py output — re-run "
+                "the generator (or port the hand edit into it)" % rel)
