@@ -121,6 +121,16 @@ def test_ring_flash_grads_match_dense(sp_mesh):
         np.testing.assert_allclose(g, w, atol=2e-4, rtol=2e-4)
 
 
+def test_ulysses_flash_path_matches_dense(sp_mesh):
+    """Ulysses routed through the Pallas kernel (interpret on the CPU
+    mesh): after the all-to-all each device holds the FULL sequence for
+    its head group, so the kernel sees [b, h/n, s, d]."""
+    q, k, v = _qkv(jax.random.PRNGKey(12), b=1, h=8, s=256, d=64)
+    want = reference_attention(q, k, v, causal=True)
+    got = ulysses_attention(q, k, v, sp_mesh, causal=True, impl="flash")
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
 def test_flash_rejects_non_tile_seq():
     """seq lengths that don't divide the block size would be silently
     truncated by the grid floor-division — must raise instead."""

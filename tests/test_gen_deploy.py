@@ -38,10 +38,17 @@ def test_committed_manifests_match_generator(tmp_path):
                                         str(root)))
         return out
 
+    # the committed side comes from git, not the working tree, so an
+    # untracked local scrap file can't masquerade as a "stale manifest"
+    tracked = set(subprocess.run(
+        ["git", "ls-files", "deploy/v1", "charts/paddle-operator-tpu"],
+        check=True, cwd=ROOT, capture_output=True, text=True,
+    ).stdout.splitlines())
+
     for tree in ("deploy/v1", "charts/paddle-operator-tpu"):
         assert (work / tree).is_dir(), "generator no longer renders %s" % tree
         gen_files = file_set(work, tree)
-        com_files = file_set(ROOT, tree)
+        com_files = {f for f in file_set(ROOT, tree) if f in tracked}
         assert gen_files, "generator rendered nothing under %s" % tree
         only_gen = sorted(gen_files - com_files)
         only_com = sorted(com_files - gen_files)
