@@ -12,6 +12,8 @@ Reference equivalent: controller-runtime ``client.Client`` as used throughout
 
 from __future__ import annotations
 
+import contextlib
+import http.client
 import json
 import os
 import ssl
@@ -21,8 +23,8 @@ import urllib.request
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from .errors import (
-    AlreadyExistsError, ApiError, ConflictError, GoneError, NotFoundError,
-    UnauthorizedError,
+    AlreadyExistsError, ApiError, ConflictError, GoneError, NetworkError,
+    NotFoundError, UnauthorizedError,
 )
 
 # kind -> (api prefix, plural).  Core v1 kinds plus the CRDs we manage.
@@ -169,6 +171,29 @@ def _map_http_error(e: "urllib.error.HTTPError") -> ApiError:
     return err
 
 
+@contextlib.contextmanager
+def _mapped_errors(label: str):
+    """THE transport-to-taxonomy mapping, shared by every HTTP path
+    (request, watch connect, watch stream reads) so the mapped exception
+    set cannot diverge per code path:
+
+    * ``HTTPError`` — the apiserver answered with a status: full taxonomy
+      via :func:`_map_http_error`. Must be caught first (HTTPError ⊂
+      URLError ⊂ OSError).
+    * ``OSError`` — never reached the server: DNS, refused, TLS, socket
+      timeout, mid-stream reset.
+    * ``http.client.HTTPException`` — transport-level protocol failure,
+      notably ``IncompleteRead`` when the peer dies mid-chunk (NOT an
+      OSError; without this a truncated response escapes the taxonomy).
+    """
+    try:
+        yield
+    except urllib.error.HTTPError as e:
+        raise _map_http_error(e)
+    except (OSError, http.client.HTTPException) as e:
+        raise NetworkError("%s: %s" % (label, e))
+
+
 class HttpKubeClient(KubeClient):
     """Talks to a real kube-apiserver over HTTPS using stdlib urllib.
 
@@ -235,12 +260,13 @@ class HttpKubeClient(KubeClient):
             req.add_header("Content-Type", "application/json")
         if self._token:
             req.add_header("Authorization", "Bearer " + self._token)
-        try:
+        # Mapped into the taxonomy so callers' ApiError handling (leader
+        # election's renew-deadline grace, reconcile retry) covers an
+        # unreachable apiserver instead of a raw URLError killing their loop.
+        with _mapped_errors("%s %s" % (method, url)):
             with urllib.request.urlopen(req, context=self._ssl, timeout=30) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
-        except urllib.error.HTTPError as e:
-            raise _map_http_error(e)
 
     # -- CRUD --------------------------------------------------------------
 
@@ -307,14 +333,20 @@ class HttpKubeClient(KubeClient):
         req.add_header("Accept", "application/json")
         if self._token:
             req.add_header("Authorization", "Bearer " + self._token)
-        try:
+        with _mapped_errors("watch %s" % url):
             resp = urllib.request.urlopen(
                 req, context=self._ssl, timeout=timeout_seconds + 15
             )
-        except urllib.error.HTTPError as e:
-            raise _map_http_error(e)
         with resp:
-            for line in resp:
+            # Stream reads share the connect-path mapping: a connection that
+            # dies MID-watch (reset, socket timeout, truncated chunk) must
+            # also surface as NetworkError, or the taxonomy guarantee would
+            # be false for the most common watch failure mode.
+            def lines():
+                with _mapped_errors("watch stream %s" % url):
+                    yield from resp
+
+            for line in lines():
                 if not line.strip():
                     continue
                 ev = json.loads(line)
@@ -367,7 +399,7 @@ class HttpKubeClient(KubeClient):
                 raise UnauthorizedError("exec: %s" % e)
             raise ApiError("exec upgrade failed: %s" % e)
         except OSError as e:  # DNS, refused, TLS, socket timeout
-            raise ApiError("exec connect failed: %s" % e)
+            raise NetworkError("exec connect failed: %s" % e)
         stdout, stderr, status = [], [], None
         try:
             for _op, payload in conn.frames():
@@ -385,8 +417,8 @@ class HttpKubeClient(KubeClient):
                         status = {"status": "Failure",
                                   "message": data.decode(errors="replace")}
         except (ws.WebSocketError, OSError) as e:
-            raise ApiError("exec stream dropped: %s (partial stdout: %r)"
-                           % (e, b"".join(stdout)[:200]))
+            raise NetworkError("exec stream dropped: %s (partial stdout: %r)"
+                               % (e, b"".join(stdout)[:200]))
         finally:
             conn.close()
         if status is None:

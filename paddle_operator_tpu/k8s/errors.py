@@ -39,6 +39,18 @@ class UnauthorizedError(ApiError):
     code = 401
 
 
+class NetworkError(ApiError):
+    """The apiserver could not be reached at all (DNS failure, connection
+    refused, TLS handshake, socket timeout). Part of the ApiError taxonomy
+    so every caller's transient-failure handling (leader election's
+    renew-deadline grace, reconcile retry) covers an unreachable apiserver
+    the same way it covers a 5xx — client-go similarly surfaces *url.Error
+    through the same error-checking helpers."""
+
+    reason = "NetworkError"
+    code = 503
+
+
 class GoneError(ApiError):
     """Watch resourceVersion fell behind apiserver compaction (410):
     the watcher must re-list and restart the watch."""

@@ -181,7 +181,16 @@ class LeaderElector:
     def acquire(self, stop: Optional[threading.Event] = None) -> bool:
         """Block until we are leader (True) or ``stop`` is set (False)."""
         while stop is None or not stop.is_set():
-            if self.try_acquire_or_renew():
+            try:
+                acquired = self.try_acquire_or_renew()
+            except Exception as e:
+                # same contract as run_renewal: an error outside the
+                # ApiError taxonomy is a failed step, not a dead candidate —
+                # a standby whose acquire thread dies can never take over
+                log.warning("%s: acquire step raised %r; retrying",
+                            self.identity, e)
+                acquired = False
+            if acquired:
                 return True
             if stop is None:
                 time.sleep(self.retry_period)
@@ -202,7 +211,17 @@ class LeaderElector:
         """
         last_renew = self._clock()
         while not stop.wait(self.retry_period):
-            if self.try_acquire_or_renew():
+            try:
+                renewed = self.try_acquire_or_renew()
+            except Exception as e:
+                # A client bug or an error outside the ApiError taxonomy must
+                # degrade into "renewal failed this step", never kill this
+                # thread — a silently dead renewal loop keeps _is_leader True
+                # forever while the lease expires under us (split brain).
+                log.warning("%s: renewal step raised %r; treating as failed",
+                            self.identity, e)
+                renewed = self._within_renew_deadline(self._clock())
+            if renewed:
                 last_renew = self._clock()
                 continue
             if not self._is_leader or (
@@ -228,5 +247,6 @@ class LeaderElector:
             lease["spec"]["renewTime"] = _iso(self._clock())
             self.client.update(lease)
             log.info("%s: released lease %s", self.identity, self.lease_name)
-        except ApiError:
-            pass  # best effort; the lease will expire on its own
+        except Exception:
+            pass  # best effort (incl. unreachable apiserver during shutdown);
+            # the lease will expire on its own

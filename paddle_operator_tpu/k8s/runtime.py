@@ -195,7 +195,17 @@ class Manager:
         self.cache = cache
         self.controllers: List[Controller] = []
         self.leader_election = leader_election
-        self.leader_identity = leader_identity or ("mgr-%d" % id(self))
+        if not leader_identity:
+            # client-go's default identity is hostname + "_" + uuid: unique
+            # across processes AND restarts. id(self) would be neither — two
+            # identically-started replicas can land the same heap address,
+            # and a colliding standby would "renew" the live leader's lease.
+            import socket
+            import uuid
+
+            leader_identity = "%s_%s" % (
+                socket.gethostname(), uuid.uuid4().hex[:12])
+        self.leader_identity = leader_identity
         self.elector = None
         if leader_election:
             from .leader import LeaderElector

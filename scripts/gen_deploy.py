@@ -132,6 +132,11 @@ def operator_manifests(namespace=NAMESPACE, image=IMAGE, jobnamespace=""):
                         "env": [
                             {"name": "POD_NAMESPACE", "valueFrom": {
                                 "fieldRef": {"fieldPath": "metadata.namespace"}}},
+                            # leader-election identity (manager.py); without
+                            # it every replica invents a random identity and
+                            # lease forensics lose the holder's pod name
+                            {"name": "POD_NAME", "valueFrom": {
+                                "fieldRef": {"fieldPath": "metadata.name"}}},
                             {"name": "COORD_SERVICE_NAME",
                              "value": "tpujob-operator-coord"},
                         ],

@@ -328,3 +328,98 @@ def test_watch_namespace_filter(srv, client):
     for etype, obj in client.watch("Pod", "ns1", timeout_seconds=1):
         events.append(obj["metadata"]["name"])
     assert events == ["a"]
+
+
+# -- network-level failures ---------------------------------------------
+
+
+def test_unreachable_apiserver_maps_to_network_error():
+    """Connection refused / DNS failure must surface inside the ApiError
+    taxonomy (NetworkError): callers' transient-failure handling — leader
+    election's renew-deadline grace — covers an unreachable apiserver."""
+    from paddle_operator_tpu.k8s.errors import ApiError, NetworkError
+
+    # a port nothing listens on: connect fails fast with ECONNREFUSED
+    c = HttpKubeClient(base_url="http://127.0.0.1:1", token=None)
+    with pytest.raises(NetworkError) as ei:
+        c.get("Pod", "default", "x")
+    assert isinstance(ei.value, ApiError)
+    with pytest.raises(NetworkError):
+        list(c.watch("Pod", "default", timeout_seconds=1))
+
+
+def test_watch_midstream_connection_death_maps_to_network_error():
+    """A watch whose connection dies MID-stream (not at connect) must also
+    raise inside the ApiError taxonomy. A clean server shutdown only EOFs
+    the chunked stream, so this server RSTs the socket (SO_LINGER 0) after
+    one delivered event — the reset surfaces inside the read loop."""
+    import json as _json
+    import socket
+    import struct
+
+    from paddle_operator_tpu.k8s.errors import NetworkError
+
+    srv_sock = socket.socket()
+    srv_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.listen(1)
+    port = srv_sock.getsockname()[1]
+
+    def serve():
+        conn, _ = srv_sock.accept()
+        conn.recv(65536)
+        ev = _json.dumps({"type": "ADDED", "object": {
+            "metadata": {"name": "a", "resourceVersion": "1"}}}) + "\n"
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n")
+        chunk = ev.encode()
+        conn.sendall(("%x\r\n" % len(chunk)).encode() + chunk + b"\r\n")
+        # RST only after the client has CONSUMED the event: Linux discards
+        # buffered unread data on RST, so a sleep here would be racy
+        assert consumed.wait(10)
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+        conn.close()  # RST, not FIN
+
+    consumed = threading.Event()
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = HttpKubeClient(base_url="http://127.0.0.1:%d" % port, token=None)
+    got = []
+    with pytest.raises(NetworkError):
+        for etype, obj in c.watch("Pod", "default", timeout_seconds=30):
+            got.append(obj["metadata"]["name"])
+            consumed.set()
+    assert got == ["a"], "first event should be delivered before the reset"
+    srv_sock.close()
+    t.join(timeout=5)
+
+
+def test_truncated_chunk_maps_to_network_error():
+    """A peer that dies mid-chunk raises http.client.IncompleteRead — an
+    HTTPException, not an OSError — which must also map to NetworkError."""
+    import socket
+
+    from paddle_operator_tpu.k8s.errors import NetworkError
+
+    srv_sock = socket.socket()
+    srv_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.listen(1)
+    port = srv_sock.getsockname()[1]
+
+    def serve():
+        conn, _ = srv_sock.accept()
+        conn.recv(65536)
+        # claim a 100-byte chunk, deliver 10 bytes, then FIN (clean close)
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n64\r\n0123456789")
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = HttpKubeClient(base_url="http://127.0.0.1:%d" % port, token=None)
+    with pytest.raises(NetworkError):
+        c.get("Pod", "default", "x")
+    srv_sock.close()
+    t.join(timeout=5)

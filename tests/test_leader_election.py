@@ -259,3 +259,61 @@ def test_lost_lease_halts_workers_and_fires_callback():
     time.sleep(0.5)
     assert seen == before, "deposed manager kept reconciling"
     m1.stop()
+
+
+def test_renewal_loop_survives_non_api_errors_and_steps_down():
+    """A raw network-level exception (URLError/OSError — NOT in the
+    ApiError taxonomy) escaping the client must degrade into a failed
+    renewal step, not kill the renewal thread: a silently dead loop would
+    leave is_leader True forever while the lease expires (split brain)."""
+    c, clk = FakeKubeClient(), Clock()
+    a = elector(c, "a", clk, retry_period=0.02, renew_deadline=0.1,
+                lease_duration=0.2)
+    assert a.try_acquire_or_renew()
+
+    def broken_get(*args, **kw):
+        clk.advance(0.03)  # wall time passes while the apiserver is gone
+        raise OSError("connection refused")
+
+    c.get = broken_get
+    stop = threading.Event()
+    stepped = threading.Event()
+    t = threading.Thread(
+        target=a.run_renewal, args=(stop,), kwargs={
+            "on_stopped_leading": stepped.set}, daemon=True)
+    t.start()
+    assert stepped.wait(5), "renewal thread died instead of stepping down"
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert not a.is_leader
+    stop.set()
+
+
+def test_acquire_loop_survives_non_api_errors():
+    """The standby's blocking acquire() must also treat raw network-level
+    exceptions as a failed step and keep retrying — a standby whose acquire
+    thread dies can never take over after the partition heals."""
+    c, clk = FakeKubeClient(), Clock()
+    holder = elector(c, "holder", clk)
+    assert holder.try_acquire_or_renew()
+    standby = elector(c, "standby", clk, retry_period=0.02)
+
+    real_get = c.get
+    calls = []
+
+    def flaky_get(*args, **kw):
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("connection refused")
+        clk.advance(20.0)  # partition outlived the holder's lease
+        return real_get(*args, **kw)
+
+    c.get = flaky_get
+    stop = threading.Event()
+    got = []
+    t = threading.Thread(target=lambda: got.append(standby.acquire(stop)),
+                         daemon=True)
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive(), "acquire thread died or hung"
+    assert got == [True] and standby.is_leader
