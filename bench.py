@@ -55,6 +55,7 @@ STAGE_DEADLINES = {
     "compile_warmup": float(os.environ.get("BENCH_T_COMPILE", "360")),
     # 2 windows x 50 steps now; scale the old 20-step/180s allowance
     "measure": float(os.environ.get("BENCH_T_MEASURE", "420")),
+    "fused_measure": float(os.environ.get("BENCH_T_FUSED", "300")),
     # extras run AFTER the core JSON is already on stdout: a wedged extra
     # loses only the enrichment, never the headline number
     "attention_bench": float(os.environ.get("BENCH_T_ATTENTION", "300")),
@@ -122,9 +123,15 @@ def child_main():
             0, calib_iters, lambda i, y: x @ y, x)
 
     jax.block_until_ready(mm_chain(a))  # compile
-    t0 = time.perf_counter()
-    jax.block_until_ready(mm_chain(a))
-    dt_c = time.perf_counter() - t0
+    # best of 3: the relay's effective device throughput swings ~3x between
+    # runs; the max is the closest observable to the true ceiling, and an
+    # underestimated ceiling overstates every MFU that divides by it
+    dt_c = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(mm_chain(a))
+        dt = time.perf_counter() - t0
+        dt_c = dt if dt_c is None else min(dt_c, dt)
     calib_tflops = 2.0 * calib_dim ** 3 * calib_iters / dt_c / 1e12
     _log("calibration: %.1f TFLOP/s sustained over %d chained %d^3 "
          "bf16 matmuls" % (calib_tflops, calib_iters, calib_dim))
@@ -199,6 +206,13 @@ def child_main():
     want_extras = os.environ.get(
         "BENCH_EXTRAS", "1" if backend == "tpu" else "0") == "1"
     if want_extras:
+        if os.environ.get("BENCH_FUSED", "1") == "1":
+            _stage("fused_measure")
+            try:
+                result["fused"] = _fused_bench(
+                    batch, params, batch_data, calib_tflops, opt, mesh)
+            except Exception as e:
+                result["fused_error"] = repr(e)[:200]
         if os.environ.get("BENCH_ATTN", "1") == "1":
             _stage("attention_bench")
             try:
@@ -225,6 +239,54 @@ def child_main():
             result["gang_latency_error"] = repr(e)[:200]
         print(json.dumps(result))
         sys.stdout.flush()
+
+
+def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
+    """K train steps fused into ONE dispatch (`steps_per_call`): the
+    device-bound throughput, freed of the per-dispatch relay latency that
+    dominates the headline window. Its MFU is the apples-to-apples
+    efficiency number — both it and the calibration are single dispatches,
+    so the ratio compares device time to device time. Same optimizer and
+    mesh as the headline step, so the two are directly comparable."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_operator_tpu.models import resnet
+    from paddle_operator_tpu.parallel import build_train_step, resnet_rules
+
+    if mesh is None:
+        # single device: the resident batch is broadcast to every scanned
+        # step — no window memory at all
+        K = int(os.environ.get("BENCH_FUSED_STEPS", "25"))
+        window = batch_data
+    else:
+        # mesh mode requires every leaf stacked [K, ...]; keep the window
+        # small so K x batch images stay within per-device HBM
+        K = int(os.environ.get("BENCH_FUSED_STEPS_MESH", "4"))
+        window = jax.tree_util.tree_map(
+            lambda l: jnp.stack([l] * K), batch_data)
+    step, state = build_train_step(
+        resnet.loss_fn, opt, params, batch_data,
+        mesh=mesh, rules=resnet_rules() if mesh is not None else None,
+        merge_stats=resnet.merge_stats, steps_per_call=K,
+    )
+    state, m = step(state, window)  # compile
+    jax.block_until_ready(m["loss"])
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, m = step(state, window)
+        jax.block_until_ready(m["loss"])
+        dt = (time.perf_counter() - t0) / K
+        best = dt if best is None else min(best, dt)
+    ips = batch / best
+    return {
+        "steps_per_call": K,
+        "images_per_sec": round(ips, 1),
+        "step_ms": round(best * 1000, 3),
+        "mfu": round(ips * RESNET50_TRAIN_FLOPS_PER_IMAGE
+                     / (calib_tflops * 1e12), 4),
+    }
 
 
 def _gang_latency_bench():

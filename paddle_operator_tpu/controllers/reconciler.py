@@ -1,10 +1,13 @@
 """The TpuJob reconcile loop.
 
 Reference: ``controllers/paddlejob_controller.go:101-333`` — the same
-level-triggered shape: derive status from child pods, then converge the world
-one mutation per pass (create/delete at most one object, then let the next
-event-driven pass continue). TPU-native behavior differences are called out
-inline.
+level-triggered shape: derive status from child pods, then converge the
+world. Deletions stay one-per-pass (the reference's cadence: remove at most
+one object, let the next event-driven pass continue); CREATIONS diverge —
+all missing Services and the whole pod gang go in a single pass, because the
+write-through informer cache gives read-your-writes safety and on TPU the
+gang's bring-up latency is idle-slice time. Other TPU-native behavior
+differences are called out inline.
 """
 
 from __future__ import annotations
@@ -126,12 +129,15 @@ class TpuJobReconciler:
         if helper.needs_pod_dns(job):
             svcs = self.client.list_owned("Service", job.obj)
             have = {s["metadata"]["name"] for s in svcs}
+            created_svc = False
             for pod in child_pods:
                 if pod["metadata"]["name"] in have:
                     continue
                 svc = helper.construct_service_for_pod(pod, job.device)
                 k8s.set_controller_reference(job.obj, svc)
                 self._create_resource(job, svc)
+                created_svc = True
+            if created_svc:
                 return Result()
 
         # -- host-port block (reference :192-196) -----------------------
@@ -165,15 +171,25 @@ class TpuJobReconciler:
             self._clean_one(job, child_pods, svcs)
             return Result()
 
-        # -- create missing pods, one per pass (reference :234-287) -----
+        # -- create missing pods (reference :234-287) -------------------
+        # Divergence from the reference's one-pod-per-pass cadence: the whole
+        # gang is created in ONE pass. The reference re-reads the world
+        # between mutations via the apiserver; here the write-through
+        # informer cache gives the same read-your-writes safety, and on TPU
+        # the gang's bring-up latency is the cost that matters — a slice
+        # can't start until every host's pod exists, so serializing creates
+        # across event-loop passes only adds idle-slice time.
         statuses = job.get_statuses()
+        created_pods = 0
         for res in job.get_resource_order():
             if specs.get(res) is None:
                 continue
             if not helper.is_pod_created(specs[res], statuses.get(res)):
                 for i in range(specs[res]["replicas"]):
                     if self._create_pod(job, res, i):
-                        return Result()
+                        created_pods += 1
+        if created_pods:
+            return Result()
 
         # -- global-env ConfigMap barrier (reference :289-306) ----------
         if job.elastic is None and helper.is_all_pods_ready(job, child_pods):

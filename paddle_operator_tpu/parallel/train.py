@@ -33,6 +33,8 @@ def build_train_step(
     merge_stats: Optional[Callable] = None,
     grad_clip: Optional[float] = None,
     accum_steps: int = 1,
+    steps_per_call: int = 1,
+    init_state: bool = True,
 ):
     """Returns (step_fn, sharded_state).
 
@@ -46,10 +48,26 @@ def build_train_step(
       *second* axis to dp); a ``lax.scan`` averages grads over microbatches
       before one optimizer update, so the effective batch grows without the
       activation memory.
+    * ``steps_per_call > 1``: K optimizer steps fused into ONE dispatch via
+      ``lax.scan`` — the host↔device round trip (the dominant cost on a
+      dispatch-latency-bound link) is paid once per K steps instead of per
+      step. Batch leaves may either carry an extra leading ``[K, ...]`` axis
+      (a device-prestaged window: each step consumes its own slice) or keep
+      the sample shape (the same batch is reused every step — synthetic /
+      benchmark mode). Metrics come back stacked with a leading ``[K]``
+      axis. With ``mesh``, EVERY leaf must carry the window axis (sharded
+      ``P(None, *spec)``) so the window's shardings are known at build time.
     """
     # Build the optimizer state under jit: one executable instead of one
     # host->device dispatch per leaf (the tunnel-latency killer on TPU pods).
-    state = jax.jit(lambda p: {"params": p, "opt": optimizer.init(p)})(params)
+    # ``init_state=False``: only shapes are needed (caller already holds a
+    # live, compatible state — e.g. a tail-window fn) — eval_shape avoids
+    # materializing a throwaway params+optimizer copy on device.
+    make_state = lambda p: {"params": p, "opt": optimizer.init(p)}
+    if init_state:
+        state = jax.jit(make_state)(params)
+    else:
+        state = jax.eval_shape(make_state, params)
 
     def grads_of(params, batch):
         def lossed(p):
@@ -117,8 +135,30 @@ def build_train_step(
             metrics.update(aux)
         return {"params": new_params, "opt": new_opt}, metrics
 
+    sample_ndims = [getattr(l, "ndim", 0)
+                    for l in jax.tree_util.tree_leaves(sample_batch)]
+
+    def multi_step(state, batch):
+        """K fused steps in one dispatch. Leaves with an extra leading axis
+        are scanned (one slice per step); sample-shaped leaves are reused
+        every step."""
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        scan_idx = [i for i, (l, nd) in enumerate(zip(leaves, sample_ndims))
+                    if getattr(l, "ndim", 0) == nd + 1]
+        xs = [leaves[i] for i in scan_idx]
+
+        def body(s, xs_leaves):
+            cur = list(leaves)
+            for i, x in zip(scan_idx, xs_leaves):
+                cur[i] = x
+            return step(s, jax.tree_util.tree_unflatten(treedef, cur))
+
+        return jax.lax.scan(body, state, xs, length=steps_per_call)
+
+    top = multi_step if steps_per_call > 1 else step
+
     if mesh is None:
-        return jax.jit(step, donate_argnums=0), state
+        return jax.jit(top, donate_argnums=0), state if init_state else None
 
     param_sh = shard_tree(params, mesh, rules)
     opt_sh = shard_tree(state["opt"], mesh, rules)
@@ -135,16 +175,26 @@ def build_train_step(
             return P(*lead, batch_axis, seq_axis)
         return P(*lead, batch_axis)
 
-    batch_sh = jax.tree_util.tree_map(
-        lambda leaf: named(mesh, batch_spec(leaf)), sample_batch
-    )
+    if steps_per_call == 1:
+        batch_sh = jax.tree_util.tree_map(
+            lambda leaf: named(mesh, batch_spec(leaf)), sample_batch
+        )
+    else:
+        # every leaf carries the leading [K] window axis: unsharded window
+        # dimension, per-step spec for the rest
+        batch_sh = jax.tree_util.tree_map(
+            lambda leaf: named(mesh, P(*((None,) + tuple(batch_spec(leaf))))),
+            sample_batch,
+        )
 
     step_fn = jax.jit(
-        step,
+        top,
         in_shardings=(state_sh, batch_sh),
         out_shardings=(state_sh, None),
         donate_argnums=0,
     )
+    if not init_state:
+        return step_fn, None
     state = jax.device_put(state, state_sh)
     return step_fn, state
 

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 
 from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distributed
 from .ops.optim import Optimizer
@@ -74,6 +75,9 @@ class TrainJob:
     merge_stats: Optional[Callable] = None
     grad_clip: Optional[float] = None
     accum_steps: int = 1        # >1: make_batch returns [accum, mb, ...]
+    # >1: K optimizer steps fused into one dispatch (lax.scan) — amortizes
+    # the host->device round trip; the loop stacks K make_batch windows
+    steps_per_call: int = 1
     total_steps: int = 100
     log_every: int = 10
     checkpoint_every: int = 50
@@ -150,12 +154,25 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 loss_fn = functools.partial(loss_fn, mesh=mesh)
         except (TypeError, ValueError):
             pass
-        step_fn, state = build_train_step(
-            loss_fn, job.optimizer, params, job.make_batch(rng, 0),
+        K = max(1, job.steps_per_call)
+        # one builder for the fused fn and the tail fallback, so the two can
+        # never train with different semantics
+        build = functools.partial(
+            build_train_step, loss_fn, job.optimizer, params,
+            job.make_batch(rng, 0),
             mesh=mesh, rules=job.rules, seq_axis=job.seq_axis,
             merge_stats=job.merge_stats, grad_clip=job.grad_clip,
             accum_steps=job.accum_steps,
         )
+        step_fn, state = build(steps_per_call=K)
+        single_fn = None  # tail windows shorter than K, built lazily
+
+        def make_single_fn():
+            # init_state=False: only the compatible fn — the live training
+            # state is already resident, and materializing a second full
+            # params+optimizer copy could OOM a near-capacity model
+            fn, _none = build(init_state=False)
+            return fn
 
         start_step = 0
         # resolve the step ONCE: a checkpoint published between two
@@ -184,27 +201,53 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         prof = profile_steps()
         trc = tracer()
         try:
-            for step in range(start_step, job.total_steps):
-                prof.before(step)
-                batch = job.make_batch(jax.random.fold_in(rng, step), step)
-                state, metrics = step_fn(state, batch)
-                prof.after(step)
-                trc.event("train_step", step=step + 1, epoch=epoch)
-                if job.log_every and (step + 1) % job.log_every == 0:
+            step = start_step
+            while step < job.total_steps:
+                k_here = min(K, job.total_steps - step)
+                prof.before(step, span=k_here)
+                if k_here == K and K > 1:
+                    window = [
+                        job.make_batch(jax.random.fold_in(rng, s), s)
+                        for s in range(step, step + K)
+                    ]
+                    stacked = jax.tree_util.tree_map(
+                        lambda *ls: jnp.stack(ls), *window)
+                    state, metrics = step_fn(state, stacked)
+                    # fused metrics come back stacked [K]; report the last
+                    metrics = jax.tree_util.tree_map(
+                        lambda x: x[-1], metrics)
+                elif K > 1:
+                    # tail shorter than the fused window: per-step fallback
+                    # (the scan length is fixed at trace time)
+                    if single_fn is None:
+                        single_fn = make_single_fn()
+                    for s in range(step, step + k_here):
+                        batch = job.make_batch(jax.random.fold_in(rng, s), s)
+                        state, metrics = single_fn(state, batch)
+                else:
+                    batch = job.make_batch(
+                        jax.random.fold_in(rng, step), step)
+                    state, metrics = step_fn(state, batch)
+                prof.after(step, span=k_here)
+                step += k_here
+                trc.event("train_step", step=step, epoch=epoch)
+                if job.log_every and (
+                        step % job.log_every < k_here):
                     loss = float(metrics["loss"])
-                    rate = (step + 1 - start_step) / (time.perf_counter() - t0)
+                    rate = (step - start_step) / (time.perf_counter() - t0)
                     log.info("step %d loss=%.4f steps/s=%.2f",
-                             step + 1, loss, rate)
-                if job.checkpoint_dir and (step + 1) % job.checkpoint_every == 0:
-                    save(step + 1, state, epoch)
+                             step, loss, rate)
+                if job.checkpoint_dir and (
+                        step % job.checkpoint_every < k_here):
+                    save(step, state, epoch)
                 if should_stop():
                     log.info("membership epoch moved at step %d; restarting",
-                             step + 1)
+                             step)
                     if job.checkpoint_dir:
-                        save(step + 1, state, epoch)
+                        save(step, state, epoch)
                     return False
                 result["state"] = state
-                result["steps"] = step + 1
+                result["steps"] = step
         finally:
             # a step that raises mid-window must still finalize the device
             # trace, or the capture is lost and re-entry hits "already active"

@@ -130,17 +130,21 @@ class profile_steps:
             self.start, self.stop = 10, 13
         self._active = False
 
-    def before(self, step: int) -> None:
+    def before(self, step: int, span: int = 1) -> None:
         # range check, not equality: a run resumed from a checkpoint past
-        # `start` (or an elastic restart) must still capture the window tail
-        if self.dir and not self._active and self.start <= step < self.stop:
+        # `start` (or an elastic restart) must still capture the window tail.
+        # ``span``: a fused multi-step call covers [step, step+span) — start
+        # the trace when the requested window INTERSECTS the call's range
+        # (span=1 reduces to the per-step start <= step < stop).
+        if (self.dir and not self._active
+                and self.start < step + span and step < self.stop):
             import jax
 
             jax.profiler.start_trace(self.dir)
             self._active = True
 
-    def after(self, step: int) -> None:
-        if self._active and step + 1 >= self.stop:
+    def after(self, step: int, span: int = 1) -> None:
+        if self._active and step + span >= self.stop:
             import jax
 
             jax.profiler.stop_trace()

@@ -118,3 +118,100 @@ def test_resnet_dp_train_step():
     assert losses[-1] < losses[0]
     # BN running stats were updated through the merge path
     assert not jnp.allclose(state["params"]["stem"]["bn"]["mean"], bn_mean_before)
+
+
+def test_steps_per_call_broadcast_matches_sequential():
+    """K fused steps reusing ONE batch must equal K sequential step() calls
+    (same math, one dispatch): metrics come back stacked [K]."""
+    params = bert.init(KEY, bert.TINY_CONFIG)
+    batch = bert.synthetic_batch(KEY, 4, seq_len=16, vocab_size=1024)
+    opt = optim.adamw(1e-3)
+
+    step, state = build_train_step(bert.loss_fn, opt, params, batch)
+    seq_losses = []
+    for _ in range(3):
+        state, m = step(state, batch)
+        seq_losses.append(float(m["loss"]))
+
+    fused, fstate = build_train_step(
+        bert.loss_fn, opt, params, batch, steps_per_call=3)
+    fstate, fm = fused(fstate, batch)
+    assert fm["loss"].shape == (3,)
+    assert jnp.allclose(fm["loss"], jnp.array(seq_losses), rtol=1e-4, atol=1e-5)
+
+
+def test_steps_per_call_scans_stacked_window():
+    """Leaves with an extra leading [K] axis are consumed one slice per
+    step — a device-prestaged data window."""
+    params = bert.init(KEY, bert.TINY_CONFIG)
+    sample = bert.synthetic_batch(KEY, 4, seq_len=16, vocab_size=1024)
+    K = 3
+    window = [bert.synthetic_batch(jax.random.PRNGKey(i), 4, seq_len=16,
+                                   vocab_size=1024) for i in range(K)]
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *window)
+
+    opt = optim.adamw(1e-3)
+    step, state = build_train_step(bert.loss_fn, opt, params, sample)
+    seq_losses = []
+    for b in window:
+        state, m = step(state, b)
+        seq_losses.append(float(m["loss"]))
+
+    fused, fstate = build_train_step(
+        bert.loss_fn, opt, params, sample, steps_per_call=K)
+    fstate, fm = fused(fstate, stacked)
+    assert jnp.allclose(fm["loss"], jnp.array(seq_losses), rtol=1e-4, atol=1e-5)
+    # trained params identical too
+    d = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        state["params"], fstate["params"])
+    assert max(jax.tree_util.tree_leaves(d)) < 1e-5
+
+
+def test_steps_per_call_on_mesh():
+    """Fused steps compose with GSPMD sharding: caller shards the stacked
+    window as P(None, 'dp', ...) and the state stays rule-sharded."""
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    from paddle_operator_tpu.parallel import named
+
+    params = bert.init(KEY, bert.TINY_CONFIG)
+    sample = bert.synthetic_batch(KEY, 4, seq_len=16, vocab_size=1024)
+    K = 2
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls),
+        *[bert.synthetic_batch(jax.random.PRNGKey(i), 4, seq_len=16,
+                               vocab_size=1024) for i in range(K)])
+    stacked = jax.tree_util.tree_map(
+        lambda l: jax.device_put(l, named(
+            mesh, P(*((None, "dp") + (None,) * (l.ndim - 2))))), stacked)
+
+    opt = optim.adamw(1e-3)
+    fused, fstate = build_train_step(
+        bert.loss_fn, opt, params, sample, mesh=mesh, rules=bert_rules(),
+        steps_per_call=K)
+    fstate, fm = fused(fstate, stacked)
+    assert fm["loss"].shape == (K,)
+    assert jnp.all(jnp.isfinite(fm["loss"]))
+    leaf = fstate["params"]["layers"][0]["attn"]["q"]["kernel"]
+    assert leaf.sharding.spec == P(None, "tp", None)
+
+
+def test_build_train_step_init_state_false_returns_no_state():
+    """init_state=False compiles a compatible fn without materializing a
+    second params+optimizer copy (tail-window fallback path)."""
+    params = bert.init(KEY, bert.TINY_CONFIG)
+    batch = bert.synthetic_batch(KEY, 4, seq_len=16, vocab_size=1024)
+    opt = optim.adamw(1e-3)
+    step, state = build_train_step(bert.loss_fn, opt, params, batch)
+    fn, none = build_train_step(bert.loss_fn, opt, params, batch,
+                                init_state=False)
+    assert none is None
+    state, m = fn(state, batch)  # compatible with the live state
+    assert jnp.isfinite(m["loss"])
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    fn_m, none_m = build_train_step(
+        bert.loss_fn, opt, params, batch, mesh=mesh, rules=bert_rules(),
+        init_state=False)
+    assert none_m is None

@@ -251,3 +251,30 @@ def test_cli_submit_rejects_typoed_template(tmp_path):
                               namespace="default")
     assert run(client, args) == 1
     assert client.all_objects(api.KIND) == []
+
+
+def test_runner_fused_steps_per_call_with_tail(tmp_path):
+    """steps_per_call fuses K optimizer steps per dispatch; a total that is
+    not a multiple of K finishes with the per-step fallback. Checkpoints
+    still land on the fused-window boundaries."""
+    ckpt = str(tmp_path / "ck")
+    out = run_training(
+        small_job(steps_per_call=4, total_steps=10, checkpoint_every=4,
+                  checkpoint_dir=ckpt),
+        cfg=LaunchConfig(), init_distributed=False)
+    assert out["steps"] == 10
+    assert jnp.isfinite(out["loss"])
+    # multiples of checkpoint_every only — same cadence as per-step mode
+    # (step 10 is not a multiple of 4 and is not saved there either)
+    assert all_steps(ckpt) == [4, 8]
+
+
+def test_runner_fused_matches_per_step_loss():
+    """Same seed, same data schedule: fused and per-step runs land on the
+    same final loss (the fused path is a pure dispatch optimization)."""
+    a = run_training(small_job(total_steps=6, checkpoint_every=100),
+                     cfg=LaunchConfig(), init_distributed=False)
+    b = run_training(small_job(total_steps=6, checkpoint_every=100,
+                               steps_per_call=3),
+                     cfg=LaunchConfig(), init_distributed=False)
+    assert abs(a["loss"] - b["loss"]) < 1e-4

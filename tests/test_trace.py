@@ -114,3 +114,45 @@ def test_runner_emits_step_events(monkeypatch, tmp_path):
     recs = [json.loads(line) for line in open(path)]
     steps = [r["attrs"]["step"] for r in recs if r["name"] == "train_step"]
     assert steps == [1, 2, 3]
+
+
+def test_profile_window_intersects_fused_span(tmp_path, monkeypatch):
+    """A fused multi-step call covering [step, step+span) must start the
+    trace when the requested window falls anywhere inside the span, and
+    stop once the span passes the window end."""
+    from paddle_operator_tpu.utils.trace import profile_steps as Profile
+
+    calls = []
+    import paddle_operator_tpu.utils.trace as trace_mod
+
+    class FakeProfiler:
+        @staticmethod
+        def start_trace(d):
+            calls.append(("start", d))
+
+        @staticmethod
+        def stop_trace():
+            calls.append(("stop", None))
+
+    import jax
+    monkeypatch.setattr(jax, "profiler", FakeProfiler)
+
+    p = Profile(profile_dir=str(tmp_path), window="10:12")
+    # window [10,12) lives inside the fused span [0,25): start AND stop
+    p.before(0, span=25)
+    assert calls and calls[0][0] == "start"
+    p.after(0, span=25)
+    assert calls[-1][0] == "stop"
+
+    # span entirely before the window: no trace
+    calls.clear()
+    p2 = Profile(profile_dir=str(tmp_path), window="10:12")
+    p2.before(0, span=5)
+    assert calls == []
+    # per-step behavior unchanged (span default 1)
+    p2.before(10)
+    assert calls == [("start", str(tmp_path))]
+    p2.after(10)
+    assert calls == [("start", str(tmp_path))]  # 11 < stop: still tracing
+    p2.after(11)
+    assert calls[-1][0] == "stop"
