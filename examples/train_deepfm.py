@@ -22,6 +22,7 @@ def main():
         make_batch=lambda rng, step: deepfm.synthetic_batch(rng, BATCH),
         rules=ctr_rules(),
         total_steps=STEPS,
+        steps_per_call=int(os.environ.get("TPUJOB_STEPS_PER_CALL", "1")),
     )
     out = run_training(job)
     print("final loss:", out.get("loss"))

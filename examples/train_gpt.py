@@ -53,6 +53,7 @@ def main():
         seq_axis="sp" if SP > 1 else None,
         grad_clip=1.0,
         total_steps=STEPS,
+        steps_per_call=int(os.environ.get("TPUJOB_STEPS_PER_CALL", "1")),
         checkpoint_dir=os.environ.get("TPUJOB_CHECKPOINT_DIR", ""),
     )
     out = run_training(job)

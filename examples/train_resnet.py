@@ -17,6 +17,8 @@ logging.basicConfig(level=logging.INFO)
 
 BATCH = int(os.environ.get("TPUJOB_BATCH", "128"))
 STEPS = int(os.environ.get("TPUJOB_STEPS", "200"))
+# >1 fuses K optimizer steps into one XLA dispatch (docs/user-guide.md)
+STEPS_PER_CALL = int(os.environ.get("TPUJOB_STEPS_PER_CALL", "1"))
 
 
 def main():
@@ -31,6 +33,7 @@ def main():
         rules=resnet_rules(),
         merge_stats=resnet.merge_stats,
         total_steps=STEPS,
+        steps_per_call=STEPS_PER_CALL,
         checkpoint_dir=os.environ.get("TPUJOB_CHECKPOINT_DIR", ""),
     )
     out = run_training(job)

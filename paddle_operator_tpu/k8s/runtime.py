@@ -118,6 +118,10 @@ class Controller:
         self._failures: Dict[Tuple[str, str], int] = {}
         self.metrics = {"reconcile_total": 0, "reconcile_errors_total": 0,
                         "requeue_total": 0}
+        # Prometheus-summary components for reconcile latency
+        # (controller-runtime exposes the same as a histogram)
+        self.duration_sum = 0.0
+        self.duration_count = 0
 
     def watch(self, client, kind: str, mapper: Callable, namespace=None,
               cache=None) -> None:
@@ -149,7 +153,12 @@ class Controller:
     def process_one(self, key: Tuple[str, str]) -> bool:
         """Run one reconcile; enqueue follow-ups per the Result contract."""
         self.metrics["reconcile_total"] += 1
+        t0 = time.monotonic()
         try:
+            # duration observed in finally: an errored reconcile is usually
+            # the SLOW one, and excluding it would flatline the latency
+            # metric exactly when it matters (controller-runtime's histogram
+            # likewise observes every outcome)
             with tracer().span("reconcile", controller=self.name,
                                namespace=key[0], obj=key[1]):
                 result = self.reconcile(*key)
@@ -161,6 +170,9 @@ class Controller:
             if n <= self.max_retries:
                 self.queue.add_after(key, min(0.1 * (2 ** n), 30.0))
             return True
+        finally:
+            self.duration_sum += time.monotonic() - t0
+            self.duration_count += 1
         self._failures.pop(key, None)
         if result is not None and getattr(result, "requeue", False):
             self.metrics["requeue_total"] += 1
@@ -361,4 +373,16 @@ class Manager:
                 lines.append(
                     'tpujob_%s{controller="%s"} %d' % (metric, ctrl.name, value)
                 )
+            lines.append(
+                'tpujob_reconcile_duration_seconds_sum{controller="%s"} %.6f'
+                % (ctrl.name, ctrl.duration_sum))
+            lines.append(
+                'tpujob_reconcile_duration_seconds_count{controller="%s"} %d'
+                % (ctrl.name, ctrl.duration_count))
+            lines.append(
+                'tpujob_workqueue_depth{controller="%s"} %d'
+                % (ctrl.name, len(ctrl.queue)))
+            lines.append(
+                'tpujob_workqueue_deferred{controller="%s"} %d'
+                % (ctrl.name, ctrl.queue.pending_deferred))
         return "\n".join(lines) + "\n"
