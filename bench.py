@@ -7,6 +7,18 @@ vs_baseline is reported against a nominal target recorded here.
 Prints exactly one JSON line on stdout:
   {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N, ...}
 
+SYNCHRONIZATION — the round-3 methodology fix: on this environment's relay
+backend, ``jax.block_until_ready`` returns BEFORE device execution
+completes (measured: an 8-matmul 4096^3 chain "finishes" in 0.05 ms by
+block_until_ready but takes ~500 ms to produce a readable result). Every
+timing here therefore synchronizes by READING A SCALAR BACK TO THE HOST
+(``float(loss)``), which provably blocks until the full dependency chain
+has executed. Rounds 1-2 (and early round 3) used block_until_ready and
+reported dispatch rates, not compute rates — those numbers (151k-330k
+img/s) are NOT comparable to the readback-synced ones; the JSON carries
+``sync: host-readback`` to mark the new regime, plus the old-style
+``dispatch_rate_images_per_sec`` for continuity.
+
 Architecture (post round-1 hang): a PARENT process that never imports jax
 (so it cannot hang) supervises a CHILD subprocess that does the actual
 benchmark. The child emits `BENCH_STAGE <name>` markers on stderr as it
@@ -40,9 +52,9 @@ RESNET50_TRAIN_FLOPS_PER_IMAGE = 12.4e9
 
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
-# 50 steps: per-dispatch jitter through the TPU relay dominates short
-# windows; a longer async-dispatched window stabilizes the mean
-STEPS = int(os.environ.get("BENCH_STEPS", "50"))
+# 20 steps x ~240 ms real step time per window; windows agree within <1%
+# under readback sync, so a long window buys nothing
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 
 # Per-stage deadlines (seconds). `child_up` covers interpreter start incl.
 # the axon sitecustomize TPU claim -- the exact spot round 1 wedged.
@@ -53,7 +65,7 @@ STAGE_DEADLINES = {
     "calibrate": float(os.environ.get("BENCH_T_CALIBRATE", "120")),
     "model_init": float(os.environ.get("BENCH_T_INIT", "120")),
     "compile_warmup": float(os.environ.get("BENCH_T_COMPILE", "360")),
-    # 2 windows x 50 steps now; scale the old 20-step/180s allowance
+    # 2 readback-synced windows + 1 dispatch-rate window, ~240 ms/step real
     "measure": float(os.environ.get("BENCH_T_MEASURE", "420")),
     "fused_measure": float(os.environ.get("BENCH_T_FUSED", "300")),
     # extras run AFTER the core JSON is already on stdout: a wedged extra
@@ -101,7 +113,7 @@ def child_main():
     _stage("canary")
     t0 = time.perf_counter()
     x = jnp.ones((256, 256), jnp.bfloat16)
-    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    float(jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x))
     _log("canary matmul in %.1fs" % (time.perf_counter() - t0))
 
     # Roofline self-calibration: the judge's round-2 finding was that
@@ -113,23 +125,25 @@ def child_main():
     calib_iters = int(os.environ.get("BENCH_CALIB_ITERS", "16"))
     a = jnp.ones((calib_dim, calib_dim), jnp.bfloat16)
 
-    # ONE dispatch containing `calib_iters` chained matmuls: per-call
-    # dispatch latency (which dominates wall-clock through the relay) is
-    # amortized away, so this measures the device's matmul ceiling, not
-    # the link's round-trip — without it MFU can exceed 1.0
+    # ONE dispatch containing `calib_iters` chained matmuls, synchronized by
+    # reading a scalar reduction of the result back to the host — the only
+    # sync this backend honors (see module docstring). The 1e-4 rescale per
+    # iteration keeps the bf16 chain from overflowing to inf, which XLA
+    # could short-circuit.
     @jax.jit
     def mm_chain(x):
-        return jax.lax.fori_loop(
-            0, calib_iters, lambda i, y: x @ y, x)
+        y = jax.lax.fori_loop(
+            0, calib_iters, lambda i, y: (x @ y) * 1e-4, x)
+        return y.astype(jnp.float32).sum()
 
-    jax.block_until_ready(mm_chain(a))  # compile
-    # best of 3: the relay's effective device throughput swings ~3x between
-    # runs; the max is the closest observable to the true ceiling, and an
-    # underestimated ceiling overstates every MFU that divides by it
+    float(mm_chain(a))  # compile + first full execution
+    # best of 3: the backend's effective throughput fluctuates; the max is
+    # the closest observable to the true ceiling, and an underestimated
+    # ceiling overstates every MFU that divides by it
     dt_c = None
     for _ in range(3):
         t0 = time.perf_counter()
-        jax.block_until_ready(mm_chain(a))
+        float(mm_chain(a))
         dt = time.perf_counter() - t0
         dt_c = dt if dt_c is None else min(dt_c, dt)
     calib_tflops = 2.0 * calib_dim ** 3 * calib_iters / dt_c / 1e12
@@ -146,7 +160,9 @@ def child_main():
     t0 = time.perf_counter()
     make = jax.jit(partial(_make, batch, IMAGE))
     params, batch_data = make(jax.random.PRNGKey(0))
-    jax.block_until_ready(params["head"]["fc"]["kernel"])
+    # host readback, not block_until_ready: init must have REALLY finished,
+    # or its tail executes inside compile_warmup's timed window/deadline
+    float(params["head"]["fc"]["kernel"].astype(jnp.float32).sum())
     _log("init in %.1fs" % (time.perf_counter() - t0))
 
     opt = optim.sgd(
@@ -162,24 +178,37 @@ def child_main():
     t0 = time.perf_counter()
     for _ in range(WARMUP):
         state, metrics = step(state, batch_data)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])  # readback: full chain has really executed
     _log("warmup (%d steps incl. compile) in %.1fs"
          % (WARMUP, time.perf_counter() - t0))
 
     _stage("measure")
-    # two independent windows, best wins: the relay's wall-clock has large
-    # transient congestion (observed 2x swings between identical runs);
-    # the best window is the closest observable to the device's steady state
+    # Two windows, best wins. Sync: ONE scalar readback of the LAST step's
+    # loss per window — it depends on the whole window's state chain, so the
+    # read blocks until every step has truly executed (block_until_ready
+    # does not; see module docstring). The readback itself is a single
+    # scalar D2H — negligible against STEPS x ~240 ms of compute.
     window_rates = []
     for _ in range(2):
         t0 = time.perf_counter()
         for _ in range(STEPS):
             state, metrics = step(state, batch_data)
-        jax.block_until_ready(metrics["loss"])
+        float(metrics["loss"])
         dt = time.perf_counter() - t0
         window_rates.append(batch * STEPS / dt)
     images_per_sec = max(window_rates)
     dt = batch * STEPS / images_per_sec
+
+    # The old (rounds 1-2) methodology for continuity: async dispatch rate
+    # with block_until_ready "sync". Overstates wildly on this backend —
+    # recorded so the artifact explains prior rounds' 151k-330k numbers.
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dispatch_rate = batch * STEPS / (time.perf_counter() - t0)
+    float(metrics["loss"])  # drain the real work before the next stage
+
     result = {
         "metric": "resnet50_train_images_per_sec",
         "value": round(images_per_sec, 2),
@@ -187,14 +216,14 @@ def child_main():
         "vs_baseline": round(images_per_sec / NOMINAL_TARGET_IMAGES_PER_SEC, 4),
         "backend": backend,
         "batch": batch,
+        "sync": "host-readback",
         "step_ms": round(1000.0 * dt / STEPS, 2),
         "window_images_per_sec": [round(r, 1) for r in window_rates],
+        "dispatch_rate_images_per_sec": round(dispatch_rate, 1),
         "calib_matmul_tflops": round(calib_tflops, 1),
-        # model FLOPs achieved / this environment's OWN matmul ceiling
-        # (measured as a single dispatch of chained matmuls, so the ceiling
-        # is device-bound, not dispatch-latency-bound). In this relay
-        # environment the ceiling is not physically a v5e — treat mfu as a
-        # cross-round-comparable efficiency ratio, not hardware utilization.
+        # model FLOPs achieved / the same-session readback-synced matmul
+        # ceiling: both sides measure true device completion, so this is an
+        # honest model-FLOPs-utilization figure.
         "mfu": round(images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE
                      / (calib_tflops * 1e12), 4),
     }
@@ -242,12 +271,12 @@ def child_main():
 
 
 def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
-    """K train steps fused into ONE dispatch (`steps_per_call`): the
-    device-bound throughput, freed of the per-dispatch relay latency that
-    dominates the headline window. Its MFU is the apples-to-apples
-    efficiency number — both it and the calibration are single dispatches,
-    so the ratio compares device time to device time. Same optimizer and
-    mesh as the headline step, so the two are directly comparable."""
+    """K train steps fused into ONE dispatch (`steps_per_call`), same
+    optimizer/mesh as the headline and the same host-readback sync. Under
+    honest sync this measures how much of the headline step is dispatch
+    overhead: fused ≈ headline means the device is the bottleneck and the
+    link is already fully pipelined; fused < headline quantifies the
+    per-dispatch cost steps_per_call removes for real users."""
     import jax
     import jax.numpy as jnp
 
@@ -271,12 +300,12 @@ def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
         merge_stats=resnet.merge_stats, steps_per_call=K,
     )
     state, m = step(state, window)  # compile
-    jax.block_until_ready(m["loss"])
+    float(m["loss"][-1])
     best = None
-    for _ in range(3):
+    for _ in range(2):
         t0 = time.perf_counter()
         state, m = step(state, window)
-        jax.block_until_ready(m["loss"])
+        float(m["loss"][-1])  # real completion of all K steps
         dt = (time.perf_counter() - t0) / K
         best = dt if best is None else min(best, dt)
     ips = batch / best
@@ -364,24 +393,6 @@ def _gang_latency_bench():
     }
 
 
-def _time_fn(fn, args, iters, repeats=2):
-    """Best of `repeats` async-dispatched windows (relay congestion makes
-    any single window untrustworthy — see the measure stage)."""
-    import jax
-
-    jax.block_until_ready(fn(*args))  # compile + warm
-    best = None
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / iters
-        best = dt if best is None else min(best, dt)
-    return best
-
-
 def _attention_bench(backend):
     """Causal attention fwd+bwd: the Pallas flash kernel vs dense einsum.
     First real-TPU execution path for ops/attention_pallas.py (tests run it
@@ -422,29 +433,46 @@ def _attention_bench(backend):
 
         entry = {"seq": s, "batch": b, "heads": h, "head_dim": d,
                  "mode": "fwd+bwd", "causal": True}
-        # per-iter device time is tiny relative to relay dispatch jitter
-        # (~ms); a long async-dispatched train amortizes it
-        iters = int(os.environ.get("BENCH_ATTN_ITERS", "100"))
-        flash_s = _time_fn(
-            jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2))), (q, k, v),
-            iters)
-        entry["flash_ms"] = round(flash_s * 1000, 2)
+        # One-dispatch chain of `iters` fwd+bwd passes, host-readback
+        # synced (module docstring): the scalar read depends on every
+        # iteration through the q/k/v perturbation chain, so the timing is
+        # true device completion, and per-iteration dispatch cost is
+        # amortized away.
+        iters = int(os.environ.get("BENCH_ATTN_ITERS", "8"))
+
+        def chain(loss_fn):
+            g = jax.grad(loss_fn, argnums=(0, 1, 2))
+
+            @jax.jit
+            def run(q, k, v):
+                def body(_, carry):
+                    qq, kk, vv = carry
+                    dq, dk, dv = g(qq, kk, vv)
+                    eps = jnp.asarray(1e-6, qq.dtype)
+                    return (qq + eps * dq, kk + eps * dk, vv + eps * dv)
+                qq, kk, vv = jax.lax.fori_loop(0, iters, body, (q, k, v))
+                return (qq.astype(jnp.float32).sum()
+                        + kk.astype(jnp.float32).sum()
+                        + vv.astype(jnp.float32).sum())
+
+            float(run(q, k, v))  # compile + first full execution
+            best = None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                float(run(q, k, v))
+                dt = (time.perf_counter() - t0) / iters
+                best = dt if best is None else min(best, dt)
+            return best
+
+        flash_s = chain(flash_loss)
+        entry["flash_ms"] = round(flash_s * 1000, 3)
         # causal fwd matmul FLOPs ~ 2 * 2*b*h*s^2*d / 2; bwd ~ 2.5x fwd
         attn_flops = 3.5 * (2.0 * b * h * s * s * d)
         entry["flash_tflops"] = round(attn_flops / flash_s / 1e12, 2)
         if cfg["dense"]:
-            dense_s = _time_fn(
-                jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2))), (q, k, v),
-                iters)
-            entry["dense_ms"] = round(dense_s * 1000, 2)
+            dense_s = chain(dense_loss)
+            entry["dense_ms"] = round(dense_s * 1000, 3)
             entry["flash_speedup"] = round(dense_s / flash_s, 2)
-            if flash_s < 2e-4 and dense_s < 2e-4:
-                # both finish inside the relay's per-dispatch jitter: the
-                # ratio flips run to run and must not be over-read — the
-                # kernel's demonstrable win is the 8k row (dense cannot
-                # run there at all)
-                entry["note"] = ("both below relay timing resolution; "
-                                 "speedup not meaningful at this size")
         else:
             entry["dense_ms"] = None  # S^2 fp32 residuals exceed HBM budget
         out.append(entry)
@@ -495,19 +523,17 @@ def _pipeline_bench(step, state, batch_data):
         it = iter(loader)
         # warm one step (first loader batch may include H2D compile)
         s, m = step(state, next(it))
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])  # host readback — the only honest sync here
         state = s
         t0 = time.perf_counter()
         m = None
         for _ in range(n_steps):
             b = next(it)
-            if serial:
-                b = jax.block_until_ready(b)
             s, m = step(state, b)
             if serial:
-                jax.block_until_ready(m["loss"])
+                float(m["loss"])  # per-step sync: no H2D/compute overlap
             state = s
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])  # overlapped mode syncs once at the end
         return (time.perf_counter() - t0) / n_steps
 
     serial_s = run(prefetch=0, serial=True)
@@ -647,8 +673,8 @@ def _parse_result(att):
 def parent_main():
     total_budget = float(os.environ.get("BENCH_TIMEOUT", "840"))
     t_start = time.monotonic()
-    # 512 is the measured single-chip sweet spot (step time is dispatch-
-    # latency-bound, so images/step is the lever; 1024 OOMs)
+    # 512 is the single-chip sweet spot: largest batch that fits (1024
+    # OOMs), best amortization of per-step fixed cost under honest sync
     first_batch = int(os.environ.get("BENCH_BATCH", "512"))
     ladder = [b for b in (first_batch, 256, 64, 8) if b <= first_batch]
     ladder = sorted(set(ladder), reverse=True)
