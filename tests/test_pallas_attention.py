@@ -67,3 +67,24 @@ def test_mha_flash_impl_matches_einsum():
     y_einsum = nn.mha(params, x, dtype=jnp.float32, impl="einsum")
     y_flash = nn.mha(params, x, dtype=jnp.float32, impl="flash")
     assert jnp.allclose(y_einsum, y_flash, atol=2e-4)
+
+
+def test_auto_block_selection_matches_small_blocks():
+    """Default (auto) block sizes must compute the same attention as
+    explicit 128-blocks, and pick the 512 tile for long sequences."""
+    from paddle_operator_tpu.ops.attention_pallas import _auto_block
+
+    assert _auto_block(4096) == 512
+    assert _auto_block(512) == 512
+    assert _auto_block(256) == 256
+    assert _auto_block(384) == 128
+    assert _auto_block(100) == 128  # rejected later by _check_blocks
+
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 512, 64), jnp.bfloat16)
+               for kk in ks)
+    auto = flash_attention(q, k, v, causal=True, interpret=True)
+    explicit = flash_attention(q, k, v, causal=True, interpret=True,
+                               block_q=128, block_k=128)
+    assert jnp.allclose(auto.astype(jnp.float32),
+                        explicit.astype(jnp.float32), atol=2e-2)
