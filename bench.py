@@ -121,8 +121,13 @@ def child_main():
     # so the bench measures ITS OWN matmul ceiling in the same process and
     # reports MFU against that — comparable across rounds by construction.
     _stage("calibrate")
-    calib_dim = int(os.environ.get("BENCH_CALIB_DIM", "8192"))
-    calib_iters = int(os.environ.get("BENCH_CALIB_ITERS", "16"))
+    # 16384^2 measures the highest sustained rate in the size probe
+    # (134.7 vs 102.7 TFLOP/s at 8192 — smaller chains are HBM-bound);
+    # the CPU fallback gets a dim it can finish inside the stage deadline
+    default_dim, default_iters = ("16384", "4") if backend == "tpu" \
+        else ("1024", "8")
+    calib_dim = int(os.environ.get("BENCH_CALIB_DIM", default_dim))
+    calib_iters = int(os.environ.get("BENCH_CALIB_ITERS", default_iters))
     a = jnp.ones((calib_dim, calib_dim), jnp.bfloat16)
 
     # ONE dispatch containing `calib_iters` chained matmuls, synchronized by

@@ -21,12 +21,16 @@ import urllib.error
 import urllib.request
 from typing import Dict, Optional
 
-from .errors import NotFoundError
+from .errors import ConflictError, NotFoundError
 from .fake import FakeKubeClient
 
 
 class PodSimulator:
-    def __init__(self, client: FakeKubeClient, auto_admit_podgroups: bool = True,
+    """Works against any KubeClient: a FakeKubeClient (fast in-process
+    harness, exec channel wired) or an HttpKubeClient speaking to the stub
+    apiserver (full production stack over real HTTP)."""
+
+    def __init__(self, client, auto_admit_podgroups: bool = True,
                  coord_container_name: str = "coord-tpujob"):
         self.client = client
         self.coord_name = coord_container_name
@@ -34,7 +38,8 @@ class PodSimulator:
         self._released: Dict[str, bool] = {}  # pod name -> coord released
         self._desired: Dict[str, str] = {}    # pod name -> Succeeded/Failed
         self._ip_seq = 0
-        client.exec_handler = self._handle_exec
+        if isinstance(client, FakeKubeClient):
+            client.exec_handler = self._handle_exec
 
     # -- operator exec channel -----------------------------------------
 
@@ -49,8 +54,24 @@ class PodSimulator:
         self._desired[pod_name] = "Succeeded" if succeeded else "Failed"
 
     def finish_all(self, succeeded: bool = True) -> None:
-        for pod in self.client.all_objects("Pod"):
+        for pod in self._all("Pod"):
             self.finish(pod["metadata"]["name"], succeeded)
+
+    # -- client adapters (FakeKubeClient fast paths, generic fallbacks) --
+
+    def _all(self, kind: str):
+        if hasattr(self.client, "all_objects"):
+            return self.client.all_objects(kind)
+        return self.client.list(kind)
+
+    def _patch_status(self, kind: str, ns: str, name: str,
+                      status: dict) -> None:
+        if hasattr(self.client, "patch_status"):
+            self.client.patch_status(kind, ns, name, status)
+            return
+        obj = self.client.get(kind, ns, name)
+        obj.setdefault("status", {}).update(status)
+        self.client.update_status(obj)
 
     # -- lifecycle engine ----------------------------------------------
 
@@ -58,14 +79,17 @@ class PodSimulator:
         """Advance every pod/podgroup one lifecycle notch. True if changed."""
         changed = False
         if self.auto_admit_podgroups:
-            for pg in self.client.all_objects("PodGroup"):
+            for pg in self._all("PodGroup"):
                 if (pg.get("status") or {}).get("phase") not in ("Running", "Inqueue"):
-                    self.client.patch_status(
-                        "PodGroup", pg["metadata"]["namespace"],
-                        pg["metadata"]["name"], {"phase": "Running"},
-                    )
+                    try:
+                        self._patch_status(
+                            "PodGroup", pg["metadata"]["namespace"],
+                            pg["metadata"]["name"], {"phase": "Running"},
+                        )
+                    except (NotFoundError, ConflictError):
+                        continue  # deleted/written concurrently; next step
                     changed = True
-        for pod in self.client.all_objects("Pod"):
+        for pod in self._all("Pod"):
             if self._step_pod(pod):
                 changed = True
         return changed
@@ -182,6 +206,6 @@ class PodSimulator:
 
     def _write(self, ns: str, name: str, status: dict) -> None:
         try:
-            self.client.patch_status("Pod", ns, name, status)
-        except NotFoundError:
-            pass
+            self._patch_status("Pod", ns, name, status)
+        except (NotFoundError, ConflictError):
+            pass  # pod deleted, or written concurrently — next step retries

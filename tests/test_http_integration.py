@@ -1,0 +1,133 @@
+"""Full production stack over real HTTP — the closest hermetic analog of the
+reference's envtest suite (suite_test.go:51-88) plus the kubelet envtest
+lacks: StubApiServer (real HTTP, streaming watch) <- HttpKubeClient <-
+InformerCache (watch-fed, rv resume) <- threaded Manager + reconciler +
+CoordinationServer, with PodSimulator playing kubelet over the same HTTP
+client. No FakeKubeClient anywhere."""
+
+import threading
+import time
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers.coordination import CoordinationServer
+from paddle_operator_tpu.controllers.hostport import PortRangeAllocator
+from paddle_operator_tpu.controllers.reconciler import TpuJobReconciler
+from paddle_operator_tpu.k8s.client import HttpKubeClient
+from paddle_operator_tpu.k8s.envtest import StubApiServer
+from paddle_operator_tpu.k8s.informer import (
+    CachedKubeClient, InformerCache, cached_kinds)
+from paddle_operator_tpu.k8s.podsim import PodSimulator
+from paddle_operator_tpu.k8s.runtime import Manager
+
+
+@pytest.fixture()
+def stack():
+    srv = StubApiServer().start()
+    srv.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+
+    client = HttpKubeClient(base_url=srv.url, token=None)
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+
+    cache = InformerCache(client, resync_period=30.0)
+    kinds = cached_kinds(api.KIND)
+    for kind in kinds:
+        cache.informer(kind)
+    cached = CachedKubeClient(client, cache)
+    cache.start()
+    assert cache.wait_for_sync(10)
+
+    coord = CoordinationServer(cached, ":0").start()
+    reconciler = TpuJobReconciler(
+        cached, init_image="busybox",
+        port_allocator=PortRangeAllocator(35000, 36000),
+        coordination_url=coord.url,
+    )
+    mgr = Manager(cached, cache=cache)
+    mgr.add_controller(
+        "tpujob", reconciler.reconcile, for_kind=api.KIND,
+        owns=[k for k in kinds if k != api.KIND],
+        owner_api_version=api.API_VERSION, owner_kind=api.KIND,
+    )
+
+    # kubelet over the PRODUCTION HTTP client (separate connection pool)
+    kubelet_client = HttpKubeClient(base_url=srv.url, token=None)
+    kubelet_client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    sim = PodSimulator(kubelet_client)
+
+    stop = threading.Event()
+    kubelet_errors = []
+
+    def kubelet():
+        while not stop.is_set():
+            try:
+                sim.step()
+            except Exception as e:  # visible in teardown, never fatal
+                kubelet_errors.append(repr(e))
+            time.sleep(0.01)
+
+    kt = threading.Thread(target=kubelet, daemon=True)
+    kt.start()
+    mgr.start()
+    yield srv, client, sim
+    stop.set()
+    mgr.stop()
+    cache.stop()
+    coord.stop()
+    kt.join(timeout=5)
+    srv.stop()
+    # transient rv conflicts are tolerated inside the sim; anything that
+    # escaped to here is a real kubelet-loop bug the test must surface
+    assert not kubelet_errors, "kubelet loop errors: %s" % kubelet_errors[-3:]
+
+
+def _wait_phase(client, name, phase, timeout=30.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        obj = client.get(api.KIND, "default", name)
+        last = obj.get("status", {}).get("phase")
+        if last == phase:
+            return obj
+        time.sleep(0.05)
+    raise AssertionError("job %s never reached %s (last=%s)" % (name, phase, last))
+
+
+def test_job_reaches_running_over_real_http(stack):
+    srv, client, sim = stack
+    spec = {
+        "ps": {"replicas": 1, "template": {"spec": {
+            "containers": [{"name": "p", "image": "x"}]}}},
+        "worker": {"replicas": 2, "template": {"spec": {
+            "containers": [{"name": "w", "image": "x"}]}}},
+    }
+    client.create(api.new_tpujob("httpjob", spec=spec))
+    obj = _wait_phase(client, "httpjob", "Running")
+    assert obj["status"]["mode"] == "PS"
+    pods = client.list_owned("Pod", obj)
+    assert len(pods) == 3
+    # the ConfigMap barrier materialized over HTTP too
+    assert client.get("ConfigMap", "default", "httpjob")
+
+
+def test_scale_down_and_completion_over_real_http(stack):
+    srv, client, sim = stack
+    spec = {"worker": {"replicas": 3, "template": {"spec": {
+        "containers": [{"name": "w", "image": "x"}]}}}}
+    client.create(api.new_tpujob("scale", spec=spec))
+    _wait_phase(client, "scale", "Running")
+
+    obj = client.get(api.KIND, "default", "scale")
+    obj["spec"]["worker"]["replicas"] = 2
+    client.update(obj)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pods = client.list_owned("Pod", client.get(api.KIND, "default", "scale"))
+        if len(pods) == 2:
+            break
+        time.sleep(0.05)
+    assert len(pods) == 2, [p["metadata"]["name"] for p in pods]
+
+    sim.finish_all(succeeded=True)
+    _wait_phase(client, "scale", "Completed")
