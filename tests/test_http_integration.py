@@ -54,7 +54,7 @@ def stack():
     # kubelet over the PRODUCTION HTTP client (separate connection pool)
     kubelet_client = HttpKubeClient(base_url=srv.url, token=None)
     kubelet_client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
-    sim = PodSimulator(kubelet_client)
+    sim = PodSimulator(kubelet_client, exec_server=srv)
 
     stop = threading.Event()
     kubelet_errors = []
@@ -131,3 +131,28 @@ def test_scale_down_and_completion_over_real_http(stack):
 
     sim.finish_all(succeeded=True)
     _wait_phase(client, "scale", "Completed")
+
+
+def test_leader_election_over_real_http():
+    """Lease-based election against the stub apiserver: acquisition,
+    optimistic-concurrency takeover protection, release -> fast successor."""
+    from paddle_operator_tpu.k8s.leader import LeaderElector
+
+    srv = StubApiServer().start()
+    try:
+        c1 = HttpKubeClient(base_url=srv.url, token=None)
+        c2 = HttpKubeClient(base_url=srv.url, token=None)
+        a = LeaderElector(c1, identity="a", lease_duration=2.0,
+                          renew_deadline=1.0, retry_period=0.2)
+        b = LeaderElector(c2, identity="b", lease_duration=2.0,
+                          renew_deadline=1.0, retry_period=0.2)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()  # unexpired: must not steal
+        assert a.try_acquire_or_renew()      # renewal via rv-carrying update
+        a.release()
+        assert b.try_acquire_or_renew()      # released: immediate takeover
+        lease = c1.get("Lease", "default", "tpujob-operator-lock")
+        assert lease["spec"]["holderIdentity"] == "b"
+        assert int(lease["spec"]["leaseTransitions"]) >= 1
+    finally:
+        srv.stop()

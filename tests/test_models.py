@@ -120,3 +120,48 @@ def test_cosine_schedule_endpoints():
     assert float(lr(jnp.array(0))) == 0.0
     assert abs(float(lr(jnp.array(10))) - 1.0) < 1e-6
     assert float(lr(jnp.array(100))) < 1e-6
+
+
+def test_batchnorm_variance_stable_with_large_mean():
+    """Single-pass shifted variance must not cancel catastrophically when
+    activations carry a mean far larger than their spread."""
+    from paddle_operator_tpu.ops import nn
+
+    ch = 4
+    p = nn.batchnorm_init(ch)
+    rng = jax.random.PRNGKey(0)
+    x = 1000.0 + 0.1 * jax.random.normal(rng, (4096, ch), jnp.float32)
+    # steady state: running mean tracks the activation mean
+    p["mean"] = jnp.full((ch,), 1000.0)
+    y, stats = nn.batchnorm(p, x, train=True, dtype=jnp.float32)
+    batch_var = (1.0 - 0.9) ** -1 * (stats["var"] - 0.9 * p["var"])
+    assert jnp.all(batch_var > 0.005), batch_var  # true var ~0.01, not 0
+    assert float(jnp.max(jnp.abs(jnp.mean(y, axis=0)))) < 1e-2
+    assert abs(float(jnp.std(y)) - 1.0) < 0.2
+
+
+def test_batchnorm_shift_converges_from_cold_start():
+    """The running-mean shift's documented contract: at cold start the
+    variance may be degraded for a pathological |mean| >> std input (same
+    caveat as flax's unshifted form), but as momentum pulls the running
+    mean onto the batch mean the single-pass variance becomes exact within
+    a few steps."""
+    from paddle_operator_tpu.ops import nn
+
+    ch = 4
+    p = nn.batchnorm_init(ch)  # running mean = 0: worst-case shift
+    rng = jax.random.PRNGKey(0)
+    for step in range(60):
+        x = 1000.0 + 0.1 * jax.random.normal(
+            jax.random.fold_in(rng, step), (4096, ch), jnp.float32)
+        y, stats = nn.batchnorm(p, x, train=True, momentum=0.8,
+                                dtype=jnp.float32)
+        p = {**p, **stats}
+    # running mean has locked on; the shifted subtraction is now exact
+    assert jnp.all(jnp.abs(p["mean"] - 1000.0) < 1.0)
+    batch_var = 5.0 * (stats["var"] - 0.8 * p["var"] / 1.0)
+    y, stats = nn.batchnorm(p, x, train=True, momentum=0.8,
+                            dtype=jnp.float32)
+    new_batch_var = 5.0 * (stats["var"] - 0.8 * p["var"])
+    assert jnp.all(jnp.abs(new_batch_var - 0.01) < 0.005), new_batch_var
+    assert abs(float(jnp.std(y)) - 1.0) < 0.2
