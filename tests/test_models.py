@@ -159,7 +159,6 @@ def test_batchnorm_shift_converges_from_cold_start():
         p = {**p, **stats}
     # running mean has locked on; the shifted subtraction is now exact
     assert jnp.all(jnp.abs(p["mean"] - 1000.0) < 1.0)
-    batch_var = 5.0 * (stats["var"] - 0.8 * p["var"] / 1.0)
     y, stats = nn.batchnorm(p, x, train=True, momentum=0.8,
                             dtype=jnp.float32)
     new_batch_var = 5.0 * (stats["var"] - 0.8 * p["var"])
