@@ -52,8 +52,8 @@ RESNET50_TRAIN_FLOPS_PER_IMAGE = 12.4e9
 
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
-# 20 steps x ~240 ms real step time per window; windows agree within <1%
-# under readback sync, so a long window buys nothing
+# 20 steps x ~100 ms real step time per window (batch 256); windows agree
+# within <1% under readback sync, so a long window buys nothing
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 
 # Per-stage deadlines (seconds). `child_up` covers interpreter start incl.
@@ -65,9 +65,10 @@ STAGE_DEADLINES = {
     "calibrate": float(os.environ.get("BENCH_T_CALIBRATE", "120")),
     "model_init": float(os.environ.get("BENCH_T_INIT", "120")),
     "compile_warmup": float(os.environ.get("BENCH_T_COMPILE", "360")),
-    # 2 readback-synced windows + 1 dispatch-rate window, ~240 ms/step real
+    # 2 readback-synced windows + 1 dispatch-rate window, ~100 ms/step real
     "measure": float(os.environ.get("BENCH_T_MEASURE", "420")),
     "fused_measure": float(os.environ.get("BENCH_T_FUSED", "300")),
+    "bert_bench": float(os.environ.get("BENCH_T_BERT", "300")),
     # extras run AFTER the core JSON is already on stdout: a wedged extra
     # loses only the enrichment, never the headline number
     "attention_bench": float(os.environ.get("BENCH_T_ATTENTION", "300")),
@@ -192,7 +193,7 @@ def child_main():
     # loss per window — it depends on the whole window's state chain, so the
     # read blocks until every step has truly executed (block_until_ready
     # does not; see module docstring). The readback itself is a single
-    # scalar D2H — negligible against STEPS x ~240 ms of compute.
+    # scalar D2H — negligible against STEPS x ~100 ms of compute.
     window_rates = []
     for _ in range(2):
         t0 = time.perf_counter()
@@ -240,6 +241,19 @@ def child_main():
     print(json.dumps(result))
     sys.stdout.flush()
 
+    # control-plane north-star (BASELINE.md) runs FIRST among the optional
+    # stages: jax-free, backend-independent, seconds-cheap — so neither a
+    # wedged extra nor the attempt-budget kill can cost the second
+    # north-star metric (and it still runs when extras are skipped).
+    if os.environ.get("BENCH_GANG", "1") == "1":
+        _stage("gang_latency")
+        try:
+            result["gang_schedule_to_running_ms"] = _gang_latency_bench()
+        except Exception as e:
+            result["gang_latency_error"] = repr(e)[:200]
+        print(json.dumps(result))
+        sys.stdout.flush()
+
     want_extras = os.environ.get(
         "BENCH_EXTRAS", "1" if backend == "tpu" else "0") == "1"
     if want_extras:
@@ -250,6 +264,12 @@ def child_main():
                     batch, params, batch_data, calib_tflops, opt, mesh)
             except Exception as e:
                 result["fused_error"] = repr(e)[:200]
+        if os.environ.get("BENCH_BERT", "1") == "1":
+            _stage("bert_bench")
+            try:
+                result["bert"] = _bert_bench(calib_tflops)
+            except Exception as e:
+                result["bert_error"] = repr(e)[:200]
         if os.environ.get("BENCH_ATTN", "1") == "1":
             _stage("attention_bench")
             try:
@@ -263,17 +283,6 @@ def child_main():
                     step, state, batch_data)
             except Exception as e:
                 result["data_pipeline_error"] = repr(e)[:200]
-        print(json.dumps(result))
-        sys.stdout.flush()
-
-    # control-plane north-star (BASELINE.md): jax-free, backend-independent
-    # — runs even when the TPU was unreachable and extras were skipped
-    if os.environ.get("BENCH_GANG", "1") == "1":
-        _stage("gang_latency")
-        try:
-            result["gang_schedule_to_running_ms"] = _gang_latency_bench()
-        except Exception as e:
-            result["gang_latency_error"] = repr(e)[:200]
         print(json.dumps(result))
         sys.stdout.flush()
 
@@ -323,6 +332,57 @@ def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
         "step_ms": round(best * 1000, 3),
         "mfu": round(ips * RESNET50_TRAIN_FLOPS_PER_IMAGE
                      / (calib_tflops * 1e12), 4),
+    }
+
+
+def _bert_bench(calib_tflops):
+    """BERT-base MLM train step (the BASELINE multi-host acceptance config,
+    measured per-chip): fwd+bwd+AdamW at seq 512, host-readback synced.
+    MFU numerator: 6 * matmul_params * tokens — the standard transformer
+    train estimate, over params that actually do matmul work: embedding
+    TABLES (tok/pos/type lookups) are excluded, or a ~134M-param count
+    would inflate MFU ~20% with FLOPs the model never executes."""
+    import jax
+
+    from paddle_operator_tpu.models import bert
+    from paddle_operator_tpu.ops import optim
+    from paddle_operator_tpu.parallel import build_train_step
+
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "32"))
+    seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
+    steps = int(os.environ.get("BENCH_BERT_STEPS", "10"))
+
+    params = jax.jit(lambda k: bert.init(k))(jax.random.PRNGKey(0))
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    n_total = sum(x.size for _, x in flat)
+    n_params = sum(
+        x.size for path, x in flat
+        if not any(getattr(k, "key", None) == "embed" for k in path))
+    batch_data = bert.synthetic_batch(
+        jax.random.PRNGKey(1), batch, seq_len=seq,
+        vocab_size=bert.BASE_CONFIG["vocab_size"])
+    opt = optim.adamw(1e-4, wd_mask=optim.make_wd_mask(params))
+    step, state = build_train_step(bert.loss_fn, opt, params, batch_data,
+                                   grad_clip=1.0)
+    state, m = step(state, batch_data)
+    float(m["loss"])  # compile + real completion
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch_data)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        best = dt if best is None else min(best, dt)
+    seqs_per_sec = batch / best
+    flops_per_seq = 6.0 * n_params * seq
+    return {
+        "model": "bert-base", "batch": batch, "seq": seq,
+        "params_m": round(n_total / 1e6, 1),
+        "matmul_params_m": round(n_params / 1e6, 1),
+        "seqs_per_sec": round(seqs_per_sec, 1),
+        "step_ms": round(best * 1000, 2),
+        "mfu": round(seqs_per_sec * flops_per_seq / (calib_tflops * 1e12), 4),
     }
 
 
