@@ -386,7 +386,22 @@ class TpuJobReconciler:
                                 helper.COORD_CONTAINER_NAME, ["touch", "goon"],
                             )
                         except Exception as e:
+                            # A silent warning here strands the whole gang in
+                            # init containers (the shipped ClusterRole grants
+                            # no pods/exec — the HTTP coordination channel is
+                            # the production release path). Surface it where
+                            # the user is looking: on the job.
                             log.warning("exec release failed: %s", e)
+                            self.recorder.event(
+                                job.obj, "Warning", "ExecReleaseFailed",
+                                "exec release of %s failed: %s — the exec "
+                                "fallback needs a pods/exec RBAC rule (not "
+                                "in the shipped ClusterRole); enable the "
+                                "HTTP coordination channel "
+                                "(--coordination-bind-address) or grant "
+                                "pods/exec"
+                                % (pod["metadata"]["name"], e),
+                            )
                 return Result(requeue_after=1.0)
         return Result()
 

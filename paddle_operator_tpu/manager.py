@@ -85,6 +85,12 @@ def main(argv=None):
 
     coord_srv = None
     coord_url = args.coordination_url
+    if not args.coordination_bind_address and args.init_image:
+        log.warning(
+            "coordination endpoint disabled: startup release falls back to "
+            "pods/exec, which the shipped ClusterRole does NOT grant — jobs "
+            "will hang in Starting unless you add a pods/exec rule "
+            "(ExecReleaseFailed events will say the same per job)")
     if args.coordination_bind_address:
         coord_srv = CoordinationServer(
             cached_client, args.coordination_bind_address)

@@ -278,3 +278,16 @@ def test_runner_fused_matches_per_step_loss():
                                steps_per_call=3),
                      cfg=LaunchConfig(), init_distributed=False)
     assert abs(a["loss"] - b["loss"]) < 1e-4
+
+
+def test_pod_spec_unknown_fields_preserved_containers_strict():
+    """Pod-SPEC-level unknown fields (new k8s minors add them) must survive
+    CRD admission pruning, while container typos remain rejected."""
+    from paddle_operator_tpu.api.crd import pod_template_schema
+
+    schema = pod_template_schema()
+    spec = schema["properties"]["spec"]
+    assert spec.get("x-kubernetes-preserve-unknown-fields") is True
+    container = spec["properties"]["containers"]["items"]
+    assert "x-kubernetes-preserve-unknown-fields" not in container
+    assert "image" in container["properties"]
