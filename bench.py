@@ -71,7 +71,7 @@ STAGE_DEADLINES = {
     "bert_bench": float(os.environ.get("BENCH_T_BERT", "300")),
     # extras run AFTER the core JSON is already on stdout: a wedged extra
     # loses only the enrichment, never the headline number
-    "attention_bench": float(os.environ.get("BENCH_T_ATTENTION", "300")),
+    "attention_bench": float(os.environ.get("BENCH_T_ATTENTION", "420")),
     "data_pipeline": float(os.environ.get("BENCH_T_PIPELINE", "150")),
     "gang_latency": float(os.environ.get("BENCH_T_GANG", "300")),
 }
@@ -254,37 +254,34 @@ def child_main():
         print(json.dumps(result))
         sys.stdout.flush()
 
+    def run_extra(env_var, stage, key, thunk):
+        """Gate on env, mark the stage, guard, and RE-EMIT the JSON after
+        completion (parent keeps the LAST line) — a stage-deadline kill
+        mid-extras must only lose the stage it killed, never results that
+        already completed before it. One helper so a future extra cannot
+        forget the re-emit and silently revert that invariant."""
+        if os.environ.get(env_var, "1") != "1":
+            return
+        _stage(stage)
+        try:
+            result[key] = thunk()
+        except Exception as e:  # OOM/lowering: keep everything already won
+            result[key + "_error"] = repr(e)[:200]
+        print(json.dumps(result))
+        sys.stdout.flush()
+
     want_extras = os.environ.get(
         "BENCH_EXTRAS", "1" if backend == "tpu" else "0") == "1"
     if want_extras:
-        if os.environ.get("BENCH_FUSED", "1") == "1":
-            _stage("fused_measure")
-            try:
-                result["fused"] = _fused_bench(
-                    batch, params, batch_data, calib_tflops, opt, mesh)
-            except Exception as e:
-                result["fused_error"] = repr(e)[:200]
-        if os.environ.get("BENCH_BERT", "1") == "1":
-            _stage("bert_bench")
-            try:
-                result["bert"] = _bert_bench(calib_tflops)
-            except Exception as e:
-                result["bert_error"] = repr(e)[:200]
-        if os.environ.get("BENCH_ATTN", "1") == "1":
-            _stage("attention_bench")
-            try:
-                result["attention"] = _attention_bench(backend)
-            except Exception as e:  # OOM/lowering: keep the core number
-                result["attention_error"] = repr(e)[:200]
-        if os.environ.get("BENCH_PIPELINE", "1") == "1":
-            _stage("data_pipeline")
-            try:
-                result["data_pipeline"] = _pipeline_bench(
-                    step, state, batch_data)
-            except Exception as e:
-                result["data_pipeline_error"] = repr(e)[:200]
-        print(json.dumps(result))
-        sys.stdout.flush()
+        run_extra("BENCH_FUSED", "fused_measure", "fused",
+                  lambda: _fused_bench(batch, params, batch_data,
+                                       calib_tflops, opt, mesh))
+        run_extra("BENCH_BERT", "bert_bench", "bert",
+                  lambda: _bert_bench(calib_tflops))
+        run_extra("BENCH_ATTN", "attention_bench", "attention",
+                  lambda: _attention_bench(backend))
+        run_extra("BENCH_PIPELINE", "data_pipeline", "data_pipeline",
+                  lambda: _pipeline_bench(step, state, batch_data))
 
 
 def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
@@ -478,6 +475,9 @@ def _attention_bench(backend):
     ]
     out = []
     for cfg in configs:
+        # re-mark the stage per config: each one compiles + runs several
+        # chained programs, and the watchdog should budget them separately
+        _stage("attention_bench")
         b, h, s, d = cfg["b"], cfg["h"], cfg["seq"], cfg["d"]
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)

@@ -63,6 +63,9 @@ class TpuJobReconciler:
         # used; when empty, the legacy exec-push release applies (fake-client
         # harness parity only — HttpKubeClient cannot exec).
         self.coordination_url = coordination_url
+        # jobs already warned about exec-release failure: the failure
+        # repeats every 1s requeue pass, the Event must not (apiserver flood)
+        self._exec_release_warned: set = set()
 
     # ------------------------------------------------------------------
     # main loop
@@ -390,18 +393,22 @@ class TpuJobReconciler:
                             # init containers (the shipped ClusterRole grants
                             # no pods/exec — the HTTP coordination channel is
                             # the production release path). Surface it where
-                            # the user is looking: on the job.
+                            # the user is looking: on the job — ONCE, not on
+                            # every 1s requeue pass of every pod.
                             log.warning("exec release failed: %s", e)
-                            self.recorder.event(
-                                job.obj, "Warning", "ExecReleaseFailed",
-                                "exec release of %s failed: %s — the exec "
-                                "fallback needs a pods/exec RBAC rule (not "
-                                "in the shipped ClusterRole); enable the "
-                                "HTTP coordination channel "
-                                "(--coordination-bind-address) or grant "
-                                "pods/exec"
-                                % (pod["metadata"]["name"], e),
-                            )
+                            key = (job.namespace, job.name)
+                            if key not in self._exec_release_warned:
+                                self._exec_release_warned.add(key)
+                                self.recorder.event(
+                                    job.obj, "Warning", "ExecReleaseFailed",
+                                    "exec release of %s failed: %s — the "
+                                    "exec fallback needs a pods/exec RBAC "
+                                    "rule (not in the shipped ClusterRole); "
+                                    "enable the HTTP coordination channel "
+                                    "(--coordination-bind-address) or grant "
+                                    "pods/exec"
+                                    % (pod["metadata"]["name"], e),
+                                )
                 return Result(requeue_after=1.0)
         return Result()
 

@@ -85,7 +85,8 @@ def main(argv=None):
 
     coord_srv = None
     coord_url = args.coordination_url
-    if not args.coordination_bind_address and args.init_image:
+    if (not args.coordination_bind_address and args.init_image
+            and not args.coordination_url):  # external endpoint: exec unused
         log.warning(
             "coordination endpoint disabled: startup release falls back to "
             "pods/exec, which the shipped ClusterRole does NOT grant — jobs "
