@@ -19,16 +19,22 @@ img/s) are NOT comparable to the readback-synced ones; the JSON carries
 ``sync: host-readback`` to mark the new regime, plus the old-style
 ``dispatch_rate_images_per_sec`` for continuity.
 
-Architecture (post round-1 hang): a PARENT process that never imports jax
-(so it cannot hang) supervises a CHILD subprocess that does the actual
-benchmark. The child emits `BENCH_STAGE <name>` markers on stderr as it
-enters each stage; the parent enforces a per-stage deadline and an overall
-budget, kills a wedged child, and retries down a batch ladder
-(256 -> 64 -> 8). Backend/interpreter-startup hangs (the round-1 failure:
-the TPU claim stalled before `jax.devices()` returned) are retried once,
-then the parent falls back to the CPU backend so a real -- honestly
-labelled -- number exists either way. On total failure it still emits a
-JSON line with `stage_reached` so the BENCH artifact localizes the hang.
+Architecture (post round-1 hang, inverted in round 4): a PARENT process
+that never imports jax (so it cannot hang) supervises CHILD subprocesses
+that do the actual work. Children emit `BENCH_STAGE <name>` markers on
+stderr; the parent enforces per-stage deadlines and an overall budget and
+stops a wedged child SIGTERM-first (a SIGKILLed child is what wedges this
+environment's relay in the first place — round-3 lesson).
+
+Supervision order (round-4 fix for the round-3 artifact capturing a CPU
+fallback while the chip did 2,479 img/s in-session): the parent BANKS the
+cheap CPU fallback number FIRST and prints it, then spends the ENTIRE
+remaining `BENCH_TIMEOUT` probing the TPU with tiny canary children on a
+backoff loop; the moment a canary executes real work it runs the full
+measurement (batch ladder 256 -> 64 -> 8 on compute-side failures) and
+re-emits — the driver keeps the LAST JSON line, so the TPU number
+replaces the banked CPU number exactly when it exists. On total failure
+it still emits a JSON line with `stage_reached` localizing the hang.
 """
 
 import json
@@ -72,8 +78,19 @@ STAGE_DEADLINES = {
     # extras run AFTER the core JSON is already on stdout: a wedged extra
     # loses only the enrichment, never the headline number
     "attention_bench": float(os.environ.get("BENCH_T_ATTENTION", "420")),
+    "gpt_bench": float(os.environ.get("BENCH_T_GPT", "360")),
+    "moe_bench": float(os.environ.get("BENCH_T_MOE", "300")),
     "data_pipeline": float(os.environ.get("BENCH_T_PIPELINE", "150")),
     "gang_latency": float(os.environ.get("BENCH_T_GANG", "300")),
+}
+
+# Tighter deadlines for the tiny TPU canary probe: its whole job is to
+# answer "is the relay alive?" quickly, so a wedge should cost minutes,
+# not the full measurement deadlines.
+CANARY_DEADLINES = {
+    "child_up": float(os.environ.get("BENCH_T_CANARY_STARTUP", "90")),
+    "backend_init": float(os.environ.get("BENCH_T_CANARY_BACKEND", "90")),
+    "canary": float(os.environ.get("BENCH_T_CANARY_RUN", "60")),
 }
 
 STAGE_MARK = "BENCH_STAGE "
@@ -92,7 +109,42 @@ def _stage(name):
     print(STAGE_MARK + name, file=sys.stderr, flush=True)
 
 
+def _install_sigterm_exit():
+    """Make SIGTERM run Python-level teardown. The default disposition
+    terminates the process without finally blocks/atexit — functionally a
+    SIGKILL as far as the relay teardown path is concerned, which defeats
+    the parent's TERM-first grace. sys.exit raises SystemExit through the
+    stack instead, so context managers and atexit (where the backend
+    plugin hooks its shutdown) actually run."""
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+
+def canary_main():
+    """Minimal TPU liveness probe: backend init + one tiny matmul with a
+    host readback. Exits 0 with a one-line JSON iff the relay really
+    executes work. Kept as small as possible so a wedged relay is detected
+    in ~a minute, not after the full measurement's deadlines."""
+    _install_sigterm_exit()
+    _stage("backend_init")
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    _stage("canary")
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    val = float(jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x))
+    print(json.dumps({
+        "canary": "ok", "backend": backend, "value": val,
+        "seconds": round(time.perf_counter() - t0, 1)}))
+    sys.stdout.flush()
+
+
 def child_main():
+    _install_sigterm_exit()
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     _stage("backend_init")
     import jax
@@ -273,15 +325,36 @@ def child_main():
     want_extras = os.environ.get(
         "BENCH_EXTRAS", "1" if backend == "tpu" else "0") == "1"
     if want_extras:
-        run_extra("BENCH_FUSED", "fused_measure", "fused",
-                  lambda: _fused_bench(batch, params, batch_data,
-                                       calib_tflops, opt, mesh))
-        run_extra("BENCH_BERT", "bert_bench", "bert",
-                  lambda: _bert_bench(calib_tflops))
-        run_extra("BENCH_ATTN", "attention_bench", "attention",
-                  lambda: _attention_bench(backend))
-        run_extra("BENCH_PIPELINE", "data_pipeline", "data_pipeline",
-                  lambda: _pipeline_bench(step, state, batch_data))
+        # Ordered cheapest/most-required first: a budget kill mid-extras
+        # keeps everything already re-emitted, so the tail is what gets
+        # sacrificed. Order overridable without a code change.
+        extras = {
+            "fused": ("BENCH_FUSED", "fused_measure",
+                      lambda: _fused_bench(batch, params, batch_data,
+                                           calib_tflops, opt, mesh)),
+            "bert": ("BENCH_BERT", "bert_bench",
+                     lambda: _bert_bench(calib_tflops)),
+            "gpt": ("BENCH_GPT", "gpt_bench",
+                    lambda: _gpt_bench(calib_tflops)),
+            "moe": ("BENCH_MOE", "moe_bench",
+                    lambda: _moe_bench(calib_tflops)),
+            "attention": ("BENCH_ATTN", "attention_bench",
+                          lambda: _attention_bench(backend)),
+            "data_pipeline": ("BENCH_PIPELINE", "data_pipeline",
+                              lambda: _pipeline_bench(step, state,
+                                                      batch_data)),
+        }
+        order = os.environ.get(
+            "BENCH_EXTRAS_ORDER",
+            "fused,bert,gpt,moe,attention,data_pipeline")
+        for key in (k.strip() for k in order.split(",")):
+            if key in extras:
+                env_var, stage, thunk = extras[key]
+                run_extra(env_var, stage, key, thunk)
+            elif key:
+                # a typo'd key must not silently cost a benchmark entry
+                _log("BENCH_EXTRAS_ORDER: unknown extra %r skipped "
+                     "(known: %s)" % (key, ",".join(extras)))
 
 
 def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
@@ -332,6 +405,25 @@ def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
     }
 
 
+def _timed_windows(step, state, batch_data, steps):
+    """Compile+run once, then best-of-2 windows of `steps` steps, each
+    synced by a single host readback of the last step's loss (the ONLY
+    sync this backend honors — module docstring). The one place the
+    readback-sync methodology lives for the per-model extras, so a future
+    sync fix lands once, not in every bench."""
+    state, m = step(state, batch_data)
+    float(m["loss"])  # compile + real completion
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch_data)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        best = dt if best is None else min(best, dt)
+    return best
+
+
 def _bert_bench(calib_tflops):
     """BERT-base MLM train step (the BASELINE multi-host acceptance config,
     measured per-chip): fwd+bwd+AdamW at seq 512, host-readback synced.
@@ -361,16 +453,7 @@ def _bert_bench(calib_tflops):
     opt = optim.adamw(1e-4, wd_mask=optim.make_wd_mask(params))
     step, state = build_train_step(bert.loss_fn, opt, params, batch_data,
                                    grad_clip=1.0)
-    state, m = step(state, batch_data)
-    float(m["loss"])  # compile + real completion
-    best = None
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = step(state, batch_data)
-        float(m["loss"])
-        dt = (time.perf_counter() - t0) / steps
-        best = dt if best is None else min(best, dt)
+    best = _timed_windows(step, state, batch_data, steps)
     seqs_per_sec = batch / best
     flops_per_seq = 6.0 * n_params * seq
     return {
@@ -380,6 +463,119 @@ def _bert_bench(calib_tflops):
         "seqs_per_sec": round(seqs_per_sec, 1),
         "step_ms": round(best * 1000, 2),
         "mfu": round(seqs_per_sec * flops_per_seq / (calib_tflops * 1e12), 4),
+    }
+
+
+def _gpt_bench(calib_tflops):
+    """GPT-2-small causal-LM train step at long context (default 2048):
+    fwd+bwd+AdamW through the causal flash-attention + RoPE path, host-
+    readback synced. First hardware timing for the GPT family (round-3
+    verdict item 3).
+
+    MFU numerator = dense-matmul FLOPs (6 * matmul_params * tokens, embed
+    tables excluded as in the BERT entry) + causal attention matmul FLOPs
+    (QK^T + PV = 4*S^2*hidden per seq per layer, halved by causality,
+    x3 for fwd+bwd) — at S=2048 attention is ~20% of the total, too big
+    to ignore in the numerator.
+    """
+    import jax
+
+    from paddle_operator_tpu.models import gpt
+    from paddle_operator_tpu.ops import optim
+    from paddle_operator_tpu.parallel import build_train_step
+
+    batch = int(os.environ.get("BENCH_GPT_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_GPT_SEQ", "2048"))
+    steps = int(os.environ.get("BENCH_GPT_STEPS", "10"))
+
+    cfg = dict(gpt.BASE_CONFIG, max_seq=seq)
+    params = jax.jit(lambda k: gpt.init(k, cfg))(jax.random.PRNGKey(0))
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    n_total = sum(x.size for _, x in flat)
+    n_matmul = sum(
+        x.size for path, x in flat
+        if not any(getattr(k, "key", None) == "embed" for k in path))
+    batch_data = gpt.synthetic_batch(
+        jax.random.PRNGKey(1), batch, seq_len=seq,
+        vocab_size=cfg["vocab_size"])
+    opt = optim.adamw(1e-4, wd_mask=optim.make_wd_mask(params))
+    step, state = build_train_step(gpt.loss_fn, opt, params, batch_data,
+                                   grad_clip=1.0)
+    best = _timed_windows(step, state, batch_data, steps)
+    tokens_per_sec = batch * seq / best
+    dense_flops = 6.0 * n_matmul * seq          # per sequence
+    attn_flops = 3.0 * 2.0 * seq * seq * cfg["hidden"] * cfg["layers"]
+    flops_per_seq = dense_flops + attn_flops
+    return {
+        "model": "gpt2-small", "batch": batch, "seq": seq,
+        "params_m": round(n_total / 1e6, 1),
+        "matmul_params_m": round(n_matmul / 1e6, 1),
+        "tokens_per_sec": round(tokens_per_sec, 0),
+        "step_ms": round(best * 1000, 2),
+        "mfu": round((batch / best) * flops_per_seq
+                     / (calib_tflops * 1e12), 4),
+    }
+
+
+def _moe_bench(calib_tflops):
+    """BERT-base with switch-MoE FFNs (8 experts, every 2nd layer) — the
+    expert-parallel data path (ops/moe.py dense dispatch/combine einsums)
+    timed on hardware for the first time (round-3 verdict item 3).
+
+    MFU here divides by the FLOPs the dense-dispatch formulation actually
+    executes (dispatch/combine T*E*C*d einsums + expert matmuls at
+    capacity), not a hypothetical top-1 cost — so it measures how well the
+    chosen GSPMD formulation uses the MXU, and tokens/s is the
+    end-to-end number to compare against the dense BERT entry.
+    """
+    import jax
+
+    from paddle_operator_tpu.models import bert
+    from paddle_operator_tpu.ops import optim
+    from paddle_operator_tpu.parallel import build_train_step
+
+    batch = int(os.environ.get("BENCH_MOE_BATCH", "16"))
+    seq = int(os.environ.get("BENCH_MOE_SEQ", "512"))
+    steps = int(os.environ.get("BENCH_MOE_STEPS", "10"))
+    experts = int(os.environ.get("BENCH_MOE_EXPERTS", "8"))
+
+    cfg = dict(bert.BASE_CONFIG, moe_experts=experts, moe_every=2)
+    params = jax.jit(lambda k: bert.init(k, cfg))(jax.random.PRNGKey(0))
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    n_total = sum(x.size for _, x in flat)
+    batch_data = bert.synthetic_batch(
+        jax.random.PRNGKey(1), batch, seq_len=seq,
+        vocab_size=cfg["vocab_size"])
+    opt = optim.adamw(1e-4, wd_mask=optim.make_wd_mask(params))
+    step, state = build_train_step(bert.loss_fn, opt, params, batch_data,
+                                   grad_clip=1.0)
+    best = _timed_windows(step, state, batch_data, steps)
+    tokens_per_sec = batch * seq / best
+
+    # Executed FLOPs per sequence: dense (non-MoE) matmul params via 6ND
+    # over params minus expert/embedding weights, plus per-MoE-layer
+    # dispatch/combine and capacity-bounded expert matmuls (x3 fwd+bwd).
+    h, mlp = cfg["hidden"], cfg["mlp_dim"]
+    n_moe_layers = sum(1 for li in range(cfg["layers"])
+                       if li % cfg["moe_every"] == 0)
+    n_expert = n_moe_layers * (experts * 2 * h * mlp)
+    n_embed = sum(
+        x.size for path, x in flat
+        if any(getattr(k, "key", None) == "embed" for k in path))
+    tokens = batch * seq
+    cap = max(1, int(1.25 * tokens / experts))
+    moe_layer_flops = (
+        2.0 * tokens * experts * cap * h * 2        # dispatch + combine
+        + 2.0 * experts * cap * h * mlp * 2)        # fc1 + fc2 at capacity
+    flops_per_step = (6.0 * (n_total - n_expert - n_embed) * tokens
+                      + 3.0 * n_moe_layers * moe_layer_flops)
+    return {
+        "model": "bert-base-moe", "batch": batch, "seq": seq,
+        "experts": experts, "moe_layers": n_moe_layers,
+        "params_m": round(n_total / 1e6, 1),
+        "tokens_per_sec": round(tokens_per_sec, 0),
+        "step_ms": round(best * 1000, 2),
+        "mfu": round((flops_per_step / best) / (calib_tflops * 1e12), 4),
     }
 
 
@@ -635,11 +831,14 @@ def _make(batch_size, image_size, key):
 # ---------------------------------------------------------------------------
 
 class _Attempt:
-    def __init__(self, batch, platform=None, steps=None, warmup=None):
+    def __init__(self, batch, platform=None, steps=None, warmup=None,
+                 mode="bench"):
         self.batch = batch
         self.platform = platform
         self.steps = steps
         self.warmup = warmup
+        self.mode = mode  # "bench" | "canary"
+        self.deadlines = CANARY_DEADLINES if mode == "canary" else None
         self.stage = "child_up"
         self.stage_t = time.monotonic()
         self.stdout_lines = []
@@ -647,9 +846,37 @@ class _Attempt:
         self.outcome = None  # "ok" | "killed:<stage>" | "exit:<rc>"
 
 
+def _stop_child(proc, why):
+    """SIGTERM first with a grace window, SIGKILL only if ignored.
+
+    Round-3 lesson: this environment's TPU relay wedges for long stretches
+    after a SIGKILLed child — and the round-3 bench's own watchdog
+    SIGKILLed, so the bench poisoned the backend it then needed for the
+    next attempt. A TERMed child gets to run the relay teardown path; the
+    KILL remains only for a child wedged inside an uninterruptible call.
+    """
+    _log("stopping child (SIGTERM): " + why)
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        proc.terminate()
+    try:
+        proc.wait(timeout=float(os.environ.get("BENCH_TERM_GRACE", "10")))
+        return
+    except subprocess.TimeoutExpired:
+        pass
+    _log("child ignored SIGTERM; escalating to SIGKILL")
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+    proc.wait()
+
+
 def _run_attempt(att, budget_s):
     env = os.environ.copy()
     env["BENCH_CHILD"] = "1"
+    env["BENCH_MODE"] = att.mode
     env["BENCH_BATCH"] = str(att.batch)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     if att.platform:
@@ -702,17 +929,12 @@ def _run_attempt(att, budget_s):
             break
         now = time.monotonic()
         in_stage = now - att.stage_t
-        deadline = STAGE_DEADLINES.get(att.stage, 180.0)
+        deadline = (att.deadlines or STAGE_DEADLINES).get(att.stage, 180.0)
         if in_stage > deadline or (now - t_start) > budget_s:
             why = ("stage '%s' exceeded %.0fs" % (att.stage, deadline)
                    if in_stage > deadline
                    else "attempt exceeded budget %.0fs" % budget_s)
-            _log("killing child: " + why)
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                proc.kill()
-            proc.wait()
+            _stop_child(proc, why)
             t_err.join(timeout=5)
             t_out.join(timeout=5)
             _parse_result(att)
@@ -746,6 +968,21 @@ def _parse_result(att):
 
 
 def parent_main():
+    """Round-4 supervision order (the round-3 verdict's top item):
+
+    1. BANK the CPU fallback number FIRST (~90 s, touches no TPU state,
+       cannot wedge anything) and print it — the driver keeps the LAST
+       JSON line, so this guarantees a real number exists no matter what
+       happens to the TPU for the rest of the budget.
+    2. Spend the ENTIRE remaining budget probing the TPU with tiny canary
+       children on a backoff loop. Round 3 retried backend_init exactly
+       once, fell back to CPU with ~8 minutes left, and the artifact
+       recorded 0.41 img/s while the chip did 2,479 in-session.
+    3. The moment a canary executes real work, run the full measurement
+       and re-emit — the TPU line replaces the banked CPU line. Pre-compute
+       failures return to the canary loop (the relay re-wedged); compute
+       failures walk down the batch ladder.
+    """
     total_budget = float(os.environ.get("BENCH_TIMEOUT", "840"))
     t_start = time.monotonic()
     # 256 peaks the readback-synced batch sweep (2467 img/s vs 2372 @512,
@@ -755,55 +992,126 @@ def parent_main():
     ladder = sorted(set(ladder), reverse=True)
 
     attempts = []
-    startup_retries = 1  # one extra chance for a transient TPU-claim stall
 
     def remaining():
         return total_budget - (time.monotonic() - t_start)
 
-    i = 0
-    while i < len(ladder):
-        batch = ladder[i]
-        if remaining() < 60:
-            _log("out of budget before attempt (batch=%d)" % batch)
-            break
-        att = _run_attempt(_Attempt(batch), min(remaining() - 20, 600))
+    banked = None
+
+    def bank_cpu(note):
+        # batch 8 / 1 step: a CPU ResNet step is ~20-40 s, and every second
+        # spent here is a second not spent probing the TPU — the bank only
+        # needs to exist, not to be precise.
+        att = _run_attempt(
+            _Attempt(int(os.environ.get("BENCH_CPU_BATCH", "8")),
+                     platform="cpu", steps=1, warmup=1),
+            min(remaining() - 10, 300))
         attempts.append(att)
         if att.outcome.startswith("ok"):
-            if att.outcome != "ok":
-                att.result = dict(att.result)
-                att.result["note"] = ("extras interrupted (%s); core "
-                                      "measurement complete" % att.outcome)
-            _emit(att.result, attempts)
-            return
-        _log("attempt failed: %s (batch=%d)" % (att.outcome, att.batch))
-        # Classify by the stage reached, not by killed-vs-exited: batch size
-        # is irrelevant to a backend that won't even initialize.
-        stuck_pre_compute = att.stage in ("child_up", "backend_init")
-        if stuck_pre_compute and startup_retries > 0:
-            startup_retries -= 1
-            time.sleep(5)  # let the relay/claim settle before re-dialing
-            continue  # same rung
-        if stuck_pre_compute:
-            break  # TPU unreachable; go to CPU fallback
-        i += 1  # compute-side trouble: smaller batch
-
-    # CPU fallback: an honestly-labelled number beats no number.
-    if os.environ.get("BENCH_CPU_FALLBACK", "1") == "1" and remaining() > 90:
-        _log("falling back to CPU backend")
-        # CPU ResNet-50 runs ~seconds/step; a short measured window is all
-        # the budget allows and all the honesty requires.
-        att = _run_attempt(
-            _Attempt(int(os.environ.get("BENCH_CPU_BATCH", "16")),
-                     platform="cpu", steps=2, warmup=1),
-            min(remaining() - 10, 420))
-        attempts.append(att)
-        if att.outcome.startswith("ok"):  # ok_partial: core number exists
             res = dict(att.result)
-            res["note"] = "TPU backend unavailable; CPU fallback"
-            if att.outcome != "ok":
-                res["note"] += "; extras interrupted (%s)" % att.outcome
+            res["note"] = note
             _emit(res, attempts)
-            return
+            return res
+        return None
+
+    # ---- Phase 1: bank the CPU number first. Cheap, relay-independent
+    # (the CPU child strips the axon sitecustomize entirely), and printed
+    # immediately so even a parent killed at the driver's deadline leaves
+    # a parseable artifact behind.
+    want_cpu_bank = os.environ.get("BENCH_CPU_FALLBACK", "1") == "1"
+    if want_cpu_bank and remaining() > 90:
+        _log("phase 1: banking CPU fallback number")
+        banked = bank_cpu("CPU fallback banked first; TPU probing follows "
+                          "with the remaining budget")
+
+    # ---- Phases 2+3: canary-probe until the relay answers, then measure.
+    probe_backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "20"))
+    # a full canary cycle can legitimately take every stage deadline in
+    # sequence; only launch one if the whole worst case fits, or the final
+    # canary gets TERM->KILLed mid-TPU-claim — the exact kill that wedges
+    # this relay
+    min_probe_budget = sum(CANARY_DEADLINES.values()) + 15
+    i = 0  # ladder index survives re-probing: a batch that failed at a
+    #        compute stage is not retried after the relay recovers
+    tpu_seen = False   # any canary succeeded: changes the final label
+    n_probes = 0       # canaries launched: the final label must not claim
+    #                    probing that never happened
+    no_plugin = None   # canary ran on a non-TPU backend: probing is moot
+    while remaining() > min_probe_budget and i < len(ladder):
+        att = _run_attempt(_Attempt(0, mode="canary"),
+                           min(remaining() - 10, 240))
+        attempts.append(att)
+        n_probes += 1
+        if (att.outcome == "ok" and att.result is not None
+                and att.result.get("backend") not in (None, "tpu")):
+            # The child env has no TPU plugin registered at all (canary
+            # ran fine on another backend). That is decided by the child's
+            # static environment, not relay state — re-probing cannot
+            # change the answer, so stop burning budget on it.
+            no_plugin = att.result.get("backend")
+            _log("canary reports backend=%r: no TPU plugin in child env; "
+                 "not re-probing" % no_plugin)
+            break
+        alive = (att.outcome == "ok" and att.result is not None
+                 and att.result.get("canary") == "ok"
+                 and att.result.get("backend") == "tpu")
+        if not alive:
+            _log("TPU canary failed (%s); %.0fs budget left"
+                 % (att.outcome, remaining()))
+            if remaining() > min_probe_budget + probe_backoff:
+                time.sleep(probe_backoff)
+            continue
+        tpu_seen = True
+        _log("TPU canary ok in %.0fs; starting full measurement (%.0fs "
+             "budget left)" % (att.result.get("seconds", -1), remaining()))
+        while i < len(ladder) and remaining() > 60:
+            att = _run_attempt(_Attempt(ladder[i]),
+                               min(remaining() - 10, 600))
+            attempts.append(att)
+            if att.outcome.startswith("ok"):
+                res = dict(att.result)
+                if att.outcome != "ok":
+                    res["note"] = ("extras interrupted (%s); core "
+                                   "measurement complete" % att.outcome)
+                _emit(res, attempts)
+                return
+            _log("attempt failed: %s (batch=%d)" % (att.outcome, att.batch))
+            # Classify by the stage reached: batch size is irrelevant to a
+            # backend that won't even initialize — that's the relay
+            # re-wedging, so go back to the canary loop without burning a
+            # ladder rung.
+            if att.stage in ("child_up", "backend_init"):
+                break
+            i += 1  # compute-side trouble: smaller batch
+
+    # ---- Out of budget or ladder. The label must match the evidence:
+    # reachable-but-unmeasured, ladder exhausted, unreachable-probed,
+    # no plugin, and budget-too-small are five different failures.
+    if tpu_seen and i >= len(ladder):
+        note = ("TPU reachable (canary ok) but every measurement attempt "
+                "failed (batch ladder exhausted) — see attempts; "
+                "CPU fallback")
+    elif tpu_seen:
+        note = ("TPU reachable (canary ok) but full measurement did not "
+                "complete within budget — see attempts; CPU fallback")
+    elif no_plugin:
+        note = ("no TPU plugin registered in the child environment "
+                "(canary ran on backend=%r); CPU fallback" % no_plugin)
+    elif n_probes:
+        note = ("TPU backend unavailable (%d canary probes until budget "
+                "exhausted); CPU fallback" % n_probes)
+    else:
+        note = "no TPU probe fit the remaining budget; CPU fallback"
+
+    # A transiently failed phase-1 bank must not turn a healthy CPU into a
+    # value-0 artifact: retry the bank with whatever budget is left.
+    if banked is None and want_cpu_bank and remaining() > 90:
+        _log("retrying CPU bank with remaining budget")
+        banked = bank_cpu(note)
+    if banked is not None:
+        banked["note"] = note
+        _emit(banked, attempts)
+        return
 
     # Total failure: still emit one parseable JSON line localizing the hang.
     last = attempts[-1] if attempts else None
@@ -813,23 +1121,28 @@ def parent_main():
         "unit": "images/sec",
         "vs_baseline": 0.0,
         "stage_reached": last.stage if last else "none",
-        "attempts": [
-            {"batch": a.batch, "platform": a.platform or "tpu",
-             "outcome": a.outcome} for a in attempts],
+        "attempts": _attempt_log(attempts),
     }))
 
 
+def _attempt_log(attempts):
+    return [
+        {"batch": a.batch, "platform": a.platform or "tpu",
+         "mode": a.mode, "outcome": a.outcome} for a in attempts]
+
+
 def _emit(result, attempts):
-    if len(attempts) > 1:
-        result = dict(result)
-        result["attempts"] = [
-            {"batch": a.batch, "platform": a.platform or "tpu",
-             "outcome": a.outcome} for a in attempts]
+    result = dict(result)
+    result["attempts"] = _attempt_log(attempts)
     print(json.dumps(result))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
-        child_main()
+        if os.environ.get("BENCH_MODE") == "canary":
+            canary_main()
+        else:
+            child_main()
     else:
         parent_main()

@@ -75,6 +75,9 @@ class TpuJobReconciler:
         try:
             obj = self.client.get(api.KIND, namespace, name)
         except NotFoundError:
+            # Job is gone: drop its warn-once marker so memory stays bounded
+            # across job churn and a recreated same-name job warns afresh.
+            self._exec_release_warned.discard((namespace, name))
             return Result()
         job = api.TpuJob(obj)
 

@@ -220,7 +220,12 @@ class LeaderElector:
                 # forever while the lease expires under us (split brain).
                 log.warning("%s: renewal step raised %r; treating as failed",
                             self.identity, e)
-                renewed = self._within_renew_deadline(self._clock())
+                # Same guard as the ApiError grace paths in
+                # try_acquire_or_renew: a non-leader must never count a
+                # raised step as a renewal, or last_renew resets based on
+                # another holder's recently-observed record.
+                renewed = (self._is_leader
+                           and self._within_renew_deadline(self._clock()))
             if renewed:
                 last_renew = self._clock()
                 continue
