@@ -1,0 +1,37 @@
+"""wide_and_deep CTR training in PS mode (BASELINE config #1, live).
+
+Runs under the operator-injected env: each pod calls this same entry;
+``TRAINING_ROLE`` decides whether it serves a parameter shard
+(``PSERVER``) or trains (``TRAINER``). See paddle_operator_tpu/ps.py for
+the BSP protocol; the collective-mode twin is train_wide_deep.py.
+"""
+
+import logging
+import os
+
+from paddle_operator_tpu import launch, ps
+from paddle_operator_tpu.models import wide_deep
+
+logging.basicConfig(level=logging.INFO)
+
+BATCH = int(os.environ.get("TPUJOB_BATCH", "512"))
+STEPS = int(os.environ.get("TPUJOB_STEPS", "100"))
+LR = float(os.environ.get("TPUJOB_LR", "0.1"))
+
+
+def main():
+    cfg = launch.detect_env()
+    job = ps.PsTrainJob(
+        init_params=lambda rng: wide_deep.init(rng),
+        loss_fn=wide_deep.loss_fn,
+        make_batch=lambda rng, step: wide_deep.synthetic_batch(rng, BATCH),
+        total_steps=STEPS,
+        lr=LR,
+    )
+    out = ps.run_ps_training(job, cfg)
+    if out["role"] == "TRAINER":
+        print("final loss:", out["losses"][-1])
+
+
+if __name__ == "__main__":
+    main()

@@ -161,6 +161,26 @@ def is_all_coord_containers_running(child_pods: List[dict]) -> bool:
 # phase & mode state machine (reference: paddlejob_helper.go:92-199)
 # ---------------------------------------------------------------------------
 
+# Elastic preemption-restart budget: how many whole-slice restarts the
+# operator grants before treating pod failure as a real (terminal) crash.
+# Overridable per job via the annotation below.
+MAX_PREEMPTION_RESTARTS = 10
+ANNOT_MAX_RESTARTS = "batch.tpujob.dev/max-preemption-restarts"
+
+
+def preemption_budget(job: api.TpuJob) -> int:
+    ann = (job.metadata.get("annotations") or {}).get(ANNOT_MAX_RESTARTS)
+    try:
+        return int(ann) if ann is not None else MAX_PREEMPTION_RESTARTS
+    except ValueError:
+        return MAX_PREEMPTION_RESTARTS
+
+
+def preemption_budget_exhausted(job: api.TpuJob) -> bool:
+    return int(job.status.get("preemptionRestarts") or 0) >= \
+        preemption_budget(job)
+
+
 def get_job_phase(job: api.TpuJob) -> str:
     """Sticky-final phase derivation, identical semantics to the reference."""
     if job.phase == api.Phase.COMPLETED:
@@ -171,6 +191,15 @@ def get_job_phase(job: api.TpuJob) -> str:
     specs, statuses = job.get_specs(), job.get_statuses()
     # priority across roles: Failed > Starting > Pending
     if any(is_failed(s) for s in statuses.values()):
+        # Elastic jobs survive preemption: a failed pod is a transient the
+        # reconciler answers with delete-recreate + a membership-epoch bump
+        # (whole-slice restart from checkpoint, SURVEY §7 "preemption vs
+        # elasticity") — Restarting, not the sticky terminal Failed. But a
+        # deterministically-crashing container would restart the slice
+        # forever, so a restart budget bounds it: past the budget the
+        # failure is treated as real and the job fails terminally.
+        if job.elastic is not None and not preemption_budget_exhausted(job):
+            return api.Phase.RESTARTING
         return api.Phase.FAILED
     if any(is_starting(s) for s in statuses.values()):
         return api.Phase.STARTING

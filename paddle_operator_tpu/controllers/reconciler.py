@@ -18,7 +18,7 @@ from typing import List, Optional
 
 from ..api import types as api
 from ..elastic.store import KVStore
-from ..elastic.sync import sync_np
+from ..elastic.sync import bump_epoch, sync_np
 from ..k8s import objects as k8s
 from ..k8s.client import EventRecorder, KubeClient
 from ..k8s.errors import ApiError, ConflictError, NotFoundError
@@ -111,6 +111,12 @@ class TpuJobReconciler:
                 return Result(requeue_after=1.0)
             except NotFoundError:
                 return Result()
+
+        # -- elastic preemption: whole-slice restart (SURVEY §7) --------
+        if job.elastic is not None:
+            gate = self._elastic_preemption(job, child_pods)
+            if gate is not None:
+                return gate
 
         # -- volcano gang gate (reference :133-157) ---------------------
         if self.scheduling == helper.SCHEDULER_VOLCANO and not helper.without_volcano(job):
@@ -222,6 +228,83 @@ class TpuJobReconciler:
     # pieces
     # ------------------------------------------------------------------
 
+    def _elastic_preemption(self, job: api.TpuJob,
+                            child_pods: List[dict]) -> Optional[Result]:
+        """Whole-slice restart for elastic jobs when the kubelet reports a
+        pod Failed (preemption/eviction): delete the pod so the normal
+        create path replaces it, and bump the membership epoch so every
+        surviving worker ends its cycle at the next step boundary and
+        resumes from the latest checkpoint (a TPU slice is one collective —
+        a dead host stalls everyone's ICI collectives, so partial recovery
+        is not an option; SURVEY §7 "preemption vs elasticity").
+
+        Dedup: only pods NOT already marked for deletion count — real pod
+        deletion is asynchronous (grace period, finalizers, cache lag), so
+        a Failed pod can linger across many passes with a
+        deletionTimestamp; bumping again each pass would yank healthy
+        workers through repeated restarts. A restart budget
+        (status.preemptionRestarts vs helper.preemption_budget) bounds a
+        deterministically-crashing container: past it, get_job_phase stops
+        answering Restarting and the job fails terminally. Pods deleted
+        OUTRIGHT (object gone, no Failed status) take the slower built-in
+        path instead: the create path replaces them, the replacement
+        rejoins, and the stalled survivors crash out of their dead
+        collectives and are restarted by restartPolicy=OnFailure — correct
+        but slower; the epoch bump is the fast path for the
+        kubelet-reported failure this branch handles.
+        """
+        failed = [p for p in child_pods if k8s.pod_phase(p) == "Failed"]
+        if not failed:
+            return None
+        if helper.preemption_budget_exhausted(job):
+            # budget spent: get_job_phase has gone terminal Failed — let
+            # the clean-pod-policy path own the wreckage, don't restart
+            return None
+        fresh = [p for p in failed
+                 if not p["metadata"].get("deletionTimestamp")]
+        if not fresh:
+            # all already deleting: wait for the objects to go away
+            return Result(requeue_after=1.0)
+        # Bump BEFORE deleting: once the pods are gone the next pass sees
+        # no Failed pod, so a bump failure after deletion could never be
+        # retried — the incident would silently lose its restart signal.
+        epoch = None
+        if self.kv is not None:
+            try:
+                epoch = bump_epoch(self.kv, job)
+            except Exception as e:  # store unreachable — surface and retry
+                log.error("elastic epoch bump failed: %s", e)
+                return Result(requeue=True)
+        for pod in fresh:
+            self._delete_resource(job, pod)
+        # Increment the restart count against the FRESH object: job.obj's
+        # resourceVersion is stale once the status-sync update above has
+        # landed, so updating it again would conflict every time and the
+        # budget would never count.
+        try:
+            cur = self.client.get(api.KIND, job.namespace, job.name)
+            count = int(cur.get("status", {})
+                        .get("preemptionRestarts") or 0) + 1
+            cur.setdefault("status", {})["preemptionRestarts"] = count
+            self.client.update_status(cur)
+            job.status["preemptionRestarts"] = count
+        except (ConflictError, NotFoundError):
+            # best-effort: a conflict loses this increment, erring on the
+            # permissive side of the budget; the next incident re-counts
+            # from the persisted value
+            job.status["preemptionRestarts"] = (
+                int(job.status.get("preemptionRestarts") or 0) + 1)
+        self.recorder.event(
+            job.obj, "Warning", "PreemptionRestart",
+            "%d pod(s) failed (%s); deleted for recreate%s (restart %d/%d)"
+            % (len(fresh),
+               ", ".join(p["metadata"]["name"] for p in fresh),
+               "; membership epoch bumped to %s for whole-slice restart "
+               "from checkpoint" % epoch if epoch else "",
+               int(job.status["preemptionRestarts"]),
+               helper.preemption_budget(job)))
+        return Result(requeue=True)
+
     def _sync_current_status(self, job: api.TpuJob, child_pods: List[dict]) -> None:
         """reference: syncCurrentStatus (paddlejob_controller.go:335-381)."""
         new_status = {
@@ -232,6 +315,8 @@ class TpuJobReconciler:
             new_status["startTime"] = job.status["startTime"]
         if job.status.get("completionTime"):
             new_status["completionTime"] = job.status["completionTime"]
+        if job.status.get("preemptionRestarts"):
+            new_status["preemptionRestarts"] = job.status["preemptionRestarts"]
 
         per_role = {}
         for pod in child_pods:

@@ -22,6 +22,20 @@ def epoch_key(namespace: str, name: str) -> str:
     return "/tpujob/%s-%s/epoch" % (namespace, name)
 
 
+def bump_epoch(store: KVStore, job: api.TpuJob) -> str:
+    """Advance the membership epoch WITHOUT an np change: the whole-slice
+    restart signal for preemption. Workers polling the epoch (launch.
+    ElasticAgent) end the current cycle at the next step boundary and
+    re-enter from the latest checkpoint with the same world size. The
+    reference has no analog — its user containers own restart — but a TPU
+    slice is one collective: a dead host stalls every other host's ICI
+    collectives, so the operator must own the restart signal."""
+    key = epoch_key(job.namespace, job.name)
+    new = str(int(store.get(key) or "0") + 1)
+    store.put(key, new)
+    return new
+
+
 def sync_np(store: KVStore, job: api.TpuJob) -> Optional[str]:
     """Write worker replica count if changed; returns new np string or None.
 
@@ -37,7 +51,6 @@ def sync_np(store: KVStore, job: api.TpuJob) -> Optional[str]:
     np = str(worker["replicas"])
     key = np_key(job.namespace, job.name)
     if store.compare_and_put(key, np):
-        cur = store.get(epoch_key(job.namespace, job.name))
-        store.put(epoch_key(job.namespace, job.name), str(int(cur or "0") + 1))
+        bump_epoch(store, job)
         return np
     return None

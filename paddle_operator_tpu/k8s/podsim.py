@@ -60,6 +60,12 @@ class PodSimulator:
     def finish(self, pod_name: str, succeeded: bool = True) -> None:
         self._desired[pod_name] = "Succeeded" if succeeded else "Failed"
 
+    def clear(self, pod_name: str) -> None:
+        """Forget a `finish` request: a RECREATED pod with the same name is
+        driven back up instead of being re-killed — one `finish` + `clear`
+        models a single preemption event against a healthy replacement."""
+        self._desired.pop(pod_name, None)
+
     def finish_all(self, succeeded: bool = True) -> None:
         for pod in self._all("Pod"):
             self.finish(pod["metadata"]["name"], succeeded)

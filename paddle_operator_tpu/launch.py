@@ -33,6 +33,9 @@ class LaunchConfig:
     num_slices: int = 1            # multislice: DCN-connected slice count
     hostnames: List[str] = field(default_factory=list)
     role: str = "TRAINER"
+    # PS mode (operator env PADDLE_PSERVERS_IP_PORT_LIST): host:port of
+    # every parameter server; consumed by ps.run_ps_training
+    ps_endpoints: List[str] = field(default_factory=list)
     job_id: str = ""
     elastic_server: str = ""
     elastic_timeout: float = 60.0
@@ -118,6 +121,9 @@ def detect_env(environ: Optional[dict] = None) -> LaunchConfig:
             num_slices=num_slices,
             hostnames=hostnames,
             role=_env("TRAINING_ROLE", default="TRAINER"),
+            ps_endpoints=[
+                e for e in _env("PADDLE_PSERVERS_IP_PORT_LIST").split(",")
+                if e],
             job_id=_env("PADDLE_ELASTIC_JOB_ID", "TPUJOB_JOB_ID"),
             elastic_server=_env("TPUJOB_ELASTIC_SERVER", "PADDLE_ELASTIC_SERVER"),
             elastic_timeout=float(_env("PADDLE_ELASTIC_TIMEOUT", default="60")),

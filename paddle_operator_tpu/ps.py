@@ -1,0 +1,406 @@
+"""Parameter-server training mode (the reference's PS/gloo world, SURVEY
+§3.3), executed live against the operator-built env.
+
+The reference operator only *wires* PS mode: it renders
+``PADDLE_PSERVERS_IP_PORT_LIST`` / ``PADDLE_TRAINER_ENDPOINTS`` /
+``TRAINING_ROLE`` into pods and releases pservers before trainers
+(paddlejob_controller.go:308-330); the actual PS runtime lives in the user's
+Paddle binary. This framework ships the data plane too, so here is a
+TPU-era PS runtime matched to where PS still earns its keep — CTR models
+(wide&deep / deepfm) whose embedding tables live CPU-side while the dense
+math runs on the accelerator:
+
+* Each **pserver** owns a contiguous shard of the flattened fp32 parameter
+  vector plus its optimizer slot (momentum), behind a tiny HTTP protocol
+  (stdlib ``ThreadingHTTPServer`` — no extra deps, loopback or pod network
+  alike). Updates are **bulk-synchronous**: a shard update applies only
+  when every trainer's gradient for that version has arrived, then the
+  version advances and blocked pulls release. BSP keeps the math identical
+  to synchronous data-parallel SGD — same contract a `psum` gives the
+  collective mode — so a PS run is checkable against a single-process run.
+* Each **trainer** computes fwd+bwd with jax (synthetic or real batches),
+  pushes the gradient slice for every shard, then long-polls the next
+  version. Gradient transport is raw ``float32`` bytes (no pickle): the
+  tree structure is derived from ``init_params`` deterministically on every
+  node, so only the flat payload crosses the wire.
+
+Role dispatch mirrors the operator contract: ``TRAINING_ROLE=PSERVER``
+serves, ``TRAINING_ROLE=TRAINER`` trains — both through
+:func:`run_ps_training`, which reads the same :class:`launch.LaunchConfig`
+the collective path uses.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("tpujob.ps")
+
+
+# ---------------------------------------------------------------------------
+# flat-vector <-> param-tree plumbing (shared by trainers; servers never
+# need jax or the tree structure — they see only fp32 ranges)
+# ---------------------------------------------------------------------------
+
+def flatten_params(params) -> Tuple[np.ndarray, object, List]:
+    """Params tree -> (flat fp32 vector, treedef, leaf shapes)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [(l.shape, l.dtype) for l in leaves]
+    vec = np.concatenate(
+        [np.asarray(l, dtype=np.float32).ravel() for l in leaves])
+    return vec, treedef, shapes
+
+
+def unflatten_params(vec: np.ndarray, treedef, shapes):
+    import jax
+
+    leaves, off = [], 0
+    for shape, dtype in shapes:
+        n = int(np.prod(shape)) if shape else 1
+        leaves.append(vec[off:off + n].reshape(shape).astype(dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def shard_ranges(dim: int, n_shards: int) -> List[Tuple[int, int]]:
+    """Contiguous near-equal [start, stop) ranges covering [0, dim)."""
+    base, rem = divmod(dim, n_shards)
+    out, start = [], 0
+    for i in range(n_shards):
+        stop = start + base + (1 if i < rem else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pserver
+# ---------------------------------------------------------------------------
+
+class ParamServer:
+    """One BSP parameter-server shard over HTTP.
+
+    Protocol (all bodies raw little-endian fp32 unless noted):
+      GET  /meta                  -> JSON {version, dim, n_trainers}
+      POST /init                  -> body = this shard's initial values;
+                                     first caller wins (idempotent)
+      GET  /pull?after=N          -> long-poll until version > N, then
+                                     X-Version header + shard bytes
+      POST /push?worker=i&version=V -> gradient for version V; when all
+                                     n_trainers arrive: SGD update,
+                                     version += 1, pulls release
+      POST /done?worker=i         -> trainer i finished; when ALL trainers
+                                     have posted, the server stops serving
+                                     (so pserver pods exit and the job can
+                                     reach Completed)
+      POST /shutdown              -> stop serving unconditionally
+    """
+
+    def __init__(self, n_trainers: int, lr: float = 0.1,
+                 momentum: float = 0.9, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.n_trainers = n_trainers
+        self.lr, self.momentum = lr, momentum
+        self._vec: Optional[np.ndarray] = None
+        self._slot: Optional[np.ndarray] = None  # momentum buffer
+        self.version = 0
+        self._grads: Dict[int, np.ndarray] = {}
+        self._done: set = set()
+        self._cond = threading.Condition()
+        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return "%s:%d" % (h, p)
+
+    def start(self) -> "ParamServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        """Blocking entry for a dedicated pserver process/thread."""
+        self._httpd.serve_forever()
+
+    # -- update rule ------------------------------------------------------
+
+    def _apply(self) -> None:
+        # caller holds self._cond
+        grad = np.mean(list(self._grads.values()), axis=0)
+        if self._slot is None:
+            self._slot = np.zeros_like(self._vec)
+        self._slot = self.momentum * self._slot + grad
+        self._vec = self._vec - self.lr * self._slot
+        self._grads.clear()
+        self.version += 1
+        self._cond.notify_all()
+
+    def _handler(server_self):  # noqa: N805 — closure over the server
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code=200, body=b"", headers=()):
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                s = server_self
+                if self.path.startswith("/meta"):
+                    with s._cond:
+                        body = json.dumps({
+                            "version": s.version,
+                            "dim": -1 if s._vec is None else len(s._vec),
+                            "n_trainers": s.n_trainers,
+                        }).encode()
+                    self._send(200, body,
+                               [("Content-Type", "application/json")])
+                    return
+                if self.path.startswith("/pull"):
+                    after = -1
+                    if "after=" in self.path:
+                        after = int(self.path.split("after=")[1].split("&")[0])
+                    with s._cond:
+                        ok = s._cond.wait_for(
+                            lambda: s._vec is not None and s.version > after,
+                            timeout=30.0)
+                        if not ok:
+                            self._send(408)
+                            return
+                        body = s._vec.tobytes()
+                        ver = s.version
+                    self._send(200, body, [("X-Version", str(ver))])
+                    return
+                self._send(404)
+
+            def do_POST(self):
+                s = server_self
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if self.path.startswith("/init"):
+                    vec = np.frombuffer(body, dtype=np.float32).copy()
+                    with s._cond:
+                        if s._vec is None:
+                            s._vec = vec
+                            s.version = 1
+                            s._cond.notify_all()
+                    self._send(200)
+                    return
+                if self.path.startswith("/push"):
+                    q = dict(kv.split("=") for kv in
+                             self.path.split("?", 1)[1].split("&"))
+                    worker, ver = int(q["worker"]), int(q["version"])
+                    grad = np.frombuffer(body, dtype=np.float32)
+                    with s._cond:
+                        if ver != s.version:
+                            # stale push (BSP: only current-version grads
+                            # count); trainer re-pulls and recomputes
+                            self._send(409)
+                            return
+                        s._grads[worker] = grad
+                        if len(s._grads) >= s.n_trainers:
+                            s._apply()
+                    self._send(200)
+                    return
+                if self.path.startswith("/done"):
+                    q = dict(kv.split("=") for kv in
+                             self.path.split("?", 1)[1].split("&"))
+                    self._send(200)
+                    with s._cond:
+                        s._done.add(int(q["worker"]))
+                        all_done = len(s._done) >= s.n_trainers
+                    if all_done:
+                        threading.Thread(target=s._httpd.shutdown,
+                                         daemon=True).start()
+                    return
+                if self.path.startswith("/shutdown"):
+                    self._send(200)
+                    threading.Thread(target=s._httpd.shutdown,
+                                     daemon=True).start()
+                    return
+                self._send(404)
+
+        return Handler
+
+
+# ---------------------------------------------------------------------------
+# trainer-side client
+# ---------------------------------------------------------------------------
+
+class PsClient:
+    """Trainer's view of the sharded server fleet."""
+
+    def __init__(self, endpoints: List[str], worker_id: int):
+        self.urls = ["http://%s" % e for e in endpoints]
+        self.worker_id = worker_id
+        self.ranges: Optional[List[Tuple[int, int]]] = None
+
+    def _req(self, url, data=None, timeout=35.0):
+        req = urllib.request.Request(url, data=data, method=(
+            "POST" if data is not None else "GET"))
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def init(self, vec: np.ndarray) -> None:
+        self.ranges = shard_ranges(len(vec), len(self.urls))
+        for url, (a, b) in zip(self.urls, self.ranges):
+            self._req(url + "/init", vec[a:b].tobytes())
+
+    def pull(self, after: int,
+             deadline_s: float = 600.0) -> Tuple[np.ndarray, int]:
+        """Long-poll every shard for version > after. A server-side 408 is
+        just the 30 s poll window expiring (e.g. a straggler trainer still
+        computing its gradient) — re-arm and keep waiting; only the
+        overall deadline turns into an error."""
+        t0 = time.monotonic()
+        parts, version = [], None
+        for url in self.urls:
+            while True:
+                status, body, headers = self._req(
+                    "%s/pull?after=%d" % (url, after))
+                if status == 200:
+                    break
+                if status != 408 or time.monotonic() - t0 > deadline_s:
+                    raise TimeoutError(
+                        "pull from %s: HTTP %s after %.0fs"
+                        % (url, status, time.monotonic() - t0))
+            parts.append(np.frombuffer(body, dtype=np.float32))
+            v = int(headers.get("X-Version", "0"))
+            version = v if version is None else min(version, v)
+        return np.concatenate(parts), version
+
+    def push(self, grad_vec: np.ndarray, version: int) -> bool:
+        """True if every shard accepted; False on a stale-version 409."""
+        ok = True
+        for url, (a, b) in zip(self.urls, self.ranges):
+            status, _, _ = self._req(
+                "%s/push?worker=%d&version=%d"
+                % (url, self.worker_id, version), grad_vec[a:b].tobytes())
+            if status == 409:
+                ok = False  # stale round: caller re-pulls and recomputes
+            elif status != 200:
+                raise RuntimeError("push to %s: HTTP %s" % (url, status))
+        return ok
+
+    def done(self) -> None:
+        """Tell every shard this trainer finished; servers stop once ALL
+        trainers have — the shutdown path that lets pserver pods exit so
+        the job reaches Completed."""
+        for url in self.urls:
+            try:
+                self._req("%s/done?worker=%d" % (url, self.worker_id), b"")
+            except Exception:
+                pass
+
+    def shutdown_servers(self) -> None:
+        for url in self.urls:
+            try:
+                self._req(url + "/shutdown", b"")
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# role dispatch — the launch.py surface
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PsTrainJob:
+    init_params: Callable
+    loss_fn: Callable          # (params, batch) -> (loss, metrics)
+    make_batch: Callable       # (rng, step) -> batch
+    total_steps: int = 10
+    lr: float = 0.1
+    momentum: float = 0.9
+    seed: int = 0
+
+
+def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
+                    server: Optional[ParamServer] = None) -> dict:
+    """Entry for BOTH roles, driven by the operator env via
+    ``launch.detect_env()`` (cfg.role, cfg.ps_endpoints, cfg.worker_id,
+    cfg.num_workers — exactly the names helper.construct_configmap and
+    the per-pod env render).
+
+    PSERVER: serve this host's shard until every trainer posts /done
+    (or something posts /shutdown), then exit so the pod completes.
+    TRAINER: init (the deterministic init is identical on every node;
+    first /init wins), then pull -> grad -> push for ``total_steps`` BSP
+    rounds, then post /done.
+    """
+    if cfg.role == "PSERVER":
+        if server is None:
+            # bind the port this pserver advertises in the env
+            my = cfg.ps_endpoints[cfg.worker_id]
+            host, _, port = my.partition(":")
+            server = ParamServer(
+                n_trainers=cfg.num_workers, lr=job.lr,
+                momentum=job.momentum,
+                host=bind_host or host, port=int(port))
+        server.serve_forever()
+        return {"role": "PSERVER"}
+
+    import jax
+
+    params = job.init_params(jax.random.PRNGKey(job.seed))
+    vec0, treedef, shapes = flatten_params(params)
+    client = PsClient(cfg.ps_endpoints, cfg.worker_id)
+    client.init(vec0)
+
+    # one jitted evaluation per step: loss and gradient from the same
+    # forward pass
+    vg_fn = jax.jit(jax.value_and_grad(lambda p, b: job.loss_fn(p, b)[0]))
+
+    rng = jax.random.PRNGKey(1000 + cfg.worker_id)
+    losses = []
+    # one full-vector pull per BSP round: the end-of-round barrier pull
+    # doubles as the next round's parameter fetch (the vector transfer is
+    # the dominant PS-mode cost for CTR models)
+    vec, version = client.pull(after=0)
+    for step in range(job.total_steps):
+        params = unflatten_params(vec, treedef, shapes)
+        batch = job.make_batch(jax.random.fold_in(rng, step), step)
+        loss, grads = vg_fn(params, batch)
+        losses.append(float(loss))
+        gvec, _, _ = flatten_params(grads)
+        while not client.push(gvec, version):
+            # stale: another BSP round completed while we computed —
+            # re-pull and recompute on fresh params
+            vec, version = client.pull(after=version)
+            params = unflatten_params(vec, treedef, shapes)
+            _, grads = vg_fn(params, batch)
+            gvec, _, _ = flatten_params(grads)
+        # barrier: our round applied; this pull is also next round's fetch
+        vec, version = client.pull(after=version)
+    client.done()  # all trainers done -> servers stop -> pods Complete
+    final = unflatten_params(vec, treedef, shapes)
+    return {"role": "TRAINER", "losses": losses, "params": final,
+            "version": version}

@@ -1,0 +1,99 @@
+"""Flash-attention block-size sweep at long context (round-4 verdict
+item 5): S=8k sustains 34 TFLOP/s (~0.26 of ceiling) with the auto block
+of 512 — find the knee, or beat it.
+
+Sweeps block_q x block_k over {128..1024}^2 (square and rectangular) for
+causal fwd+bwd at S=4k and S=8k, host-readback-synced, one JSON line per
+config. Failures (VMEM overflow, lowering errors) are recorded, not
+fatal — the sweep's job is to map the space.
+
+Usage: python scripts/perf_attention.py [seq[,seq...]]   (default 4096,8192)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.ops import attention_pallas
+
+ITERS = int(os.environ.get("PERF_ATTN_ITERS", "8"))
+
+
+def log(msg):
+    print("perf: " + msg, file=sys.stderr, flush=True)
+
+
+def emit(**kv):
+    print(json.dumps(kv), flush=True)
+
+
+def bench_config(b, h, s, d, block_q, block_k, interpret=False):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks)
+
+    def loss(q, k, v):
+        o = attention_pallas.flash_attention(
+            q, k, v, causal=True, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+        return o.astype(jnp.float32).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def run(q, k, v):
+        def body(_, carry):
+            qq, kk, vv = carry
+            dq, dk, dv = g(qq, kk, vv)
+            eps = jnp.asarray(1e-6, qq.dtype)
+            return (qq + eps * dq, kk + eps * dk, vv + eps * dv)
+        qq, kk, vv = jax.lax.fori_loop(0, ITERS, body, (q, k, v))
+        return (qq.astype(jnp.float32).sum()
+                + kk.astype(jnp.float32).sum()
+                + vv.astype(jnp.float32).sum())
+
+    float(run(q, k, v))  # compile + first execution
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(run(q, k, v))
+        dt = (time.perf_counter() - t0) / ITERS
+        best = dt if best is None else min(best, dt)
+    # causal fwd matmul FLOPs ~ 2*2*b*h*s^2*d / 2; bwd ~2.5x fwd
+    flops = 3.5 * 2.0 * b * h * s * s * d
+    return best, flops / best / 1e12
+
+
+def main():
+    seqs = ([int(x) for x in sys.argv[1].split(",")] if len(sys.argv) > 1
+            else [4096, 8192])
+    interpret = jax.default_backend() != "tpu"
+    log("backend=%s interpret=%s" % (jax.default_backend(), interpret))
+    emit(stage="meta", backend=jax.default_backend())
+    blocks = [128, 256, 512, 768, 1024]
+    for s in seqs:
+        b, h, d = (2, 8, 128) if s <= 4096 else (1, 8, 128)
+        for bq in blocks:
+            for bk in blocks:
+                if s % bq or s % bk:
+                    continue
+                try:
+                    dt, tflops = bench_config(b, h, s, d, bq, bk,
+                                              interpret)
+                    emit(seq=s, block_q=bq, block_k=bk,
+                         ms=round(dt * 1e3, 3), tflops=round(tflops, 1))
+                    log("S=%d bq=%d bk=%d: %.1f TF/s" % (s, bq, bk, tflops))
+                except Exception as e:
+                    emit(seq=s, block_q=bq, block_k=bk,
+                         error=repr(e)[:160])
+                    log("S=%d bq=%d bk=%d: FAILED %r" % (s, bq, bk, e))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,7 @@ InformerCache (watch-fed, rv resume) <- threaded Manager + reconciler +
 CoordinationServer, with PodSimulator playing kubelet over the same HTTP
 client. No FakeKubeClient anywhere."""
 
+import contextlib
 import threading
 import time
 
@@ -22,8 +23,8 @@ from paddle_operator_tpu.k8s.podsim import PodSimulator
 from paddle_operator_tpu.k8s.runtime import Manager
 
 
-@pytest.fixture()
-def stack():
+@contextlib.contextmanager
+def _stack(scheduling="", kv_store=None):
     srv = StubApiServer().start()
     srv.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
 
@@ -31,7 +32,7 @@ def stack():
     client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
 
     cache = InformerCache(client, resync_period=30.0)
-    kinds = cached_kinds(api.KIND)
+    kinds = cached_kinds(api.KIND, scheduling)
     for kind in kinds:
         cache.informer(kind)
     cached = CachedKubeClient(client, cache)
@@ -40,9 +41,9 @@ def stack():
 
     coord = CoordinationServer(cached, ":0").start()
     reconciler = TpuJobReconciler(
-        cached, init_image="busybox",
+        cached, init_image="busybox", scheduling=scheduling,
         port_allocator=PortRangeAllocator(35000, 36000),
-        coordination_url=coord.url,
+        coordination_url=coord.url, kv_store=kv_store,
     )
     mgr = Manager(cached, cache=cache)
     mgr.add_controller(
@@ -70,16 +71,24 @@ def stack():
     kt = threading.Thread(target=kubelet, daemon=True)
     kt.start()
     mgr.start()
-    yield srv, client, sim
-    stop.set()
-    mgr.stop()
-    cache.stop()
-    coord.stop()
-    kt.join(timeout=5)
-    srv.stop()
+    try:
+        yield srv, client, sim
+    finally:
+        stop.set()
+        mgr.stop()
+        cache.stop()
+        coord.stop()
+        kt.join(timeout=5)
+        srv.stop()
     # transient rv conflicts are tolerated inside the sim; anything that
     # escaped to here is a real kubelet-loop bug the test must surface
     assert not kubelet_errors, "kubelet loop errors: %s" % kubelet_errors[-3:]
+
+
+@pytest.fixture()
+def stack():
+    with _stack() as parts:
+        yield parts
 
 
 def _wait_phase(client, name, phase, timeout=30.0):
@@ -131,6 +140,128 @@ def test_scale_down_and_completion_over_real_http(stack):
 
     sim.finish_all(succeeded=True)
     _wait_phase(client, "scale", "Completed")
+
+
+def test_preemption_whole_slice_restart_over_real_http(tmp_path):
+    """Round-4 verdict item 7 — the full preemption-vs-elasticity story
+    (SURVEY §7) across the production stack: a gang TPU elastic job is
+    Running over real HTTP; podsim (kubelet) reports a host Failed; the
+    reconciler flows the job through Restarting, deletes/recreates the pod
+    and bumps the membership epoch; a REAL training run (ElasticAgent
+    polling the same membership server the operator writes) ends its cycle
+    and resumes from checkpoint with state continuity; the job returns to
+    Running."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_operator_tpu.elastic.server import MembershipServer
+    from paddle_operator_tpu.elastic.store import connect as kv_connect
+    from paddle_operator_tpu.elastic.sync import epoch_key, np_key
+    from paddle_operator_tpu.launch import LaunchConfig
+    from paddle_operator_tpu.models import gpt
+    from paddle_operator_tpu.ops import optim
+    from paddle_operator_tpu.runner import TrainJob, run_training
+    from paddle_operator_tpu.utils.checkpoint import (
+        latest_step, restore_checkpoint)
+
+    result = {}
+    with MembershipServer() as server:
+        store = kv_connect(server.endpoint)
+        with _stack(scheduling="volcano", kv_store=store) as (
+                srv, client, sim):
+            spec = {
+                "device": "tpu", "elastic": 1,
+                "tpu": {"accelerator": "v5e", "topology": "2x4",
+                        "chipsPerHost": 4},
+                "worker": {"replicas": 2, "template": {"spec": {
+                    "containers": [{"name": "w", "image": "x"}]}}},
+            }
+            client.create(api.new_tpujob("drill", spec=spec))
+            _wait_phase(client, "drill", "Running")
+            # gang: the PodGroup admitted the whole slice
+            assert client.get("PodGroup", "default", "drill")
+            # the operator published the initial membership over HTTP
+            assert store.get(np_key("default", "drill")) == "2"
+            epoch0 = int(store.get(epoch_key("default", "drill")))
+
+            # data plane: a real elastic training run against the SAME
+            # membership server the operator writes
+            reached = threading.Event()
+
+            def make_batch(rng, step):
+                if step >= 3:
+                    reached.set()
+                    time.sleep(0.05)  # hold the cycle open for the drill
+                return gpt.synthetic_batch(rng, 4, 16, 1024)
+
+            job = TrainJob(
+                init_params=lambda rng: gpt.init(rng, gpt.TINY_CONFIG),
+                loss_fn=gpt.loss_fn,
+                optimizer=optim.adamw(1e-3),
+                make_batch=make_batch,
+                mesh_axes=lambda world: {"dp": world},
+                sharded_checkpoint=True,
+                total_steps=40, checkpoint_every=2,
+                checkpoint_dir=str(tmp_path), log_every=0,
+            )
+            cfg = LaunchConfig(
+                worker_id=0, num_workers=2,
+                elastic_server=server.endpoint, job_id="default-drill")
+
+            def train():
+                result.update(run_training(
+                    job, cfg=cfg, init_distributed=False,
+                    poll_interval=0.0))
+
+            tt = threading.Thread(target=train, daemon=True)
+            tt.start()
+            assert reached.wait(120), "training never reached step 3"
+
+            # preemption: the kubelet reports worker-1 Failed
+            sim.finish("drill-worker-1", succeeded=False)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if int(store.get(epoch_key("default", "drill")) or 0) > epoch0:
+                    break
+                time.sleep(0.02)
+            # exactly one whole-slice restart signal
+            assert int(store.get(epoch_key("default", "drill"))) == epoch0 + 1
+            sim.clear("drill-worker-1")  # the replacement host is healthy
+            _wait_phase(client, "drill", "Running")
+
+            # the event trail names the preemption
+            events = [e for e in client.list("Event", "default")
+                      if e.get("reason") == "PreemptionRestart"]
+            assert events, "no PreemptionRestart event recorded"
+
+            tt.join(timeout=300)
+            assert not tt.is_alive(), "training did not finish"
+
+    # the run was interrupted exactly once and RESUMED, not restarted
+    assert result["cycles"] == 2
+    assert result["steps"] == 40
+    assert jnp.isfinite(jnp.asarray(result["loss"]))
+    assert latest_step(str(tmp_path)) is not None
+
+    # state continuity: final params continue from the interrupt checkpoint
+    # (small relative distance), not a re-init (~sqrt(2) away)
+    steps_present = sorted(
+        int(p.name[len("step_"):]) for p in tmp_path.iterdir()
+        if p.name.startswith("step_"))
+    ckpt_state, _ = restore_checkpoint(str(tmp_path),
+                                       step=steps_present[0])
+    final_params = jax.device_get(result["state"])["params"]
+
+    def flat(t):
+        return jnp.concatenate([
+            jnp.ravel(x).astype(jnp.float32)
+            for x in jax.tree_util.tree_leaves(t)])
+
+    rel = float(jnp.linalg.norm(flat(final_params) - flat(ckpt_state["params"]))
+                / jnp.linalg.norm(flat(ckpt_state["params"])))
+    assert 0.0 < rel < 0.5, (
+        "cycle-2 state is not a continuation of the checkpoint "
+        "(relative param distance %.4f)" % rel)
 
 
 def test_leader_election_over_real_http():

@@ -1,0 +1,160 @@
+"""PS mode executed LIVE (round-4 verdict item 8): the operator builds the
+PS env for a wide&deep job (BASELINE config #1), and that same env drives
+2 pservers + 2 trainers in-process through launch.detect_env ->
+ps.run_ps_training, training real steps with decreasing loss.
+
+The reference only ever wires this env (the PS runtime lives in the user's
+paddle binary); here the data plane is part of the framework, so the wire
+contract is exercised end-to-end: env names, role dispatch, ps-host shard
+serving, BSP rounds.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_operator_tpu import launch, ps
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.models import wide_deep
+from paddle_operator_tpu.testing import OperatorHarness
+
+TINY = dict(num_slots=8, vocab_per_slot=100, embed_dim=8,
+            dense_dim=13, hidden=[32, 32])
+
+
+def _role_spec(replicas):
+    return {"replicas": replicas, "template": {"spec": {
+        "containers": [{"name": "c", "image": "x"}]}}}
+
+
+def test_ps_mode_trains_live_from_operator_env():
+    # --- control plane: the operator renders the PS world ----------------
+    h = OperatorHarness(http_coordination=True)
+    h.create_job(api.new_tpujob("wd", spec={
+        "ps": _role_spec(2), "worker": _role_spec(2)}))
+    h.converge()
+    job = h.get_job("wd")
+    assert job.phase == api.Phase.RUNNING
+    assert job.status["mode"] == "PS"
+    cm = h.client.get("ConfigMap", "default", "wd")["data"]
+    ps_eps = cm["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")
+    assert len(ps_eps) == 2
+    assert cm["PADDLE_TRAINERS_NUM"] == "2"
+    h.close()
+
+    # --- data plane: run that world in-process ---------------------------
+    # The rendered endpoints are pod IPs (unroutable on the test host):
+    # bind servers on loopback ephemeral ports and rewrite ONLY the
+    # host:port strings — every env NAME and the role dispatch stay
+    # exactly as the operator rendered them.
+    servers = [
+        ps.ParamServer(n_trainers=2, lr=0.1, momentum=0.9).start()
+        for _ in ps_eps
+    ]
+    endpoints = ",".join(s.endpoint for s in servers)
+
+    job_spec = ps.PsTrainJob(
+        init_params=lambda rng: wide_deep.init(rng, TINY),
+        loss_fn=wide_deep.loss_fn,
+        make_batch=lambda rng, step: wide_deep.synthetic_batch(
+            rng, 64, TINY),
+        total_steps=6, lr=0.1, momentum=0.9,
+    )
+
+    def trainer_env(idx):
+        env = dict(cm)
+        env["PADDLE_PSERVERS_IP_PORT_LIST"] = endpoints
+        env["TRAINING_ROLE"] = "TRAINER"
+        env["PADDLE_TRAINER_ID"] = str(idx)
+        return env
+
+    results = {}
+    errors = []
+
+    # detect_env swaps os.environ globally while parsing — build both
+    # configs in the MAIN thread (concurrent calls would race the swap)
+    cfgs = {}
+    for idx in (0, 1):
+        cfg = launch.detect_env(trainer_env(idx))
+        assert cfg.role == "TRAINER"
+        assert cfg.num_workers == 2
+        assert len(cfg.ps_endpoints) == 2
+        cfgs[idx] = cfg
+
+    def trainer(idx):
+        try:
+            results[idx] = ps.run_ps_training(job_spec, cfgs[idx])
+        except Exception as e:  # surface in the main thread
+            errors.append((idx, repr(e)))
+
+    threads = [threading.Thread(target=trainer, args=(i,)) for i in (0, 1)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads), "trainers hung"
+        assert not errors, errors
+        # done-protocol: once every trainer posted /done the servers shut
+        # themselves down — the path that lets pserver pods exit so the
+        # job reaches Completed
+        deadline = 10
+        import time as _time
+        for s in servers:
+            for _ in range(deadline * 10):
+                if not s._thread.is_alive():
+                    break
+                _time.sleep(0.1)
+            assert not s._thread.is_alive(), "pserver kept serving"
+    finally:
+        for s in servers:
+            s.stop()
+
+    # BSP: both trainers finished the same number of rounds on identical
+    # final params (the defining property vs async PS)
+    assert set(results) == {0, 1}
+    p0, _, _ = ps.flatten_params(results[0]["params"])
+    p1, _, _ = ps.flatten_params(results[1]["params"])
+    np.testing.assert_array_equal(p0, p1)
+
+    # the model actually learned: mean loss over the last rounds improved
+    # vs the first round (6 SGD steps on a learnable synthetic objective)
+    for r in results.values():
+        losses = r["losses"]
+        assert len(losses) == 6
+        assert all(np.isfinite(losses))
+    mean_first = np.mean([results[i]["losses"][0] for i in (0, 1)])
+    mean_last = np.mean([results[i]["losses"][-1] for i in (0, 1)])
+    assert mean_last < mean_first, (mean_first, mean_last)
+
+
+def test_ps_server_role_dispatch_binds_advertised_port():
+    """PSERVER role through the same entry: cfg.worker_id selects this
+    host's endpoint from PADDLE_PSERVERS_IP_PORT_LIST and serves it."""
+    import urllib.request
+
+    srv = ps.ParamServer(n_trainers=1)  # bound at construction, not serving
+    cfg = launch.LaunchConfig(worker_id=0, num_workers=1, role="PSERVER",
+                              ps_endpoints=["127.0.0.1:0"])
+    t = threading.Thread(
+        target=ps.run_ps_training,
+        args=(ps.PsTrainJob(init_params=None, loss_fn=None,
+                            make_batch=None),
+              cfg),
+        kwargs={"server": srv},  # run_ps_training owns the serve loop
+        daemon=True)
+    t.start()
+    with urllib.request.urlopen(
+            "http://%s/meta" % srv.endpoint, timeout=5) as resp:
+        meta = resp.read()
+    assert b"n_trainers" in meta
+    srv.stop()
+
+
+def test_shard_ranges_cover_and_partition():
+    for dim, n in [(10, 3), (7, 2), (5, 5), (1, 1), (100, 7)]:
+        ranges = ps.shard_ranges(dim, n)
+        assert ranges[0][0] == 0 and ranges[-1][1] == dim
+        for (a, b), (c, d) in zip(ranges, ranges[1:]):
+            assert b == c and b >= a and d >= c

@@ -82,6 +82,65 @@ def test_preempted_pod_fails_nonelastic_job():
     assert h.get_job("pre").phase == api.Phase.FAILED
 
 
+def test_preempted_failed_pod_elastic_bumps_epoch_and_restarts():
+    """Round-4 (verdict item 7 machinery): kubelet-reported pod failure on
+    an elastic job -> phase Restarting (never the sticky Failed), failed
+    pod deleted + recreated, membership epoch bumped so surviving workers
+    restart the whole slice from checkpoint."""
+    from paddle_operator_tpu.elastic.sync import epoch_key
+
+    h = OperatorHarness()
+    h.create_job(api.new_tpujob("prf", spec={
+        "device": "tpu", "elastic": 1,
+        "tpu": {"accelerator": "v5e", "topology": "2x4", "chipsPerHost": 4},
+        "worker": role_spec(2),
+    }))
+    h.converge()
+    assert h.get_job("prf").phase == api.Phase.RUNNING
+    epoch0 = int(h.kv.get(epoch_key("default", "prf")) or "0")
+
+    h.sim.finish("prf-worker-1", succeeded=False)
+    h.sim.step()                      # kubelet reports the failure
+    h.reconciler.reconcile("default", "prf")  # one pass: observe + react
+    job = h.get_job("prf")
+    assert job.phase == api.Phase.RESTARTING
+    assert int(h.kv.get(epoch_key("default", "prf"))) == epoch0 + 1
+
+    h.sim.clear("prf-worker-1")       # the replacement host is healthy
+    h.converge()
+    job = h.get_job("prf")
+    assert job.phase == api.Phase.RUNNING
+    assert {p["metadata"]["name"] for p in h.pods()} == {
+        "prf-worker-0", "prf-worker-1"}
+    # one preemption = exactly one whole-slice restart signal
+    assert int(h.kv.get(epoch_key("default", "prf"))) == epoch0 + 1
+
+
+def test_elastic_preemption_budget_exhaustion_fails_terminally():
+    """A deterministically-crashing container must not restart the slice
+    forever: past the (annotation-tunable) restart budget the job goes
+    terminally Failed instead of Restarting."""
+    h = OperatorHarness()
+    job = api.new_tpujob("crashy", spec={
+        "device": "tpu", "elastic": 1, "cleanPodPolicy": "Never",
+        "tpu": {"accelerator": "v5e", "topology": "2x4", "chipsPerHost": 4},
+        "worker": role_spec(2),
+    })
+    job["metadata"].setdefault("annotations", {})[
+        helper.ANNOT_MAX_RESTARTS] = "2"
+    h.create_job(job)
+    h.converge()
+    assert h.get_job("crashy").phase == api.Phase.RUNNING
+
+    # podsim keeps re-killing the recreated pod (desired phase persists):
+    # the crash loop the budget exists for
+    h.sim.finish("crashy-worker-1", succeeded=False)
+    h.converge(max_ticks=200)
+    job = h.get_job("crashy")
+    assert job.phase == api.Phase.FAILED
+    assert int(job.status["preemptionRestarts"]) == 2
+
+
 def test_preempted_pod_recreated_for_elastic_job():
     h = OperatorHarness()
     h.create_job(api.new_tpujob("pree", spec={
