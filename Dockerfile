@@ -13,7 +13,7 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
     && make -C native \
     && apt-get purge -y g++ && apt-get autoremove -y \
     && rm -rf /var/lib/apt/lists/* \
-    && pip install --no-cache-dir numpy pyyaml
+    && pip install --no-cache-dir numpy pyyaml cryptography
 
 ENV PYTHONPATH=/opt/tpujob
 USER 65532:65532

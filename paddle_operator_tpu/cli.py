@@ -113,11 +113,17 @@ def run(client, args) -> int:
         for doc in docs:
             doc.setdefault("metadata", {}).setdefault("namespace",
                                                       args.namespace)
-            # semantic checks + structural schema (what CRD admission will
-            # enforce server-side — catch typo'd pod templates pre-submit)
+            # structural schema FIRST (the semantic validator assumes
+            # shape-valid input and can raise on e.g. replicas: null),
+            # then semantic checks — same order as the admission webhook
             from .api.crd import validate_tpujob
 
-            errs = api.TpuJob(doc).validate() + validate_tpujob(doc)
+            errs = validate_tpujob(doc)
+            if not errs:
+                try:
+                    errs = api.TpuJob(doc).validate()
+                except Exception as e:
+                    errs = ["semantic validation failed: %r" % (e,)]
             if errs:
                 print("invalid %s: %s" % (doc["metadata"].get("name"),
                                           "; ".join(errs)), file=sys.stderr)

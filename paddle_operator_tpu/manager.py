@@ -54,6 +54,13 @@ def main(argv=None):
                     help="base URL pods use to reach the coordination "
                          "endpoint; default derives from "
                          "$COORD_SERVICE_NAME.$POD_NAMESPACE.svc")
+    ap.add_argument("--webhook-bind-address", default="",
+                    help="bind for the validating admission webhook "
+                         "('' disables; e.g. ':9443')")
+    ap.add_argument("--webhook-cert-dir", default="",
+                    help="dir holding tls.crt/tls.key (cert-manager "
+                         "mounted secret); empty = self-signed (local "
+                         "runs only — the apiserver won't trust it)")
     ap.add_argument("--kube-api", default=None, help="apiserver URL override")
     ap.add_argument("--insecure-skip-tls-verify", action="store_true")
     args = ap.parse_args(argv)
@@ -104,6 +111,48 @@ def main(argv=None):
             ns = os.environ.get("POD_NAMESPACE", "tpujob-system")
             port = os.environ.get("COORD_SERVICE_PORT", "8082")
             coord_url = "http://%s.%s.svc:%s" % (svc, ns, port)
+
+    webhook_srv = None
+    if args.webhook_bind_address:
+        import atexit
+        import shutil
+        import tempfile
+
+        from .controllers.webhook import (
+            AdmissionWebhookServer, self_signed_cert)
+
+        cert = os.path.join(args.webhook_cert_dir, "tls.crt")
+        key = os.path.join(args.webhook_cert_dir, "tls.key")
+        # both halves or neither: a mid-rotation secret with only tls.crt
+        # must fall back, not crash load_cert_chain
+        have_certs = (args.webhook_cert_dir and os.path.exists(cert)
+                      and os.path.exists(key))
+        if not have_certs:
+            try:
+                cert_pem, key_pem = self_signed_cert()
+            except ImportError as e:
+                # degrade loudly instead of CrashLoopBackOff: the rest of
+                # the operator is healthy, only the webhook is not
+                log.error("webhook DISABLED: no usable cert pair in %r "
+                          "and self-signed generation unavailable (%s)",
+                          args.webhook_cert_dir, e)
+                cert = None
+            else:
+                log.warning("webhook: no cert pair, generating "
+                            "self-signed (the apiserver will NOT trust "
+                            "this — use cert-manager in production)")
+                d = tempfile.mkdtemp(prefix="tpujob-webhook-")
+                atexit.register(shutil.rmtree, d, ignore_errors=True)
+                cert = os.path.join(d, "tls.crt")
+                key = os.path.join(d, "tls.key")
+                with open(cert, "wb") as f:
+                    f.write(cert_pem)
+                with open(key, "wb") as f:
+                    f.write(key_pem)
+        if cert:
+            webhook_srv = AdmissionWebhookServer(
+                args.webhook_bind_address, cert_file=cert, key_file=key)
+            webhook_srv.start()
 
     reconciler = TpuJobReconciler(
         cached_client,
@@ -190,6 +239,8 @@ def main(argv=None):
     mgr.stop()  # releases the lease so a successor takes over immediately
     if coord_srv is not None:
         coord_srv.stop()
+    if webhook_srv is not None:
+        webhook_srv.stop()
     return exit_code[0]
 
 

@@ -167,6 +167,77 @@ def operator_manifests(namespace=NAMESPACE, image=IMAGE, jobnamespace=""):
             leader_binding, coord_service, deployment]
 
 
+def webhook_manifests(namespace=NAMESPACE):
+    """Optional validating-webhook overlay (deploy/webhook/): the
+    apiserver rejects invalid TpuJobs at admission with the typed-schema
+    + semantic error list (controllers/webhook.py). The reference carries
+    cert-manager scaffolding but no webhook (config/certmanager/ there is
+    unused); here the scaffolding provisions a real endpoint."""
+    svc_name = "tpujob-operator-webhook"
+    cert_name = "tpujob-webhook-cert"
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": svc_name, "namespace": namespace,
+                     "labels": {"control-plane": "tpujob-operator"}},
+        "spec": {
+            "selector": {"control-plane": "tpujob-operator"},
+            "ports": [{"name": "webhook", "port": 443,
+                       "targetPort": 9443}],
+        },
+    }
+    issuer = {
+        "apiVersion": "cert-manager.io/v1",
+        "kind": "Issuer",
+        "metadata": {"name": "tpujob-selfsigned-issuer",
+                     "namespace": namespace},
+        "spec": {"selfSigned": {}},
+    }
+    certificate = {
+        "apiVersion": "cert-manager.io/v1",
+        "kind": "Certificate",
+        "metadata": {"name": cert_name, "namespace": namespace},
+        "spec": {
+            "dnsNames": [
+                "%s.%s.svc" % (svc_name, namespace),
+                "%s.%s.svc.cluster.local" % (svc_name, namespace),
+            ],
+            "issuerRef": {"kind": "Issuer",
+                          "name": "tpujob-selfsigned-issuer"},
+            "secretName": cert_name,
+        },
+    }
+    webhook_config = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {
+            "name": "tpujob-validating-webhook",
+            # cert-manager injects the CA bundle from the Certificate
+            "annotations": {"cert-manager.io/inject-ca-from":
+                            "%s/%s" % (namespace, cert_name)},
+        },
+        "webhooks": [{
+            "name": "vtpujob.%s" % api.GROUP,
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            # Fail is safe: this webhook gates only the CRD this operator
+            # owns, so an outage can't block unrelated workloads
+            "failurePolicy": "Fail",
+            "clientConfig": {
+                "service": {"name": svc_name, "namespace": namespace,
+                            "path": "/validate-tpujob", "port": 443},
+            },
+            "rules": [{
+                "apiGroups": [api.GROUP],
+                "apiVersions": [api.VERSION],
+                "operations": ["CREATE", "UPDATE"],
+                "resources": [api.PLURAL],
+            }],
+        }],
+    }
+    return [service, issuer, certificate, webhook_config]
+
+
 def dump_all(objs):
     return "---\n".join(yaml.safe_dump(o, sort_keys=False, width=100) for o in objs)
 
@@ -178,6 +249,37 @@ def main():
         f.write(yaml.safe_dump(crd_manifest(), sort_keys=False, width=100))
     with open(os.path.join(v1, "operator.yaml"), "w") as f:
         f.write(dump_all(operator_manifests()))
+    webhook_dir = os.path.join(ROOT, "deploy", "webhook")
+    os.makedirs(webhook_dir, exist_ok=True)
+    with open(os.path.join(webhook_dir, "webhook.yaml"), "w") as f:
+        f.write("# Optional: validating admission webhook (requires "
+                "cert-manager).\n# Also add to the operator Deployment "
+                "args: --webhook-bind-address=:9443\n#   "
+                "--webhook-cert-dir=/tmp/k8s-webhook-server/"
+                "serving-certs\n# and mount the %s secret there "
+                "(see docs/design.md).\n---\n" % "tpujob-webhook-cert")
+        f.write(dump_all(webhook_manifests()))
+
+    # kustomize pieces (reference layout: config/webhook + the
+    # certmanager scaffold — unused there, provisioning a real endpoint
+    # here), single-sourced from the same objects as deploy/webhook/
+    svc, issuer, certificate, whconf = webhook_manifests()
+    cfg_webhook = os.path.join(ROOT, "config", "webhook")
+    os.makedirs(cfg_webhook, exist_ok=True)
+    with open(os.path.join(cfg_webhook, "service.yaml"), "w") as f:
+        f.write(yaml.safe_dump(svc, sort_keys=False, width=100))
+    with open(os.path.join(cfg_webhook, "manifests.yaml"), "w") as f:
+        f.write(yaml.safe_dump(whconf, sort_keys=False, width=100))
+    with open(os.path.join(cfg_webhook, "kustomization.yaml"), "w") as f:
+        yaml.safe_dump({"resources": ["manifests.yaml", "service.yaml"]},
+                       f, sort_keys=False)
+    cfg_cm = os.path.join(ROOT, "config", "certmanager")
+    os.makedirs(cfg_cm, exist_ok=True)
+    with open(os.path.join(cfg_cm, "certificate.yaml"), "w") as f:
+        f.write(dump_all([issuer, certificate]))
+    with open(os.path.join(cfg_cm, "kustomization.yaml"), "w") as f:
+        yaml.safe_dump({"resources": ["certificate.yaml"]},
+                       f, sort_keys=False)
 
     # helm chart: same objects, image/namespaces templated
     chart_dir = os.path.join(ROOT, "charts", "paddle-operator-tpu")

@@ -1,0 +1,165 @@
+"""Validating admission webhook (round-4): rejects invalid TpuJobs at
+apply time with the same typed-schema + semantic validators the rest of
+the stack uses. The reference ships cert-manager scaffolding with no
+webhook behind it; here the endpoint is real."""
+
+import json
+import ssl
+import urllib.request
+
+import yaml
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers.webhook import (
+    AdmissionWebhookServer, self_signed_cert, validate_admission)
+
+
+def _review(obj, uid="u1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "operation": "CREATE", "object": obj},
+    }
+
+
+def _good_job():
+    return api.new_tpujob("wh", spec={
+        "worker": {"replicas": 2, "template": {"spec": {
+            "containers": [{"name": "w", "image": "img"}]}}}})
+
+
+def test_validate_admission_allows_valid_job():
+    out = validate_admission(_review(_good_job()))
+    assert out["response"]["allowed"] is True
+    assert out["response"]["uid"] == "u1"
+    assert out["kind"] == "AdmissionReview"
+
+
+def test_validate_admission_denies_schema_typo():
+    job = _good_job()
+    job["spec"]["worker"]["template"]["spec"]["containers"][0][
+        "imagee"] = "typo"
+    out = validate_admission(_review(job))
+    assert out["response"]["allowed"] is False
+    assert "imagee" in out["response"]["status"]["message"]
+    assert out["response"]["status"]["code"] == 422
+
+
+def test_validate_admission_denies_semantic_error():
+    job = _good_job()
+    job["spec"]["worker"]["replicas"] = -2
+    out = validate_admission(_review(job))
+    assert out["response"]["allowed"] is False
+
+
+def test_validate_admission_ignores_other_kinds():
+    out = validate_admission(_review({"kind": "Pod", "metadata": {}}))
+    assert out["response"]["allowed"] is True
+
+
+def test_validate_admission_type_malformed_spec_denies_with_schema_error():
+    """replicas: null crashes the semantic validator if run first; the
+    schema must answer instead of an internal-error 400."""
+    job = _good_job()
+    job["spec"]["worker"]["replicas"] = None
+    out = validate_admission(_review(job))
+    assert out["response"]["allowed"] is False
+    msg = out["response"]["status"]["message"]
+    assert "replicas" in msg and "TypeError" not in msg
+
+
+def test_validate_admission_allows_terminating_object():
+    """failurePolicy Fail must never wedge finalizer removal: a job with
+    deletionTimestamp is allowed even if (now-)invalid."""
+    job = _good_job()
+    job["spec"]["worker"]["template"]["spec"]["containers"][0][
+        "imagee"] = "typo"
+    job["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    out = validate_admission(_review(job))
+    assert out["response"]["allowed"] is True
+
+
+def test_validate_admission_allows_metadata_only_update():
+    """Finalizer/label writes on a stored job whose spec predates a
+    stricter validator must not start failing."""
+    job = _good_job()
+    job["spec"]["worker"]["template"]["spec"]["containers"][0][
+        "imagee"] = "stored-before-the-validator-got-stricter"
+    import copy
+    old = {"spec": copy.deepcopy(job["spec"])}
+    review = _review(job)
+    review["request"]["operation"] = "UPDATE"
+    review["request"]["oldObject"] = old
+    out = validate_admission(review)
+    assert out["response"]["allowed"] is True
+    # but a SPEC change on the same job is validated
+    changed = copy.deepcopy(review)
+    changed["request"]["object"]["spec"]["worker"]["replicas"] = 3
+    out = validate_admission(changed)
+    assert out["response"]["allowed"] is False
+
+
+def test_webhook_server_over_tls(tmp_path):
+    cert_pem, key_pem = self_signed_cert(dns_names=("localhost",))
+    cert = tmp_path / "tls.crt"
+    key = tmp_path / "tls.key"
+    cert.write_bytes(cert_pem)
+    key.write_bytes(key_pem)
+
+    srv = AdmissionWebhookServer("127.0.0.1:0", cert_file=str(cert),
+                                 key_file=str(key)).start()
+    try:
+        assert srv.tls
+        ctx = ssl.create_default_context(cadata=cert_pem.decode())
+        ctx.check_hostname = False  # CN/SAN is localhost, we dial 127.0.0.1
+
+        def post(body):
+            req = urllib.request.Request(
+                srv.url + "/validate-tpujob", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=5, context=ctx) as r:
+                return json.loads(r.read())
+
+        ok = post(json.dumps(_review(_good_job())).encode())
+        assert ok["response"]["allowed"] is True
+
+        bad_job = _good_job()
+        bad_job["spec"]["worker"]["template"]["spec"] = {"containerz": []}
+        denied = post(json.dumps(_review(bad_job)).encode())
+        assert denied["response"]["allowed"] is False
+
+        malformed = post(b"this is not json")
+        assert malformed["response"]["allowed"] is False
+        assert malformed["response"]["status"]["code"] == 400
+
+        # probes
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=5,
+                                    context=ctx) as r:
+            assert r.status == 200
+    finally:
+        srv.stop()
+
+
+def test_webhook_manifests_rendered():
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "deploy", "webhook", "webhook.yaml")
+    docs = [d for d in yaml.safe_load_all(open(path)) if d]
+    kinds = {d["kind"] for d in docs}
+    assert kinds == {"Service", "Issuer", "Certificate",
+                     "ValidatingWebhookConfiguration"}
+    wh = next(d for d in docs
+              if d["kind"] == "ValidatingWebhookConfiguration")
+    assert "cert-manager.io/inject-ca-from" in wh["metadata"]["annotations"]
+    rule = wh["webhooks"][0]["rules"][0]
+    assert rule["resources"] == [api.PLURAL]
+    assert wh["webhooks"][0]["clientConfig"]["service"]["path"] == \
+        "/validate-tpujob"
+    # kustomize pieces exist and agree
+    assert yaml.safe_load(open(os.path.join(
+        root, "config", "webhook", "manifests.yaml")))["kind"] == \
+        "ValidatingWebhookConfiguration"
+    assert os.path.exists(os.path.join(
+        root, "config", "certmanager", "certificate.yaml"))
