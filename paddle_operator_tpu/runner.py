@@ -24,7 +24,7 @@ from .ops.optim import Optimizer
 from .parallel import build_train_step, make_mesh
 from .parallel.sharding import Rules
 from .utils.checkpoint import (
-    latest_step, read_manifest, restore_checkpoint,
+    AsyncCheckpointer, latest_step, read_manifest, restore_checkpoint,
     restore_checkpoint_sharded, save_checkpoint, save_checkpoint_sharded,
 )
 from .utils.trace import profile_steps, tracer
@@ -82,6 +82,12 @@ class TrainJob:
     log_every: int = 10
     checkpoint_every: int = 50
     checkpoint_dir: str = ""
+    # npz saves happen on a background thread (train steps keep running
+    # during the disk write; the loop only pays the device->host snapshot).
+    # Durability points — elastic interrupt, end of run — drain the writer.
+    # Sharded multi-host saves are always synchronous (they serialize on a
+    # cross-host barrier anyway).
+    async_checkpoint: bool = True
     seed: int = 0
 
 
@@ -97,6 +103,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         initialize_distributed(cfg)
 
     result: Dict[str, Any] = {"cycles": 0}
+    ckpt_writer = AsyncCheckpointer() if job.async_checkpoint else None
 
     def save(step: int, state, epoch: int) -> None:
         """Multi-host: every process writes its own shards (a full gather of
@@ -112,9 +119,19 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             if job.sharded_checkpoint:
                 save_checkpoint_sharded(job.checkpoint_dir, step, state,
                                         meta={"epoch": epoch})
+            elif ckpt_writer is not None:
+                ckpt_writer.save(job.checkpoint_dir, step, state,
+                                 meta={"epoch": epoch})
             else:
                 save_checkpoint(job.checkpoint_dir, step,
                                 jax.device_get(state), meta={"epoch": epoch})
+
+    def drain_saves() -> None:
+        """Durability point: block until the in-flight npz write (if any)
+        has really landed — called before an elastic restart reads the
+        checkpoint back, and at the end of the run."""
+        if ckpt_writer is not None:
+            ckpt_writer.wait()
 
     def agreed_stop(should_stop: Callable[[], bool]) -> Callable[[], bool]:
         """Multi-host: the stop decision must be identical on every process
@@ -202,6 +219,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         trc = tracer()
         try:
             step = start_step
+            last_saved = -1  # dedups the stop-path save at a boundary step
             while step < job.total_steps:
                 k_here = min(K, job.total_steps - step)
                 prof.before(step, span=k_here)
@@ -240,11 +258,17 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 if job.checkpoint_dir and (
                         step % job.checkpoint_every < k_here):
                     save(step, state, epoch)
+                    last_saved = step
                 if should_stop():
                     log.info("membership epoch moved at step %d; restarting",
                              step)
                     if job.checkpoint_dir:
-                        save(step, state, epoch)
+                        # skip the rewrite when the periodic save just
+                        # covered this exact step — the stop path only
+                        # needs the write durable, not duplicated
+                        if last_saved != step:
+                            save(step, state, epoch)
+                        drain_saves()  # next cycle restores this write
                     return False
                 result["state"] = state
                 result["steps"] = step
@@ -256,10 +280,19 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             result["loss"] = float(metrics["loss"])
         return True
 
-    if cfg.is_elastic:
-        agent = ElasticAgent(cfg, poll_interval=poll_interval)
-        result["cycles"] = agent.run(train_cycle)
-    else:
-        train_cycle(cfg.num_workers, 0, lambda: False)
-        result["cycles"] = 1
+    try:
+        if cfg.is_elastic:
+            agent = ElasticAgent(cfg, poll_interval=poll_interval)
+            result["cycles"] = agent.run(train_cycle)
+        else:
+            train_cycle(cfg.num_workers, 0, lambda: False)
+            result["cycles"] = 1
+        drain_saves()  # a pending final write must land before we report
+    finally:
+        # error path: still drain so a half-finished background write
+        # can't race process teardown; its own error wins over masking
+        try:
+            drain_saves()
+        except Exception:
+            log.exception("async checkpoint write failed during teardown")
     return result

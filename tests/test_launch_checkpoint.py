@@ -144,6 +144,68 @@ def test_checkpoint_roundtrip(tmp_path):
     assert restored["params"]["layers"][1]["kernel"].shape == (3,)
 
 
+def test_async_checkpointer_matches_sync(tmp_path):
+    """Background write produces the identical checkpoint, and the
+    snapshot decouples from later state mutation: saves landed in order
+    with the values they were handed."""
+    from paddle_operator_tpu.utils.checkpoint import AsyncCheckpointer
+
+    ck = AsyncCheckpointer()
+    state = make_state()
+    ck.save(str(tmp_path), 1, state, meta={"epoch": 1})
+    # immediately hand a second save with different values: the first
+    # write may still be in flight; save() serializes them
+    state2 = make_state()
+    state2["opt"]["step"] = jnp.array(42, jnp.int32)
+    ck.save(str(tmp_path), 2, state2, meta={"epoch": 1})
+    ck.wait()
+    assert all_steps(str(tmp_path)) == [1, 2]
+    r1, _ = restore_checkpoint(str(tmp_path), step=1)
+    r2, _ = restore_checkpoint(str(tmp_path), step=2)
+    assert int(r1["opt"]["step"]) == 7
+    assert int(r2["opt"]["step"]) == 42
+
+
+def test_async_checkpointer_surfaces_write_error(tmp_path):
+    """A failed background write must raise on the next save/wait, not
+    silently look saved."""
+    import pytest
+
+    from paddle_operator_tpu.utils.checkpoint import AsyncCheckpointer
+
+    target = tmp_path / "blocked"
+    target.write_text("a file where the ckpt dir should go")
+    ck = AsyncCheckpointer()
+    ck.save(str(target), 1, make_state())
+    with pytest.raises(Exception):
+        ck.wait()
+    ck.wait()  # error consumed: drained writer is reusable
+
+
+def test_runner_async_checkpoint_end_to_end(tmp_path):
+    """run_training with the default async writer: checkpoints exist and
+    restore after the run (the drain point held)."""
+    from paddle_operator_tpu.models import gpt
+    from paddle_operator_tpu.ops import optim
+    from paddle_operator_tpu.runner import TrainJob, run_training
+
+    job = TrainJob(
+        init_params=lambda rng: gpt.init(rng, gpt.TINY_CONFIG),
+        loss_fn=gpt.loss_fn,
+        optimizer=optim.adamw(1e-3),
+        make_batch=lambda rng, step: gpt.synthetic_batch(rng, 8, 16, 1024),
+        total_steps=4, checkpoint_every=2, checkpoint_dir=str(tmp_path),
+        log_every=0,
+    )
+    assert job.async_checkpoint  # the default
+    out = run_training(job, cfg=LaunchConfig(worker_id=0, num_workers=1),
+                       init_distributed=False)
+    assert out["steps"] == 4
+    assert latest_step(str(tmp_path)) == 4
+    restored, manifest = restore_checkpoint(str(tmp_path))
+    assert manifest["step"] == 4
+
+
 def test_checkpoint_keep_prunes(tmp_path):
     state = make_state()
     for step in [1, 2, 3, 4, 5]:
