@@ -484,9 +484,14 @@ def _gpt_bench(calib_tflops):
     from paddle_operator_tpu.ops import optim
     from paddle_operator_tpu.parallel import build_train_step
 
+    from functools import partial
+
     batch = int(os.environ.get("BENCH_GPT_BATCH", "8"))
     seq = int(os.environ.get("BENCH_GPT_SEQ", "2048"))
     steps = int(os.environ.get("BENCH_GPT_STEPS", "10"))
+    # chunked cross-entropy: stream tokens through the LM head instead of
+    # materializing the [B, S, V] fp32 logits (~3 GB at these shapes)
+    ce_chunk = int(os.environ.get("BENCH_GPT_CE_CHUNK", "1024"))
 
     cfg = dict(gpt.BASE_CONFIG, max_seq=seq)
     params = jax.jit(lambda k: gpt.init(k, cfg))(jax.random.PRNGKey(0))
@@ -499,7 +504,8 @@ def _gpt_bench(calib_tflops):
         jax.random.PRNGKey(1), batch, seq_len=seq,
         vocab_size=cfg["vocab_size"])
     opt = optim.adamw(1e-4, wd_mask=optim.make_wd_mask(params))
-    step, state = build_train_step(gpt.loss_fn, opt, params, batch_data,
+    loss_fn = partial(gpt.loss_fn, ce_chunk=ce_chunk)
+    step, state = build_train_step(loss_fn, opt, params, batch_data,
                                    grad_clip=1.0)
     best = _timed_windows(step, state, batch_data, steps)
     tokens_per_sec = batch * seq / best
@@ -508,6 +514,7 @@ def _gpt_bench(calib_tflops):
     flops_per_seq = dense_flops + attn_flops
     return {
         "model": "gpt2-small", "batch": batch, "seq": seq,
+        "ce_chunk": ce_chunk,
         "params_m": round(n_total / 1e6, 1),
         "matmul_params_m": round(n_matmul / 1e6, 1),
         "tokens_per_sec": round(tokens_per_sec, 0),

@@ -33,12 +33,17 @@ def main():
     if MOE:
         cfg.update(moe_experts=MOE, moe_every=2)
 
+    # stream tokens through the LM head (never materialize [B,S,V] fp32
+    # logits — gigabytes at long context); 0 restores the dense path
+    ce_chunk = int(os.environ.get("TPUJOB_CE_CHUNK", "1024"))
+
     def loss_fn(p, b, mesh=None):
         attn = "auto"
         if mesh is not None and SP > 1 and "sp" in mesh.shape:
             attn = functools.partial(
                 ring_attention, mesh=mesh, axis="sp", causal=True)
-        return gpt.loss_fn(p, b, remat=True, attn_impl=attn)
+        return gpt.loss_fn(p, b, remat=True, attn_impl=attn,
+                           ce_chunk=ce_chunk)
 
     job = TrainJob(
         init_params=lambda rng: gpt.init(rng, cfg),

@@ -88,6 +88,18 @@ def _block(layer, x, dtype, attn_impl, positions):
 def apply(params, input_ids, dtype=jnp.bfloat16, remat: bool = False,
           attn_impl="auto", positions: Optional[jnp.ndarray] = None):
     """input_ids: [B, S] -> (logits [B, S, V] fp32, moe aux loss scalar)."""
+    x, aux = encode(params, input_ids, dtype=dtype, remat=remat,
+                    attn_impl=attn_impl, positions=positions)
+    logits = nn.dense(params["lm_head"], x, dtype=jnp.float32)
+    return logits, aux
+
+
+def encode(params, input_ids, dtype=jnp.bfloat16, remat: bool = False,
+           attn_impl="auto", positions: Optional[jnp.ndarray] = None):
+    """Backbone up to (but excluding) the LM head: [B, S] -> ([B, S, D]
+    final-LN hidden states, moe aux loss). Split out so the chunked
+    cross-entropy path can consume hidden states without ever
+    materializing the [B, S, V] logits."""
     x = nn.embedding(params["embed"]["tok"], input_ids, dtype)
 
     layer_fn = _block
@@ -98,27 +110,44 @@ def apply(params, input_ids, dtype=jnp.bfloat16, remat: bool = False,
         x, layer_aux = layer_fn(layer, x, dtype, attn_impl, positions)
         aux = aux + layer_aux
     x = nn.layernorm(params["final_ln"], x, dtype=dtype)
-    logits = nn.dense(params["lm_head"], x, dtype=jnp.float32)
-    return logits, aux
+    return x, aux
 
 
 def loss_fn(params, batch, train=True, dtype=jnp.bfloat16, remat: bool = False,
-            attn_impl="auto", moe_aux_weight: float = 0.01):
+            attn_impl="auto", moe_aux_weight: float = 0.01,
+            ce_chunk: int = 0):
     """Next-token LM loss. batch = {"input_ids" [B,S], optional "loss_mask"}.
 
     Labels are input_ids shifted left; the final position is dropped. A
     ``loss_mask`` (e.g. padding) applies to the *label* position.
+
+    ``ce_chunk > 0`` routes the LM head through
+    :func:`ops.nn.chunked_lm_xent`: tokens stream through the head in
+    chunks under remat, so the ``[B, S, V]`` fp32 logits (gigabytes at
+    S=2k, V=50k — the dominant HBM cost of this loss) are never
+    materialized. Same loss/accuracy as the dense path up to fp32
+    summation order.
     """
     ids = batch["input_ids"]
-    logits, moe_aux = apply(params, ids, dtype=dtype, remat=remat,
-                            attn_impl=attn_impl)
-    logits = logits[:, :-1]
     labels = ids[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     mask = batch.get("loss_mask")
     mask = (jnp.ones_like(labels, jnp.float32) if mask is None
             else mask[:, 1:].astype(jnp.float32))
+
+    if ce_chunk:
+        hidden, moe_aux = encode(params, ids, dtype=dtype, remat=remat,
+                                 attn_impl=attn_impl)
+        loss, acc = nn.chunked_lm_xent(
+            params["lm_head"], hidden[:, :-1], labels, mask=mask,
+            chunk=ce_chunk, dtype=dtype)
+        loss = loss + moe_aux_weight * moe_aux
+        return loss, {"accuracy": acc, "moe_aux": moe_aux}
+
+    logits, moe_aux = apply(params, ids, dtype=dtype, remat=remat,
+                            attn_impl=attn_impl)
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(jnp.sum(mask), 1.0)
     loss = -jnp.sum(picked * mask) / denom
     loss = loss + moe_aux_weight * moe_aux

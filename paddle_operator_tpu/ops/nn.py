@@ -322,3 +322,72 @@ def sigmoid_binary_cross_entropy(logits, labels):
 
 def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def chunked_lm_xent(head_params, hidden, labels, mask=None,
+                    chunk: int = 1024, dtype=jnp.bfloat16):
+    """Cross-entropy through a big-vocab LM head WITHOUT materializing the
+    full ``[tokens, vocab]`` logits tensor.
+
+    The dense path stores fp32 logits plus their backward residuals —
+    at GPT scale (S=2048, V=50k) that is gigabytes of HBM per batch and
+    the dominant memory (and bandwidth) cost of the loss. Here tokens are
+    processed in ``chunk``-sized slices under ``jax.checkpoint``: the
+    forward keeps only per-token scalars (logsumexp, picked logit,
+    argmax-correct), and the backward recomputes each chunk's logits from
+    ``(hidden_chunk, W)`` — the same FLOPs-for-memory trade flash
+    attention makes for S^2 scores. Peak extra memory: O(chunk * vocab).
+
+    Args:
+      head_params: dense-layer params ``{"kernel": [D, V], ...}``.
+      hidden: ``[..., D]`` activations entering the LM head.
+      labels: int ids, shape = hidden.shape[:-1].
+      mask: optional float weights on label positions (same shape).
+    Returns:
+      (mean_loss fp32, accuracy fp32) over masked positions — matching
+      ``softmax_cross_entropy`` + ``accuracy`` on the dense path.
+    """
+    d = hidden.shape[-1]
+    flat_h = hidden.reshape(-1, d)
+    flat_l = labels.reshape(-1)
+    n = flat_h.shape[0]
+    flat_m = (jnp.ones((n,), jnp.float32) if mask is None
+              else mask.reshape(-1).astype(jnp.float32))
+    chunk = max(1, min(chunk, n))
+    pad = (-n) % chunk
+    if pad:
+        flat_h = jnp.concatenate(
+            [flat_h, jnp.zeros((pad, d), flat_h.dtype)])
+        flat_l = jnp.concatenate([flat_l, jnp.zeros((pad,), flat_l.dtype)])
+        flat_m = jnp.concatenate([flat_m, jnp.zeros((pad,), jnp.float32)])
+    n_chunks = flat_h.shape[0] // chunk
+    hc = flat_h.reshape(n_chunks, chunk, d)
+    lc = flat_l.reshape(n_chunks, chunk)
+    mc = flat_m.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def one_chunk(h, l, m):
+        # bf16 operands, fp32 MXU accumulation: full matmul speed with
+        # near-fp32 logits (plain bf16 output would round the logsumexp)
+        logits = jnp.matmul(
+            h.astype(dtype), head_params["kernel"].astype(dtype),
+            preferred_element_type=jnp.float32)
+        if "bias" in head_params:
+            logits = logits + head_params["bias"].astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)                 # [chunk]
+        picked = jnp.take_along_axis(
+            logits, l[:, None], axis=-1)[:, 0]                  # [chunk]
+        correct = (jnp.argmax(logits, axis=-1) == l)
+        loss_sum = jnp.sum((lse - picked) * m)
+        acc_sum = jnp.sum(correct.astype(jnp.float32) * m)
+        return loss_sum, acc_sum
+
+    def body(carry, xs):
+        loss_acc, acc_acc = carry
+        loss_sum, acc_sum = one_chunk(*xs)
+        return (loss_acc + loss_sum, acc_acc + acc_sum), None
+
+    (loss_sum, acc_sum), _ = jax.lax.scan(
+        body, (jnp.float32(0), jnp.float32(0)), (hc, lc, mc))
+    denom = jnp.maximum(jnp.sum(flat_m), 1.0)
+    return loss_sum / denom, acc_sum / denom

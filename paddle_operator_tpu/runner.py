@@ -290,9 +290,11 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         drain_saves()  # a pending final write must land before we report
     finally:
         # error path: still drain so a half-finished background write
-        # can't race process teardown; its own error wins over masking
+        # can't race process teardown. BaseException, matching what the
+        # writer stores — a SystemExit smuggled out of the write thread
+        # must not replace the in-flight training error.
         try:
             drain_saves()
-        except Exception:
+        except BaseException:
             log.exception("async checkpoint write failed during teardown")
     return result
