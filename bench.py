@@ -35,6 +35,15 @@ measurement (batch ladder 256 -> 64 -> 8 on compute-side failures) and
 re-emits — the driver keeps the LAST JSON line, so the TPU number
 replaces the banked CPU number exactly when it exists. On total failure
 it still emits a JSON line with `stage_reached` localizing the hang.
+
+Round-5 canary escalation (round-4 verdict item 1): all five round-4
+probes died at the same fixed 90 s backend_init wall, which can only ever
+re-confirm "down" — never catch a relay whose init takes 90+ s while it
+recovers. Probes now escalate their backend_init deadline (90 -> 180 ->
+everything left, guaranteeing one probe >= 300 s whenever the budget
+allows; see `_canary_backend_deadline`), and every attempt records
+per-stage elapsed times + the child's last stderr line in the attempts
+log, so even a failed round localizes WHERE init hung.
 """
 
 import json
@@ -92,6 +101,42 @@ CANARY_DEADLINES = {
     "backend_init": float(os.environ.get("BENCH_T_CANARY_BACKEND", "90")),
     "canary": float(os.environ.get("BENCH_T_CANARY_RUN", "60")),
 }
+
+# Round-5 fix (round-4 verdict item 1): a FIXED canary backend_init deadline
+# can only ever re-confirm "down" — all five round-4 probes died at the same
+# 90 s wall and the artifact could not distinguish "relay wedged forever"
+# from "init takes 90+ s while the relay recovers" (round 2 proves this
+# environment CAN reach the TPU). Probes now ESCALATE: 90 s, then 180 s,
+# then every probe after that gets everything left in the budget (≥300 s
+# when the budget allows). The CPU bank is already printed by then, so a
+# long final probe risks nothing but its own time.
+def _parse_escalation(raw):
+    # must never crash at import: the parent's contract is "always one
+    # parseable JSON line", which a config typo must not break
+    steps = []
+    for s in raw.split(","):
+        s = s.strip()
+        if not s:
+            continue
+        try:
+            steps.append(float(s))
+        except ValueError:
+            pass
+    return steps or [90.0, 180.0]
+
+
+CANARY_BACKEND_ESCALATION = _parse_escalation(
+    os.environ.get("BENCH_T_CANARY_ESCALATION", "90,180"))
+# The smallest deadline any probe may run with: a probe below this cannot
+# answer at all. Floored against the schedule's own first step so raising
+# BENCH_T_CANARY_BACKEND alone cannot make probe 0 "not fit" and silently
+# disable probing.
+CANARY_MIN_BACKEND = min(
+    [CANARY_DEADLINES["backend_init"]] + CANARY_BACKEND_ESCALATION)
+# The long probe is the one that can catch a slow-recovering relay; it must
+# actually happen. If following the schedule would leave less than this for
+# a later everything-left probe, the current probe takes everything instead.
+CANARY_LONG_PROBE_MIN = float(os.environ.get("BENCH_T_CANARY_LONG", "300"))
 
 STAGE_MARK = "BENCH_STAGE "
 
@@ -839,18 +884,32 @@ def _make(batch_size, image_size, key):
 
 class _Attempt:
     def __init__(self, batch, platform=None, steps=None, warmup=None,
-                 mode="bench"):
+                 mode="bench", deadlines=None):
         self.batch = batch
         self.platform = platform
         self.steps = steps
         self.warmup = warmup
         self.mode = mode  # "bench" | "canary"
-        self.deadlines = CANARY_DEADLINES if mode == "canary" else None
+        if deadlines is not None:
+            self.deadlines = deadlines
+        else:
+            self.deadlines = CANARY_DEADLINES if mode == "canary" else None
         self.stage = "child_up"
         self.stage_t = time.monotonic()
+        # Evidence trail (round-4 verdict: the attempts log recorded only
+        # {batch, platform, mode, outcome} — a failed round could not
+        # localize WHERE init hung). Per-stage elapsed seconds, in order,
+        # plus the child's last stderr line.
+        self.stage_times = []      # [(stage, seconds)], closed stages
+        self.last_stderr = None    # last non-marker stderr line seen
+        self.outcome = None  # "ok" | "killed:<stage>" | "exit:<rc>"
         self.stdout_lines = []
         self.result = None  # parsed JSON from child
-        self.outcome = None  # "ok" | "killed:<stage>" | "exit:<rc>"
+
+    def close_stage(self):
+        """Record the elapsed time of the stage currently open."""
+        self.stage_times.append(
+            (self.stage, round(time.monotonic() - self.stage_t, 1)))
 
 
 def _stop_child(proc, why):
@@ -912,12 +971,15 @@ def _run_attempt(att, budget_s):
         for line in proc.stderr:
             line = line.rstrip("\n")
             if line.startswith(STAGE_MARK):
+                att.close_stage()
                 att.stage = line[len(STAGE_MARK):].strip()
                 att.stage_t = time.monotonic()
                 _log("stage -> %s (batch=%d%s)" % (
                     att.stage, att.batch,
                     ", platform=%s" % att.platform if att.platform else ""))
             else:
+                if line.strip():
+                    att.last_stderr = line[-240:]
                 print(line, file=sys.stderr, flush=True)
 
     def read_stdout():
@@ -944,6 +1006,7 @@ def _run_attempt(att, budget_s):
             _stop_child(proc, why)
             t_err.join(timeout=5)
             t_out.join(timeout=5)
+            att.close_stage()
             _parse_result(att)
             # a kill during the post-measure extras must not discard the
             # core number the child already printed
@@ -955,6 +1018,7 @@ def _run_attempt(att, budget_s):
 
     t_err.join(timeout=5)
     t_out.join(timeout=5)
+    att.close_stage()
     _parse_result(att)
     if att.result is not None:
         # core JSON is printed before the extra stages: a child that died
@@ -1036,17 +1100,25 @@ def parent_main():
     # a full canary cycle can legitimately take every stage deadline in
     # sequence; only launch one if the whole worst case fits, or the final
     # canary gets TERM->KILLed mid-TPU-claim — the exact kill that wedges
-    # this relay
-    min_probe_budget = sum(CANARY_DEADLINES.values()) + 15
+    # this relay. Computed per-probe below because deadlines escalate.
+    fixed_canary_cost = (CANARY_DEADLINES["child_up"]
+                         + CANARY_DEADLINES["canary"] + 15)
     i = 0  # ladder index survives re-probing: a batch that failed at a
     #        compute stage is not retried after the relay recovers
     tpu_seen = False   # any canary succeeded: changes the final label
     n_probes = 0       # canaries launched: the final label must not claim
     #                    probing that never happened
     no_plugin = None   # canary ran on a non-TPU backend: probing is moot
-    while remaining() > min_probe_budget and i < len(ladder):
-        att = _run_attempt(_Attempt(0, mode="canary"),
-                           min(remaining() - 10, 240))
+    while i < len(ladder):
+        backend_deadline = _canary_backend_deadline(
+            n_probes, remaining(), fixed_canary_cost, probe_backoff)
+        if backend_deadline is None:
+            break  # not even the base probe fits the budget now
+        deadlines = dict(CANARY_DEADLINES, backend_init=backend_deadline)
+        _log("canary probe %d: backend_init deadline %.0fs (%.0fs budget "
+             "left)" % (n_probes + 1, backend_deadline, remaining()))
+        att = _run_attempt(_Attempt(0, mode="canary", deadlines=deadlines),
+                           remaining() - 10)
         attempts.append(att)
         n_probes += 1
         if (att.outcome == "ok" and att.result is not None
@@ -1065,7 +1137,8 @@ def parent_main():
         if not alive:
             _log("TPU canary failed (%s); %.0fs budget left"
                  % (att.outcome, remaining()))
-            if remaining() > min_probe_budget + probe_backoff:
+            min_next = fixed_canary_cost + CANARY_MIN_BACKEND
+            if remaining() > min_next + probe_backoff:
                 time.sleep(probe_backoff)
             continue
         tpu_seen = True
@@ -1132,10 +1205,49 @@ def parent_main():
     }))
 
 
+def _canary_backend_deadline(n_probes, remaining_s, fixed_cost, backoff=0.0):
+    """Escalating backend_init deadline for canary probe #`n_probes`.
+
+    Scheduled steps first (default 90, 180 s), then every later probe gets
+    ALL remaining budget. A fixed deadline can only ever re-confirm "down";
+    the escalation catches a relay whose init is slow-but-recovering
+    (round-4 verdict item 1 — all five round-4 probes died at the same
+    fixed 90 s wall). Returns None when not even the base probe fits.
+    """
+    avail = remaining_s - fixed_cost
+    if n_probes < len(CANARY_BACKEND_ESCALATION):
+        want = CANARY_BACKEND_ESCALATION[n_probes]
+        # Worst case this probe burns want + fixed_cost, then the loop
+        # sleeps `backoff` before the next launch; if what would be left
+        # cannot fund a >=CANARY_LONG_PROBE_MIN everything-left probe,
+        # skip ahead and go long NOW — otherwise the schedule's small
+        # steps eat the budget and the long probe never happens (the
+        # exact round-4 failure shape, just with escalating numbers).
+        if avail - (want + fixed_cost + backoff) < CANARY_LONG_PROBE_MIN:
+            want = avail
+    else:
+        want = avail
+    deadline = want  # scheduled steps are proven < avail; long takes avail
+    if deadline < CANARY_MIN_BACKEND:
+        return None
+    return deadline
+
+
 def _attempt_log(attempts):
-    return [
-        {"batch": a.batch, "platform": a.platform or "tpu",
-         "mode": a.mode, "outcome": a.outcome} for a in attempts]
+    out = []
+    for a in attempts:
+        rec = {"batch": a.batch, "platform": a.platform or "tpu",
+               "mode": a.mode, "outcome": a.outcome,
+               # per-stage elapsed seconds in execution order: a failed
+               # round must still localize WHERE the child hung
+               "stages": [[s, t] for s, t in a.stage_times]}
+        if a.mode == "canary" and a.deadlines is not None:
+            rec["backend_init_deadline"] = round(
+                a.deadlines.get("backend_init", 0))
+        if a.last_stderr:
+            rec["last_stderr"] = a.last_stderr
+        out.append(rec)
+    return out
 
 
 def _emit(result, attempts):

@@ -1,0 +1,154 @@
+"""Tests for bench.py's parent-side supervision logic.
+
+The bench is the round artifact; its supervision logic (canary deadline
+escalation, per-attempt evidence capture) must be tested hermetically on
+CPU — the TPU relay's availability is exactly what it cannot depend on.
+
+Round-5 additions (round-4 verdict item 1): probes escalate their
+backend_init deadline (90 -> 180 -> rest-of-budget) instead of dying at a
+fixed wall, and every attempt records per-stage elapsed times plus the
+child's last stderr line so a failed round still localizes the hang.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+class TestCanaryEscalation:
+    def test_first_probe_uses_base_deadline(self):
+        assert bench._canary_backend_deadline(0, 840.0, 165.0) == 90.0
+
+    def test_second_probe_escalates(self):
+        # plenty of budget left: scheduled 180 step is honored
+        assert bench._canary_backend_deadline(1, 1500.0, 165.0) == 180.0
+
+    def test_later_probes_get_rest_of_budget(self):
+        # probe 3+ gets everything left minus the fixed canary cost
+        d = bench._canary_backend_deadline(2, 600.0, 165.0)
+        assert d == 600.0 - 165.0
+        assert d >= 300.0  # the verdict's "one probe >= 300 s" criterion
+
+    def test_scheduled_step_goes_long_when_budget_tightens(self):
+        # scheduled 180 s, but honoring it would leave <300 s for a later
+        # long probe: take everything now instead
+        d = bench._canary_backend_deadline(1, 700.0, 165.0)
+        assert d == 700.0 - 165.0
+
+    def test_probe_that_cannot_fit_returns_none(self):
+        # less budget than the base backend_init deadline: don't launch —
+        # a canary TERM-KILLed mid-TPU-claim is what wedges the relay
+        assert bench._canary_backend_deadline(5, 120.0, 100.0) is None
+
+    def test_raising_base_backend_knob_does_not_disable_probing(self):
+        """Review finding: with BENCH_T_CANARY_BACKEND raised above the
+        schedule's first step, probe 0 must still fit (floor against the
+        schedule, not the independently tunable base deadline)."""
+        orig = dict(bench.CANARY_DEADLINES)
+        try:
+            bench.CANARY_DEADLINES["backend_init"] = 120.0
+            # CANARY_MIN_BACKEND is computed at import from the schedule's
+            # min (90) — probe 0's scheduled 90 s deadline must pass it
+            assert bench._canary_backend_deadline(0, 840.0, 165.0) == 90.0
+        finally:
+            bench.CANARY_DEADLINES.update(orig)
+
+    def test_backoff_reserved_in_long_probe_guarantee(self):
+        """Review finding: the inter-probe backoff sleep must be reserved
+        too, or the everything-left probe comes in just under 300 s."""
+        fixed, backoff = 165.0, 20.0
+        # 720 s: without the reserve, probe 0 keeps its 90 s step and the
+        # long probe lands at ~280 s; with it, probe 0 goes long >= 300 s
+        d0 = bench._canary_backend_deadline(0, 720.0, fixed, backoff)
+        assert d0 == 720.0 - fixed
+        assert d0 >= bench.CANARY_LONG_PROBE_MIN
+
+    def test_escalation_env_parse_is_crashproof(self):
+        # trailing comma / empties / garbage must not crash at import —
+        # the parent's "always one JSON line" contract depends on it
+        assert bench._parse_escalation("90,180,") == [90.0, 180.0]
+        assert bench._parse_escalation("") == [90.0, 180.0]
+        assert bench._parse_escalation("nonsense") == [90.0, 180.0]
+        assert bench._parse_escalation(" 60 , 120 ") == [60.0, 120.0]
+
+    def test_escalation_sequence_over_a_full_budget(self):
+        """Simulate the exact round-4 failure shape — relay never answers,
+        every probe burns its full deadline (worst case). The probes must
+        escalate and include one >= 300 s, even inside the driver's 840 s
+        budget with the CPU bank already paid."""
+        fixed = 165.0
+        remaining = 750.0  # 840 driver budget minus ~90 s CPU bank
+        deadlines = []
+        for n in range(10):
+            d = bench._canary_backend_deadline(n, remaining, fixed)
+            if d is None:
+                break
+            deadlines.append(d)
+            remaining -= d + fixed  # worst case: probe burns its deadline
+        assert deadlines[0] == 90.0
+        assert any(d >= 300.0 for d in deadlines), deadlines
+        assert deadlines == sorted(deadlines), deadlines  # escalating
+
+    def test_escalation_sequence_with_generous_budget(self):
+        """With a big budget the full 90/180/rest ladder plays out."""
+        fixed = 165.0
+        remaining = 1800.0
+        deadlines = []
+        for n in range(10):
+            d = bench._canary_backend_deadline(n, remaining, fixed)
+            if d is None:
+                break
+            deadlines.append(d)
+            remaining -= d + fixed
+        assert deadlines[0] == 90.0
+        assert deadlines[1] == 180.0
+        assert any(d >= 300.0 for d in deadlines), deadlines
+
+
+class TestAttemptEvidence:
+    def test_attempt_log_carries_stage_times_and_deadline(self):
+        att = bench._Attempt(0, mode="canary",
+                             deadlines=dict(bench.CANARY_DEADLINES,
+                                            backend_init=300.0))
+        att.stage_times = [["child_up", 12.5], ["backend_init", 91.0]]
+        att.last_stderr = "RuntimeError: backend relay unreachable"
+        att.outcome = "killed:backend_init"
+        (rec,) = bench._attempt_log([att])
+        assert rec["stages"] == [["child_up", 12.5], ["backend_init", 91.0]]
+        assert rec["backend_init_deadline"] == 300
+        assert rec["last_stderr"].endswith("unreachable")
+        assert rec["outcome"] == "killed:backend_init"
+
+    def test_attempt_log_is_json_serializable(self):
+        att = bench._Attempt(256)
+        att.outcome = "ok"
+        att.close_stage()
+        json.dumps(bench._attempt_log([att]))
+
+    def test_bench_attempts_have_no_canary_deadline_field(self):
+        att = bench._Attempt(256, mode="bench")
+        att.outcome = "ok"
+        (rec,) = bench._attempt_log([att])
+        assert "backend_init_deadline" not in rec
+
+
+@pytest.mark.slow
+class TestCanaryChildOnCpu:
+    def test_cpu_canary_records_stage_evidence(self):
+        """Run a REAL canary child on the CPU platform through the full
+        supervision path: outcome ok, stages recorded with elapsed times."""
+        att = bench._Attempt(0, mode="canary", platform="cpu")
+        bench._run_attempt(att, 240)
+        assert att.outcome == "ok", (att.outcome, att.last_stderr)
+        assert att.result is not None and att.result["canary"] == "ok"
+        assert att.result["backend"] == "cpu"
+        stages = [s for s, _ in att.stage_times]
+        assert "backend_init" in stages and "canary" in stages
+        # every recorded elapsed is a sane non-negative number
+        assert all(t >= 0 for _, t in att.stage_times)
