@@ -119,9 +119,11 @@ def _parse_escalation(raw):
         if not s:
             continue
         try:
-            steps.append(float(s))
+            v = float(s)
         except ValueError:
-            pass
+            continue
+        if v > 0:  # a non-positive deadline would TERM the child the
+            steps.append(v)  # instant it enters backend_init
     return steps or [90.0, 180.0]
 
 
@@ -1003,10 +1005,13 @@ def _run_attempt(att, budget_s):
             why = ("stage '%s' exceeded %.0fs" % (att.stage, deadline)
                    if in_stage > deadline
                    else "attempt exceeded budget %.0fs" % budget_s)
+            # record the fatal stage's elapsed NOW — at the moment the
+            # deadline tripped — so the log shows how long the child ran
+            # the stage, not that plus TERM-grace/KILL/join teardown
+            att.close_stage()
             _stop_child(proc, why)
             t_err.join(timeout=5)
             t_out.join(timeout=5)
-            att.close_stage()
             _parse_result(att)
             # a kill during the post-measure extras must not discard the
             # core number the child already printed

@@ -17,20 +17,41 @@ logging.basicConfig(level=logging.INFO)
 BATCH = int(os.environ.get("TPUJOB_BATCH", "512"))
 STEPS = int(os.environ.get("TPUJOB_STEPS", "100"))
 LR = float(os.environ.get("TPUJOB_LR", "0.1"))
+# TPUJOB_SPARSE=1: embedding tables stay row-sharded on the pservers;
+# trainers pull/push only the rows each batch touches (the CTR pattern —
+# per-round traffic scales with touched rows, not table size)
+SPARSE = os.environ.get("TPUJOB_SPARSE", "0") == "1"
 
 
 def main():
     cfg = launch.detect_env()
-    job = ps.PsTrainJob(
-        init_params=lambda rng: wide_deep.init(rng),
-        loss_fn=wide_deep.loss_fn,
-        make_batch=lambda rng, step: wide_deep.synthetic_batch(rng, BATCH),
-        total_steps=STEPS,
-        lr=LR,
-    )
+    if SPARSE:
+        mc = wide_deep.DEFAULT_CONFIG
+        job = ps.PsTrainJob(
+            init_params=lambda rng: wide_deep.init_dense(rng),
+            loss_fn=wide_deep.sparse_loss_fn,
+            make_batch=lambda rng, step: wide_deep.synthetic_batch(
+                rng, BATCH),
+            ids_fn=lambda b: wide_deep.sparse_ids(
+                b, mc["vocab_per_slot"]),
+            embed_dim=wide_deep.sparse_row_dim(),
+            total_steps=STEPS, lr=LR,
+        )
+    else:
+        job = ps.PsTrainJob(
+            init_params=lambda rng: wide_deep.init(rng),
+            loss_fn=wide_deep.loss_fn,
+            make_batch=lambda rng, step: wide_deep.synthetic_batch(
+                rng, BATCH),
+            total_steps=STEPS,
+            lr=LR,
+        )
     out = ps.run_ps_training(job, cfg)
     if out["role"] == "TRAINER":
         print("final loss:", out["losses"][-1])
+        if SPARSE:
+            print("wire bytes: sent=%d recv=%d over %d rounds"
+                  % (out["bytes_sent"], out["bytes_recv"], STEPS))
 
 
 if __name__ == "__main__":

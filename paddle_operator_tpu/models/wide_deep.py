@@ -72,6 +72,66 @@ def apply(params, batch, dtype=jnp.bfloat16):
     return deep_logit + wide_logit
 
 
+# ---------------------------------------------------------------------------
+# Sparse-PS variant: the embedding tables live on parameter servers
+# (ps.SparseTable row shards); the trainer sees only the rows the current
+# batch touches. One fused server-side table of width embed_dim + 1 carries
+# both the deep embedding and the wide per-id weight, so a round is one
+# sparse pull/push instead of two.
+# ---------------------------------------------------------------------------
+
+def sparse_row_dim(config: Optional[dict] = None) -> int:
+    cfg = dict(DEFAULT_CONFIG, **(config or {}))
+    return cfg["embed_dim"] + 1
+
+
+def init_dense(key, config: Optional[dict] = None) -> Dict:
+    """The non-embedding parameters only — what the DENSE BSP vector
+    carries in sparse-PS mode (the tables never leave the servers)."""
+    cfg = dict(DEFAULT_CONFIG, **(config or {}))
+    keys = iter(jax.random.split(key, 4 + len(cfg["hidden"])))
+    params: Dict = {
+        "dense_proj": nn.dense_init(next(keys), cfg["dense_dim"], cfg["embed_dim"]),
+        "mlp": [],
+    }
+    in_dim = cfg["embed_dim"] * (cfg["num_slots"] + 1)
+    for h in cfg["hidden"]:
+        params["mlp"].append(nn.dense_init(next(keys), in_dim, h))
+        in_dim = h
+    params["out"] = nn.dense_init(next(keys), in_dim, 1)
+    return params
+
+
+def sparse_ids(batch, vocab_per_slot: int):
+    """Raw (slot-folded) embedding-row ids this batch touches — the
+    trainer's `ids_fn` for ps.PsTrainJob."""
+    import numpy as np
+
+    return np.asarray(
+        _fold_slots(batch["sparse"], vocab_per_slot)).ravel()
+
+
+def sparse_loss_fn(params, rows, inv, batch, train=True,
+                   dtype=jnp.bfloat16):
+    """Same math as loss_fn, but embedding lookup = rows[inv] over the
+    PULLED rows (rows: [cap, embed_dim+1]; inv: [B*S] local indices)."""
+    b, s = batch["sparse"].shape
+    picked = rows[inv].reshape(b, s, -1)        # [B, S, E+1]
+    emb = picked[..., :-1].astype(dtype)        # [B, S, E]
+    wide = picked[..., -1].astype(jnp.float32)  # [B, S]
+    dense_feat = nn.dense(params["dense_proj"], batch["dense"], dtype)
+
+    deep = jnp.concatenate([emb.reshape(b, -1), dense_feat], axis=-1)
+    for layer in params["mlp"]:
+        deep = jax.nn.relu(nn.dense(layer, deep, dtype))
+    deep_logit = nn.dense(params["out"], deep, jnp.float32)[:, 0]
+    logits = deep_logit + jnp.sum(wide, axis=-1)
+    loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])
+    pred = (logits > 0).astype(jnp.float32)
+    acc = jnp.mean((pred == batch["label"].astype(jnp.float32)).astype(jnp.float32))
+    return loss, {"accuracy": acc}
+
+
 def loss_fn(params, batch, train=True, dtype=jnp.bfloat16):
     logits = apply(params, batch, dtype)
     loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])

@@ -23,6 +23,17 @@ math runs on the accelerator:
   version. Gradient transport is raw ``float32`` bytes (no pickle): the
   tree structure is derived from ``init_params`` deterministically on every
   node, so only the flat payload crosses the wire.
+* **Sparse embedding tables** (the workload PS actually exists for —
+  reference PS architecture: docs/design-arch.md:5-74 describes pservers
+  holding the sparse CTR embedding shards) are ROW-sharded across pservers
+  by ``id % n_servers``. Trainers ``sparse_pull(ids)`` / ``sparse_push(ids,
+  grads)`` only the rows the current batch touches; the server keeps
+  per-row momentum slots and initializes rows LAZILY from a deterministic
+  per-row seed on first touch, so the full table never crosses the wire —
+  per-round traffic scales with touched rows, not table size. The sparse
+  table advances under the same BSP contract as the dense vector (a push
+  must carry the current version; the update applies when every trainer's
+  gradient has arrived), so sparse+dense stay in lockstep round for round.
 
 Role dispatch mirrors the operator contract: ``TRAINING_ROLE=PSERVER``
 serves, ``TRAINING_ROLE=TRAINER`` trains — both through
@@ -86,6 +97,78 @@ def shard_ranges(dim: int, n_shards: int) -> List[Tuple[int, int]]:
 
 
 # ---------------------------------------------------------------------------
+# sparse embedding shard (server side)
+# ---------------------------------------------------------------------------
+
+class SparseTable:
+    """Row-sharded embedding shard with lazy init and per-row momentum.
+
+    Rows materialize on first touch from a deterministic per-row RNG
+    (seeded by (seed, row_id)), so every run — and a restarted pserver fed
+    the same seed — agrees on untouched-row values without any dense init
+    transfer. Optimizer state (momentum) is also per-row and lazy: memory
+    on the server scales with TOUCHED rows, mirroring the wire traffic.
+
+    Not thread-safe by itself: the owning ParamServer serializes access
+    under its condition lock, which also carries the BSP version.
+    """
+
+    def __init__(self, dim: int, seed: int = 0, init_scale: float = 0.01):
+        self.dim = dim
+        self.seed = seed
+        self.init_scale = init_scale
+        self.rows: Dict[int, np.ndarray] = {}
+        self.slots: Dict[int, np.ndarray] = {}
+
+    def row(self, rid: int) -> np.ndarray:
+        r = self.rows.get(rid)
+        if r is None:
+            rng = np.random.default_rng((self.seed, rid))
+            r = (rng.standard_normal(self.dim) * self.init_scale).astype(
+                np.float32)
+            self.rows[rid] = r
+        return r
+
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        if len(ids) == 0:
+            return np.zeros((0, self.dim), np.float32)
+        return np.stack([self.row(int(i)) for i in ids])
+
+    def apply(self, grads_by_worker: List[Tuple[np.ndarray, np.ndarray]],
+              lr: float, momentum: float, n_trainers: int) -> None:
+        """SGD+momentum on exactly the touched rows. Row gradient = sum of
+        per-trainer gradients / n_trainers — identical semantics to the
+        dense vector's mean-across-trainers (a trainer whose batch misses
+        a row contributes an implicit zero), so a sparse PS run stays
+        checkable against a single-process dense run."""
+        acc: Dict[int, np.ndarray] = {}
+        for ids, grads in grads_by_worker:
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                g = acc.get(rid)
+                acc[rid] = grads[i].copy() if g is None else g + grads[i]
+        for rid, gsum in acc.items():
+            g = gsum / float(n_trainers)
+            slot = self.slots.get(rid)
+            slot = g if slot is None else momentum * slot + g
+            self.slots[rid] = slot
+            self.rows[rid] = self.row(rid) - lr * slot
+
+
+def _pack_sparse(ids: np.ndarray, rows: np.ndarray) -> bytes:
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    rows = np.ascontiguousarray(rows, dtype=np.float32)
+    return (np.int64(len(ids)).tobytes() + ids.tobytes() + rows.tobytes())
+
+
+def _unpack_sparse(body: bytes, dim: int) -> Tuple[np.ndarray, np.ndarray]:
+    n = int(np.frombuffer(body[:8], dtype=np.int64)[0])
+    ids = np.frombuffer(body[8:8 + 8 * n], dtype=np.int64)
+    rows = np.frombuffer(body[8 + 8 * n:], dtype=np.float32).reshape(n, dim)
+    return ids, rows
+
+
+# ---------------------------------------------------------------------------
 # pserver
 # ---------------------------------------------------------------------------
 
@@ -106,17 +189,46 @@ class ParamServer:
                                      (so pserver pods exit and the job can
                                      reach Completed)
       POST /shutdown              -> stop serving unconditionally
+
+    Sparse-table extension (enabled by ``sparse_dim > 0``; same BSP
+    contract, own version counter so a dense-only round and a sparse round
+    release independently but advance in lockstep when the trainer loop
+    drives both once per round):
+      GET  /sparse/meta           -> JSON {version, dim, rows_resident}
+      POST /sparse/pull?after=N   -> body = int64 ids; long-poll until
+                                     sparse version > N, then X-Version +
+                                     fp32 rows [n_ids, dim]
+      POST /sparse/push?worker=i&version=V
+                                  -> body = n|ids|row-grads; when all
+                                     n_trainers arrive: per-row update,
+                                     sparse version += 1, pulls release
     """
 
     def __init__(self, n_trainers: int, lr: float = 0.1,
                  momentum: float = 0.9, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, sparse_dim: int = 0, sparse_seed: int = 0,
+                 sparse_init_scale: float = 0.01):
         self.n_trainers = n_trainers
         self.lr, self.momentum = lr, momentum
         self._vec: Optional[np.ndarray] = None
         self._slot: Optional[np.ndarray] = None  # momentum buffer
         self.version = 0
         self._grads: Dict[int, np.ndarray] = {}
+        # worker -> last version whose push was ACCEPTED (per plane).
+        # Client connection-retries re-send POSTs; a push that was already
+        # counted before the connection dropped must be acked 200 (not
+        # 409-stale), or the retry desynchronizes the BSP barrier: the
+        # trainer would recompute and push AGAIN into the next round,
+        # running one round ahead of the fleet forever.
+        self._acked: Dict[int, int] = {}
+        self._sacked: Dict[int, int] = {}
+        # sparse shard: rows exist implicitly (lazy init), so version
+        # starts live at 1 — there is no dense init transfer to wait for
+        self.sparse = (SparseTable(sparse_dim, sparse_seed,
+                                   sparse_init_scale)
+                       if sparse_dim > 0 else None)
+        self.sparse_version = 1
+        self._sgrads: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         self._done: set = set()
         self._cond = threading.Condition()
         self._httpd = ThreadingHTTPServer((host, port), self._handler())
@@ -171,6 +283,18 @@ class ParamServer:
 
             def do_GET(self):
                 s = server_self
+                if self.path.startswith("/sparse/meta"):
+                    with s._cond:
+                        body = json.dumps({
+                            "version": s.sparse_version,
+                            "dim": 0 if s.sparse is None else s.sparse.dim,
+                            "rows_resident": (
+                                0 if s.sparse is None
+                                else len(s.sparse.rows)),
+                        }).encode()
+                    self._send(200, body,
+                               [("Content-Type", "application/json")])
+                    return
                 if self.path.startswith("/meta"):
                     with s._cond:
                         body = json.dumps({
@@ -202,6 +326,46 @@ class ParamServer:
                 s = server_self
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
+                if self.path.startswith("/sparse/pull"):
+                    after = 0
+                    if "after=" in self.path:
+                        after = int(self.path.split("after=")[1].split("&")[0])
+                    ids = np.frombuffer(body, dtype=np.int64)
+                    with s._cond:
+                        ok = s._cond.wait_for(
+                            lambda: s.sparse is not None
+                            and s.sparse_version > after,
+                            timeout=30.0)
+                        if not ok:
+                            self._send(408)
+                            return
+                        rows = s.sparse.gather(ids)
+                        ver = s.sparse_version
+                    self._send(200, rows.tobytes(),
+                               [("X-Version", str(ver))])
+                    return
+                if self.path.startswith("/sparse/push"):
+                    q = dict(kv.split("=") for kv in
+                             self.path.split("?", 1)[1].split("&"))
+                    worker, ver = int(q["worker"]), int(q["version"])
+                    ids, grads = _unpack_sparse(body, s.sparse.dim)
+                    with s._cond:
+                        if ver != s.sparse_version:
+                            if s._sacked.get(worker) == ver:
+                                self._send(200)  # duplicate re-send of an
+                                return           # already-counted push
+                            self._send(409)  # stale round, same as dense
+                            return
+                        s._sacked[worker] = ver
+                        s._sgrads[worker] = (ids, grads)
+                        if len(s._sgrads) >= s.n_trainers:
+                            s.sparse.apply(list(s._sgrads.values()),
+                                           s.lr, s.momentum, s.n_trainers)
+                            s._sgrads.clear()
+                            s.sparse_version += 1
+                            s._cond.notify_all()
+                    self._send(200)
+                    return
                 if self.path.startswith("/init"):
                     vec = np.frombuffer(body, dtype=np.float32).copy()
                     with s._cond:
@@ -218,10 +382,14 @@ class ParamServer:
                     grad = np.frombuffer(body, dtype=np.float32)
                     with s._cond:
                         if ver != s.version:
+                            if s._acked.get(worker) == ver:
+                                self._send(200)  # duplicate re-send of an
+                                return           # already-counted push
                             # stale push (BSP: only current-version grads
                             # count); trainer re-pulls and recomputes
                             self._send(409)
                             return
+                        s._acked[worker] = ver
                         s._grads[worker] = grad
                         if len(s._grads) >= s.n_trainers:
                             s._apply()
@@ -253,45 +421,79 @@ class ParamServer:
 # ---------------------------------------------------------------------------
 
 class PsClient:
-    """Trainer's view of the sharded server fleet."""
+    """Trainer's view of the sharded server fleet.
+
+    ``bytes_sent`` / ``bytes_recv`` count request/response BODY bytes —
+    the traffic the sparse path exists to shrink; tests assert per-round
+    bytes scale with touched rows, not table size.
+    """
 
     def __init__(self, endpoints: List[str], worker_id: int):
         self.urls = ["http://%s" % e for e in endpoints]
         self.worker_id = worker_id
         self.ranges: Optional[List[Tuple[int, int]]] = None
+        self.bytes_sent = 0
+        self.bytes_recv = 0
 
-    def _req(self, url, data=None, timeout=35.0):
-        req = urllib.request.Request(url, data=data, method=(
-            "POST" if data is not None else "GET"))
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return resp.status, resp.read(), dict(resp.headers)
-        except urllib.error.HTTPError as e:
-            return e.code, e.read(), dict(e.headers)
+    def _req(self, url, data=None, timeout=35.0, retry_s=60.0):
+        """One HTTP round trip. HTTP errors are returned as (code, ...) for
+        the caller's protocol logic; CONNECTION-level failures (refused —
+        a pserver pod not yet listening when a released trainer fires
+        /init; reset — a pserver restart mid-job) are retried with backoff
+        for up to ``retry_s`` before propagating, so a transient does not
+        cost the whole training cycle to restartPolicy=OnFailure."""
+        t0 = time.monotonic()
+        delay = 0.2
+        while True:
+            req = urllib.request.Request(url, data=data, method=(
+                "POST" if data is not None else "GET"))
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    body = resp.read()
+                    self.bytes_sent += len(data) if data else 0
+                    self.bytes_recv += len(body)
+                    return resp.status, body, dict(resp.headers)
+            except urllib.error.HTTPError as e:
+                body = e.read()
+                self.bytes_sent += len(data) if data else 0
+                self.bytes_recv += len(body)
+                return e.code, body, dict(e.headers)
+            except (urllib.error.URLError, OSError):
+                if time.monotonic() - t0 + delay > retry_s:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
 
     def init(self, vec: np.ndarray) -> None:
         self.ranges = shard_ranges(len(vec), len(self.urls))
         for url, (a, b) in zip(self.urls, self.ranges):
             self._req(url + "/init", vec[a:b].tobytes())
 
+    def _long_poll(self, url: str, data: Optional[bytes], t0: float,
+                   deadline_s: float) -> Tuple[bytes, dict]:
+        """Re-arm a long-poll request until 200. A server-side 408 is just
+        the 30 s poll window expiring (e.g. a straggler trainer still
+        computing its gradient) — keep waiting until `deadline_s` from
+        `t0`; any other status is a server fault, raised as such."""
+        while True:
+            status, body, headers = self._req(url, data)
+            if status == 200:
+                return body, headers
+            if status != 408:
+                raise RuntimeError("poll %s: HTTP %s" % (url, status))
+            if time.monotonic() - t0 > deadline_s:
+                raise TimeoutError(
+                    "poll %s: no new version after %.0fs"
+                    % (url, time.monotonic() - t0))
+
     def pull(self, after: int,
              deadline_s: float = 600.0) -> Tuple[np.ndarray, int]:
-        """Long-poll every shard for version > after. A server-side 408 is
-        just the 30 s poll window expiring (e.g. a straggler trainer still
-        computing its gradient) — re-arm and keep waiting; only the
-        overall deadline turns into an error."""
+        """Long-poll every shard for version > after."""
         t0 = time.monotonic()
         parts, version = [], None
         for url in self.urls:
-            while True:
-                status, body, headers = self._req(
-                    "%s/pull?after=%d" % (url, after))
-                if status == 200:
-                    break
-                if status != 408 or time.monotonic() - t0 > deadline_s:
-                    raise TimeoutError(
-                        "pull from %s: HTTP %s after %.0fs"
-                        % (url, status, time.monotonic() - t0))
+            body, headers = self._long_poll(
+                "%s/pull?after=%d" % (url, after), None, t0, deadline_s)
             parts.append(np.frombuffer(body, dtype=np.float32))
             v = int(headers.get("X-Version", "0"))
             version = v if version is None else min(version, v)
@@ -308,6 +510,55 @@ class PsClient:
                 ok = False  # stale round: caller re-pulls and recomputes
             elif status != 200:
                 raise RuntimeError("push to %s: HTTP %s" % (url, status))
+        return ok
+
+    # -- sparse embedding rows -------------------------------------------
+
+    def _split_ids(self, ids: np.ndarray) -> List[np.ndarray]:
+        """Row-shard by id % n_servers. Returns per-server LOCAL positions
+        into `ids` so pulls reassemble and pushes route grads correctly."""
+        ids = np.asarray(ids, dtype=np.int64)
+        return [np.nonzero(ids % len(self.urls) == k)[0]
+                for k in range(len(self.urls))]
+
+    def sparse_pull(self, ids: np.ndarray, after: int, dim: int,
+                    deadline_s: float = 600.0) -> Tuple[np.ndarray, int]:
+        """Rows for `ids` (any order, duplicates allowed) at a version >
+        `after`, from every owning server. Servers that own none of the
+        ids still participate in the version long-poll — BSP lockstep is
+        fleet-wide, not just where this batch's ids happen to live."""
+        ids = np.asarray(ids, dtype=np.int64)
+        out = np.zeros((len(ids), dim), np.float32)
+        t0 = time.monotonic()
+        version = None
+        for url, pos in zip(self.urls, self._split_ids(ids)):
+            body, headers = self._long_poll(
+                "%s/sparse/pull?after=%d" % (url, after),
+                ids[pos].tobytes(), t0, deadline_s)
+            rows = np.frombuffer(body, dtype=np.float32).reshape(-1, dim)
+            out[pos] = rows
+            v = int(headers.get("X-Version", "0"))
+            version = v if version is None else min(version, v)
+        return out, version
+
+    def sparse_push(self, ids: np.ndarray, grads: np.ndarray,
+                    version: int) -> bool:
+        """True if every shard accepted; False on a stale-version 409.
+        Every server gets a push (possibly with zero rows): the BSP
+        barrier counts trainers, so absence would stall the round."""
+        ids = np.asarray(ids, dtype=np.int64)
+        grads = np.asarray(grads, dtype=np.float32)
+        ok = True
+        for url, pos in zip(self.urls, self._split_ids(ids)):
+            status, _, _ = self._req(
+                "%s/sparse/push?worker=%d&version=%d"
+                % (url, self.worker_id, version),
+                _pack_sparse(ids[pos], grads[pos]))
+            if status == 409:
+                ok = False
+            elif status != 200:
+                raise RuntimeError(
+                    "sparse push to %s: HTTP %s" % (url, status))
         return ok
 
     def done(self) -> None:
@@ -335,12 +586,17 @@ class PsClient:
 @dataclass
 class PsTrainJob:
     init_params: Callable
-    loss_fn: Callable          # (params, batch) -> (loss, metrics)
+    loss_fn: Callable          # dense: (params, batch) -> (loss, metrics)
+    #                            sparse: (params, rows, inv, batch) -> same,
+    #                            where the model's embedding lookup is
+    #                            rows[inv] (rows = pulled unique-id rows)
     make_batch: Callable       # (rng, step) -> batch
     total_steps: int = 10
     lr: float = 0.1
     momentum: float = 0.9
     seed: int = 0
+    embed_dim: int = 0         # >0 enables the sparse embedding path
+    ids_fn: Optional[Callable] = None  # batch -> raw int64 ids (any shape)
 
 
 def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
@@ -364,7 +620,8 @@ def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
             server = ParamServer(
                 n_trainers=cfg.num_workers, lr=job.lr,
                 momentum=job.momentum,
-                host=bind_host or host, port=int(port))
+                host=bind_host or host, port=int(port),
+                sparse_dim=job.embed_dim, sparse_seed=job.seed)
         server.serve_forever()
         return {"role": "PSERVER"}
 
@@ -375,12 +632,17 @@ def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
     client = PsClient(cfg.ps_endpoints, cfg.worker_id)
     client.init(vec0)
 
+    rng = jax.random.PRNGKey(1000 + cfg.worker_id)
+    losses = []
+    if job.embed_dim > 0:
+        result = _train_sparse(job, client, treedef, shapes, rng, losses)
+        client.done()
+        return result
+
     # one jitted evaluation per step: loss and gradient from the same
     # forward pass
     vg_fn = jax.jit(jax.value_and_grad(lambda p, b: job.loss_fn(p, b)[0]))
 
-    rng = jax.random.PRNGKey(1000 + cfg.worker_id)
-    losses = []
     # one full-vector pull per BSP round: the end-of-round barrier pull
     # doubles as the next round's parameter fetch (the vector transfer is
     # the dominant PS-mode cost for CTR models)
@@ -404,3 +666,67 @@ def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
     final = unflatten_params(vec, treedef, shapes)
     return {"role": "TRAINER", "losses": losses, "params": final,
             "version": version}
+
+
+def _pow2ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _train_sparse(job: PsTrainJob, client: PsClient, treedef,
+                  shapes, rng, losses) -> dict:
+    """Sparse-embedding BSP trainer loop: per round, pull only the rows
+    this batch touches, compute grads w.r.t. (dense params, pulled rows),
+    push both under the round's versions. Unique-id counts vary per batch,
+    so rows are padded to a power-of-two bucket — jit compiles once per
+    bucket, not once per batch (pad rows are local zeros; their zero grads
+    are dropped before the push, so padding never crosses the wire)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _loss(p, rows, inv, batch):
+        return job.loss_fn(p, rows, inv, batch)[0]
+
+    vg_fn = jax.jit(jax.value_and_grad(_loss, argnums=(0, 1)))
+
+    vec, version = client.pull(after=0)
+    sver = 0
+    dim = job.embed_dim
+    for step in range(job.total_steps):
+        batch = job.make_batch(jax.random.fold_in(rng, step), step)
+        raw_ids = np.asarray(job.ids_fn(batch), np.int64).ravel()
+        uids, inv = np.unique(raw_ids, return_inverse=True)
+        n = len(uids)
+        cap = _pow2ceil(max(n, 1))
+        rows_real, sver = client.sparse_pull(uids, after=sver, dim=dim)
+        while True:
+            rows = np.zeros((cap, dim), np.float32)
+            rows[:n] = rows_real
+            params = unflatten_params(vec, treedef, shapes)
+            loss, (gparams, grows) = vg_fn(
+                params, jnp.asarray(rows), jnp.asarray(inv), batch)
+            gvec, _, _ = flatten_params(gparams)
+            ok_dense = client.push(gvec, version)
+            ok_sparse = client.sparse_push(
+                uids, np.asarray(grows)[:n], sver)
+            if ok_dense and ok_sparse:
+                break
+            # stale round (another BSP round completed while we computed):
+            # re-pull BOTH planes and recompute on fresh state. A half-
+            # accepted push is consumed by that round's barrier on the
+            # accepting plane; re-pushing under the fresh versions below
+            # keeps both planes advancing one round per loop iteration.
+            vec, version = client.pull(after=version)
+            rows_real, sver = client.sparse_pull(uids, after=sver, dim=dim)
+        losses.append(float(loss))
+        # barrier: dense plane applied; this pull is next round's fetch.
+        # The sparse barrier is implicit in the NEXT round's sparse_pull
+        # (after=sver long-polls until the round applies) — no extra trip.
+        vec, version = client.pull(after=version)
+    final = unflatten_params(vec, treedef, shapes)
+    return {"role": "TRAINER", "losses": losses, "params": final,
+            "version": version, "sparse_version": sver,
+            "bytes_sent": client.bytes_sent,
+            "bytes_recv": client.bytes_recv, "client": client}

@@ -76,6 +76,11 @@ class TestCanaryEscalation:
         assert bench._parse_escalation("") == [90.0, 180.0]
         assert bench._parse_escalation("nonsense") == [90.0, 180.0]
         assert bench._parse_escalation(" 60 , 120 ") == [60.0, 120.0]
+        # non-positive deadlines would TERM the child the instant it
+        # enters backend_init — the exact mid-claim kill that wedges the
+        # relay; they must be dropped
+        assert bench._parse_escalation("90,-180") == [90.0]
+        assert bench._parse_escalation("0,0") == [90.0, 180.0]
 
     def test_escalation_sequence_over_a_full_budget(self):
         """Simulate the exact round-4 failure shape — relay never answers,

@@ -158,3 +158,256 @@ def test_shard_ranges_cover_and_partition():
         assert ranges[0][0] == 0 and ranges[-1][1] == dim
         for (a, b), (c, d) in zip(ranges, ranges[1:]):
             assert b == c and b >= a and d >= c
+
+
+# ---------------------------------------------------------------------------
+# Sparse embedding path (round-4 verdict item 3): per-round traffic must
+# scale with TOUCHED rows, not table size — the CTR workload PS exists for
+# (reference PS architecture: docs/design-arch.md:5-74).
+# ---------------------------------------------------------------------------
+
+# >=100k-row table: 8 slots x 20k vocab = 160k rows of width embed_dim+1
+SPARSE_CFG = dict(num_slots=8, vocab_per_slot=20000, embed_dim=8,
+                  dense_dim=13, hidden=[16])
+
+
+def _sparse_job(total_steps=4, batch=32, cfg=SPARSE_CFG):
+    return ps.PsTrainJob(
+        init_params=lambda rng: wide_deep.init_dense(rng, cfg),
+        loss_fn=wide_deep.sparse_loss_fn,
+        make_batch=lambda rng, step: wide_deep.synthetic_batch(
+            rng, batch, cfg),
+        ids_fn=lambda b: wide_deep.sparse_ids(b, cfg["vocab_per_slot"]),
+        embed_dim=wide_deep.sparse_row_dim(cfg),
+        total_steps=total_steps, lr=0.1, momentum=0.9,
+    )
+
+
+class TestSparseTableUnit:
+    def test_lazy_rows_deterministic_across_instances(self):
+        a = ps.SparseTable(dim=4, seed=7)
+        b = ps.SparseTable(dim=4, seed=7)
+        np.testing.assert_array_equal(a.row(123), b.row(123))
+        assert not np.array_equal(a.row(123), a.row(124))
+        c = ps.SparseTable(dim=4, seed=8)
+        assert not np.array_equal(a.row(123), c.row(123))
+
+    def test_apply_matches_dense_mean_semantics(self):
+        """Row gradient = sum over trainers / n_trainers, momentum SGD —
+        identical to the dense vector's update for a row every trainer
+        touches, implicit-zero for trainers that miss it."""
+        t = ps.SparseTable(dim=2, seed=0)
+        r0 = t.row(5).copy()
+        g_w0 = (np.array([5]), np.array([[1.0, 2.0]], np.float32))
+        g_w1 = (np.array([5]), np.array([[3.0, 4.0]], np.float32))
+        t.apply([g_w0, g_w1], lr=0.1, momentum=0.9, n_trainers=2)
+        g = np.array([2.0, 3.0])  # mean over 2 trainers
+        np.testing.assert_allclose(t.row(5), r0 - 0.1 * g, rtol=1e-6)
+        # second round: momentum engages; a trainer missing the row
+        # contributes an implicit zero
+        r1 = t.row(5).copy()
+        t.apply([(np.array([5]), np.array([[2.0, 2.0]], np.float32)),
+                 (np.array([], np.int64), np.zeros((0, 2), np.float32))],
+                lr=0.1, momentum=0.9, n_trainers=2)
+        slot = 0.9 * g + np.array([1.0, 1.0])  # 2/2 trainers averaged
+        np.testing.assert_allclose(t.row(5), r1 - 0.1 * slot, rtol=1e-6)
+
+    def test_pack_unpack_roundtrip(self):
+        ids = np.array([3, 1, 99], np.int64)
+        rows = np.arange(9, dtype=np.float32).reshape(3, 3)
+        i2, r2 = ps._unpack_sparse(ps._pack_sparse(ids, rows), 3)
+        np.testing.assert_array_equal(i2, ids)
+        np.testing.assert_array_equal(r2, rows)
+        i3, r3 = ps._unpack_sparse(
+            ps._pack_sparse(np.array([], np.int64),
+                            np.zeros((0, 3), np.float32)), 3)
+        assert len(i3) == 0 and r3.shape == (0, 3)
+
+
+def test_sparse_ps_traffic_scales_with_touched_rows_not_table_size():
+    """THE scaling property: per-round wire bytes are a function of the
+    rows the batch touches, independent of table size. Verified two ways:
+    (a) per-round bytes are a small multiple of touched-row payload and
+    far below the table's dense size; (b) growing the table 4x leaves
+    per-round bytes unchanged."""
+    per_round = {}
+    for scale in (1, 4):
+        cfg = dict(SPARSE_CFG, vocab_per_slot=SPARSE_CFG["vocab_per_slot"]
+                   * scale)
+        row_dim = wide_deep.sparse_row_dim(cfg)
+        srv = ps.ParamServer(n_trainers=1, lr=0.1, momentum=0.9,
+                             sparse_dim=row_dim, sparse_seed=0).start()
+        try:
+            import paddle_operator_tpu.launch as launch_mod
+            cfg_l = launch_mod.LaunchConfig(
+                worker_id=0, num_workers=1, role="TRAINER",
+                ps_endpoints=[srv.endpoint])
+            steps = 4
+            res = ps.run_ps_training(_sparse_job(total_steps=steps,
+                                                 cfg=cfg), cfg_l)
+        finally:
+            srv.stop()
+        assert len(res["losses"]) == steps
+        assert all(np.isfinite(res["losses"]))
+        total_rows = cfg["num_slots"] * cfg["vocab_per_slot"]
+        table_bytes = total_rows * row_dim * 4
+        assert total_rows >= 100_000
+        per_round[scale] = (res["bytes_sent"] + res["bytes_recv"]) / steps
+        # (a) touched rows per round <= 32 batch * 8 slots = 256 unique;
+        # payload bounded by pull-req ids + pull rows + push ids+grads +
+        # the (small) dense MLP vector both ways, with generous slack for
+        # HTTP re-pulls — and still orders of magnitude under the table
+        touched_payload = 256 * (8 + row_dim * 4) * 2
+        dense_vec_bytes = sum(
+            int(np.prod(s)) for s, _ in ps.flatten_params(
+                wide_deep.init_dense(
+                    __import__("jax").random.PRNGKey(0), cfg))[2]) * 4
+        bound = 4 * (touched_payload + 3 * dense_vec_bytes)
+        assert per_round[scale] < bound, (per_round[scale], bound)
+        assert per_round[scale] < table_bytes / 50, (
+            per_round[scale], table_bytes)
+    # (b) a 4x larger table moves per-round traffic by < 5%
+    assert abs(per_round[4] - per_round[1]) / per_round[1] < 0.05, per_round
+
+
+def test_sparse_ps_two_trainers_bsp_identical_and_learns():
+    """2 pservers x 2 trainers on a 160k-row table: BSP bit-identical
+    dense params AND embedding rows across trainers, decreasing loss,
+    server residency scaling with touched rows only."""
+    row_dim = wide_deep.sparse_row_dim(SPARSE_CFG)
+    servers = [ps.ParamServer(n_trainers=2, lr=0.1, momentum=0.9,
+                              sparse_dim=row_dim, sparse_seed=0).start()
+               for _ in range(2)]
+    eps = [s.endpoint for s in servers]
+    import paddle_operator_tpu.launch as launch_mod
+
+    steps = 6
+    job = _sparse_job(total_steps=steps)
+    results, errors = {}, []
+
+    def trainer(idx):
+        try:
+            cfg_l = launch_mod.LaunchConfig(
+                worker_id=idx, num_workers=2, role="TRAINER",
+                ps_endpoints=eps)
+            results[idx] = ps.run_ps_training(job, cfg_l)
+        except Exception as e:
+            errors.append((idx, repr(e)))
+
+    threads = [threading.Thread(target=trainer, args=(i,)) for i in (0, 1)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads), "trainers hung"
+        assert not errors, errors
+
+        # dense params bit-identical (BSP contract)
+        p0, _, _ = ps.flatten_params(results[0]["params"])
+        p1, _, _ = ps.flatten_params(results[1]["params"])
+        np.testing.assert_array_equal(p0, p1)
+
+        # the sparse rounds advanced in lockstep with the dense rounds:
+        # one sparse version per BSP round on both trainers (the cursor
+        # reads the version seen at the LAST pull — the final round's
+        # apply happens server-side after it)
+        assert (results[0]["sparse_version"]
+                == results[1]["sparse_version"] == steps)
+        for s in servers:
+            assert s.sparse_version == steps + 1
+
+        # trained rows live on the servers (post-shutdown state is still
+        # readable in-process) and are finite
+        for s in servers:
+            for r in list(s.sparse.rows.values())[:16]:
+                assert np.all(np.isfinite(r))
+
+        # learning happened
+        mean_first = np.mean([results[i]["losses"][0] for i in (0, 1)])
+        mean_last = np.mean([results[i]["losses"][-1] for i in (0, 1)])
+        assert mean_last < mean_first, (mean_first, mean_last)
+
+        # server-side memory scales with touched rows, not table size:
+        # <= steps * trainers * 256 unique ids resident, of 160k total
+        resident = sum(len(s.sparse.rows) for s in servers)
+        assert 0 < resident <= steps * 2 * 256, resident
+        assert resident < 160_000 / 10
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_duplicate_push_resend_is_acked_not_stale():
+    """Review finding: _req connection-retry re-sends POSTs; a push that
+    was counted before the connection dropped must be acked 200 on
+    re-send — a 409 would make the trainer recompute and push AGAIN,
+    running one BSP round ahead of the fleet forever."""
+    srv = ps.ParamServer(n_trainers=1, lr=0.1, momentum=0.0,
+                         sparse_dim=2, sparse_seed=0).start()
+    try:
+        c = ps.PsClient([srv.endpoint], worker_id=0)
+        c.init(np.zeros(4, np.float32))
+        _, version = c.pull(after=0)
+
+        # dense: push applies the round (n_trainers=1) and advances the
+        # version; an identical re-send must be acked, not rejected
+        g = np.ones(4, np.float32)
+        assert c.push(g, version) is True
+        assert srv.version == version + 1
+        assert c.push(g, version) is True      # duplicate re-send
+        assert srv.version == version + 1      # round NOT double-applied
+        vec, _ = c.pull(after=version)
+        np.testing.assert_allclose(vec, -0.1 * g)  # one SGD step only
+
+        # sparse: same contract
+        ids = np.array([3], np.int64)
+        rows0, sver = c.sparse_pull(ids, after=0, dim=2)
+        gr = np.ones((1, 2), np.float32)
+        assert c.sparse_push(ids, gr, sver) is True
+        assert srv.sparse_version == sver + 1
+        assert c.sparse_push(ids, gr, sver) is True  # duplicate re-send
+        assert srv.sparse_version == sver + 1
+        rows1, _ = c.sparse_pull(ids, after=sver, dim=2)
+        np.testing.assert_allclose(rows1, rows0 - 0.1 * gr, rtol=1e-6)
+
+        # a genuinely different stale push (not this worker's last acked
+        # version) still 409s
+        assert c.push(g, version - 1) is False
+    finally:
+        srv.stop()
+
+
+def test_ps_client_retries_connection_refused_until_server_up():
+    """Advisor fix: connection-level failures (pserver pod not yet
+    listening when a released trainer fires) retry with backoff inside
+    the call deadline instead of crashing the trainer."""
+    import socket
+
+    # reserve a port, then release it for the late-starting server
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    srv_box = {}
+
+    def late_start():
+        import time as _t
+        _t.sleep(1.0)
+        srv_box["s"] = ps.ParamServer(n_trainers=1, port=port).start()
+
+    t = threading.Thread(target=late_start, daemon=True)
+    t.start()
+    client = ps.PsClient(["127.0.0.1:%d" % port], worker_id=0)
+    try:
+        # fires immediately -> connection refused -> retried until the
+        # server comes up (well inside the 60s default retry budget)
+        client.init(np.ones(8, np.float32))
+        vec, version = client.pull(after=0)
+        np.testing.assert_array_equal(vec, np.ones(8, np.float32))
+        assert version == 1
+    finally:
+        t.join(timeout=5)
+        if "s" in srv_box:
+            srv_box["s"].stop()
