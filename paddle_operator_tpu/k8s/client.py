@@ -23,8 +23,8 @@ import urllib.request
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from .errors import (
-    AlreadyExistsError, ApiError, ConflictError, GoneError, NetworkError,
-    NotFoundError, UnauthorizedError,
+    AlreadyExistsError, ApiError, ConflictError, GoneError, InvalidError,
+    NetworkError, NotFoundError, UnauthorizedError,
 )
 
 # kind -> (api prefix, plural).  Core v1 kinds plus the CRDs we manage.
@@ -166,6 +166,8 @@ def _map_http_error(e: "urllib.error.HTTPError") -> ApiError:
         return ConflictError(msg)
     if e.code == 410:
         return GoneError(msg)
+    if e.code == 422:
+        return InvalidError(msg)  # admission/schema rejection
     err = ApiError(msg)
     err.code = e.code
     return err

@@ -16,6 +16,7 @@ shape (e.g. the informer cache performing ZERO lists at steady state).
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -50,6 +51,12 @@ class StubApiServer:
         self.exec_handler = None
         self.exec_calls: List[Tuple[str, str, str, tuple]] = []
         self.fragment_exec_frames = False  # test RFC6455 reassembly
+        # ValidatingWebhookConfiguration analog: registered webhooks are
+        # called over REAL HTTP(S) before persistence, like an apiserver
+        # honoring a webhook's caBundle (TLS verification is skipped —
+        # the trust anchor is the registration itself, as with caBundle)
+        self._admission: List[dict] = []
+        self._admission_uid = itertools.count(1)  # thread-safe under GIL
         self._plurals: Dict[str, str] = dict(_BUILTIN_PLURALS)
         # watch history: (seq, etype, obj). seq is the global rv counter;
         # DELETED events get a fresh seq (real apiservers bump rv on delete)
@@ -112,6 +119,85 @@ class StubApiServer:
     def clear_requests(self) -> None:
         self.requests.clear()
 
+    # -- admission ---------------------------------------------------------
+
+    def register_admission_webhook(
+            self, url: str, kinds: Tuple[str, ...],
+            operations: Tuple[str, ...] = ("CREATE", "UPDATE"),
+            failure_policy: str = "Fail") -> None:
+        """Point this apiserver at a validating webhook (the
+        ValidatingWebhookConfiguration analog). Matching CREATE/UPDATE
+        requests are wrapped in an admission.k8s.io/v1 AdmissionReview and
+        POSTed to `url` over real HTTP(S) BEFORE any store mutation; a
+        deny response surfaces as 422 Invalid and nothing persists.
+        failure_policy: "Fail" -> unreachable webhook rejects the write
+        (500), "Ignore" -> proceeds without admission."""
+        unsupported = set(operations) - {"CREATE", "UPDATE"}
+        if unsupported:
+            # only POST/PUT dispatch through _admit; accepting e.g.
+            # DELETE here would register a webhook that silently never
+            # fires — fail loudly at registration instead
+            raise ValueError(
+                "unsupported admission operations %s (the stub dispatches "
+                "CREATE and UPDATE only)" % sorted(unsupported))
+        self._admission.append({
+            "url": url, "kinds": tuple(kinds),
+            "operations": tuple(operations),
+            "failure_policy": failure_policy,
+        })
+
+    def clear_admission_webhooks(self) -> None:
+        self._admission.clear()
+
+    def _admit(self, operation: str, kind: str, obj: dict,
+               old: Optional[dict]) -> None:
+        """Run every matching webhook; raise ApiError to refuse the write."""
+        import ssl
+        import urllib.request
+
+        for wh in self._admission:
+            if kind not in wh["kinds"] or operation not in wh["operations"]:
+                continue
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": "admission-%d" % next(self._admission_uid),
+                    "operation": operation,
+                    "kind": {"kind": kind},
+                    "namespace": obj.get("metadata", {}).get("namespace"),
+                    "name": obj.get("metadata", {}).get("name"),
+                    "object": obj,
+                    "oldObject": old,
+                },
+            }
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE  # trust = registration (caBundle)
+            try:
+                req = urllib.request.Request(
+                    wh["url"], data=json.dumps(review).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10,
+                                            context=ctx) as resp:
+                    out = json.loads(resp.read())
+            except Exception as e:
+                if wh["failure_policy"] == "Ignore":
+                    continue
+                err = ApiError(
+                    "failed calling webhook %s: %r (failurePolicy=Fail)"
+                    % (wh["url"], e))
+                err.reason = "InternalError"
+                raise err
+            response = out.get("response") or {}
+            if not response.get("allowed"):
+                status = response.get("status") or {}
+                err = ApiError(status.get("message", "admission denied"))
+                err.code = int(status.get("code", 422))
+                err.reason = "Invalid"
+                raise err
+
     def inject_error_event(self, code: int = 410, reason: str = "Expired",
                            message: str = "injected") -> None:
         """Append an in-stream ERROR event (how real apiservers report an
@@ -165,12 +251,23 @@ class StubApiServer:
                 self._send_json(req, 200, self.store.get(kind, namespace, name))
             elif method == "POST":
                 obj = self._read_body(req)
+                self._admit("CREATE", kind, obj, None)
                 self._send_json(req, 201, self.store.create(obj))
             elif method == "PUT" and subresource == "status":
+                # status subresource is admission-exempt (production
+                # parity: webhooks register rules on the main resource;
+                # the operator's own status writes must never be gated)
                 obj = self._read_body(req)
                 self._send_json(req, 200, self.store.update_status(obj))
             elif method == "PUT":
                 obj = self._read_body(req)
+                if self._admission:
+                    try:
+                        old = self.store.get(kind, namespace, name)
+                    except ApiError:
+                        old = None  # store.update raises the 404 below
+                    if old is not None:
+                        self._admit("UPDATE", kind, obj, old)
                 self._send_json(req, 200, self.store.update(obj))
             elif method == "DELETE":
                 self._read_body(req)  # DeleteOptions: accepted, ignored
