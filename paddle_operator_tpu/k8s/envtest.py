@@ -140,6 +140,10 @@ class StubApiServer:
             raise ValueError(
                 "unsupported admission operations %s (the stub dispatches "
                 "CREATE and UPDATE only)" % sorted(unsupported))
+        if failure_policy not in ("Fail", "Ignore"):
+            raise ValueError(
+                "failure_policy must be 'Fail' or 'Ignore', got %r"
+                % (failure_policy,))
         self._admission.append({
             "url": url, "kinds": tuple(kinds),
             "operations": tuple(operations),

@@ -193,10 +193,36 @@ def build_train_step(
         out_shardings=(state_sh, None),
         donate_argnums=0,
     )
+    if jax.process_count() > 1:
+        # Multi-host: a host-local numpy/device batch cannot feed a jit
+        # whose in_shardings span non-addressable devices ("passing
+        # non-trivial shardings for numpy inputs is not allowed"). The
+        # contract stays "make_batch returns the GLOBAL batch, identical
+        # on every host" (same folded rng everywhere); each process
+        # assembles the global jax.Array by materializing ONLY the blocks
+        # its own devices hold — no cross-host transfer.
+        step_fn = _globalize_batches(step_fn, batch_sh)
     if not init_state:
         return step_fn, None
     state = jax.device_put(state, state_sh)
     return step_fn, state
+
+
+def _globalize_batches(step_fn, batch_sh):
+    import numpy as np
+
+    def to_global(leaf, sh):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return leaf  # already a global array
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx: arr[idx])
+
+    def wrapped(state, batch):
+        batch = jax.tree_util.tree_map(to_global, batch, batch_sh)
+        return step_fn(state, batch)
+
+    return wrapped
 
 
 def build_eval_step(loss_fn: Callable, mesh: Optional[Mesh] = None):

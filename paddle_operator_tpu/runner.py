@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distributed
 from .ops.optim import Optimizer
@@ -144,9 +145,8 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
 
         from jax.experimental import multihost_utils
 
-        import numpy as np
-
-        def agreed() -> bool:  # pragma: no cover - needs real multihost
+        def agreed() -> bool:  # covered by tests/test_multihost_ckpt.py
+            # (2 real processes), which pytest-cov cannot see
             local = should_stop() if jax.process_index() == 0 else False
             return bool(multihost_utils.broadcast_one_to_all(
                 np.asarray(local)))
@@ -210,6 +210,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                     jax.tree_util.tree_map(lambda leaf: leaf.sharding, state),
                 )
             start_step = manifest["step"]
+            result.setdefault("resume_steps", []).append(start_step)
             log.info("restored checkpoint step=%d (epoch %s)",
                      start_step, manifest["meta"].get("epoch"))
 
@@ -228,8 +229,14 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                         job.make_batch(jax.random.fold_in(rng, s), s)
                         for s in range(step, step + K)
                     ]
+                    # multi-host: stack on HOST — a jnp.stack would land
+                    # the window on device only for the globalization
+                    # wrapper to read it all back before re-sharding
+                    stack = (jnp.stack if jax.process_count() == 1
+                             else (lambda ls: np.stack(
+                                 [jax.device_get(x) for x in ls])))
                     stacked = jax.tree_util.tree_map(
-                        lambda *ls: jnp.stack(ls), *window)
+                        lambda *ls: stack(ls), *window)
                     state, metrics = step_fn(state, stacked)
                     # fused metrics come back stacked [K]; report the last
                     metrics = jax.tree_util.tree_map(

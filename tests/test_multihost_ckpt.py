@@ -1,0 +1,189 @@
+"""REAL multi-process sharded checkpointing + the preemption drill across
+two OS processes (round-4 verdict item 6).
+
+The in-process suite runs everything under one jax process, so the
+multi-host code paths (cross-host save barriers, per-process index merge,
+agreed_stop broadcast, host-local batch globalization) were written but
+never executed. Here two subprocesses form a genuine
+``jax.distributed`` world of 2 CPU "hosts" x 4 virtual devices and run
+them for real: a cooperative sharded save/restore, then the full elastic
+preemption cycle — epoch bump mid-training -> both processes stop at the
+same step -> cooperative sharded checkpoint -> whole-slice restart ->
+restore from the sharded index -> completion with loss continuity.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "mh_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(mode, pid, port, ckpt_dir, extra=()):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # strip the axon TPU sitecustomize: these workers must be pure CPU
+    env["PYTHONPATH"] = REPO
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        [sys.executable, WORKER, "--mode", mode,
+         "--coordinator", "localhost:%d" % port,
+         "--pid", str(pid), "--ckpt-dir", ckpt_dir, *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+
+
+def _finish(procs, timeout=240):
+    outs = []
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        left = max(5, deadline - time.monotonic())
+        try:
+            out, err = p.communicate(timeout=left)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("multihost worker timed out")
+        assert p.returncode == 0, (
+            "worker failed rc=%s\nstderr tail:\n%s"
+            % (p.returncode, err[-3000:]))
+        outs.append(json.loads(
+            [ln for ln in out.splitlines() if ln.startswith("{")][-1]))
+    return outs
+
+
+@pytest.mark.slow
+def test_sharded_checkpoint_across_two_real_processes(tmp_path):
+    """Two processes cooperatively write one sharded checkpoint (each only
+    its own devices' blocks), p0 merges the index partials, and both
+    restore their blocks back — the multi-host paths in
+    utils/checkpoint.py run for real."""
+    port = _free_port()
+    procs = [_spawn("save", i, port, str(tmp_path)) for i in (0, 1)]
+    outs = _finish(procs)
+    assert all(o["ok"] for o in outs)
+    assert all(o["local_devices"] == 4 for o in outs)
+
+    # on-disk shape: one merged index covering shards from BOTH processes'
+    # devices (ids 0-3 from p0, 4-7 from p1), one manifest, sharded format
+    step_dir = tmp_path / ("step_%012d" % 7)
+    with open(step_dir / "manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["format"] == "sharded"
+    with open(step_dir / "shards.json") as f:
+        index = json.load(f)
+    w_shards = index["params/w"]["shards"]
+    # 8 distinct device shards (device ids are namespaced per process —
+    # p1's start at 2048 — so count, don't enumerate), disjointly tiling
+    # all 16 rows
+    assert len({e["file"] for e in w_shards}) == 8, w_shards
+    rows = sorted((e["slices"][0][0], e["slices"][0][1]) for e in w_shards)
+    assert rows == [(i * 2, i * 2 + 2) for i in range(8)], rows
+    assert not list(step_dir.glob("index.p*.json")), "partials not merged"
+
+    # a single-process reader (this pytest process, 8 local devices)
+    # restores the full state from the same sharded index
+    import numpy as np
+    from paddle_operator_tpu.utils.checkpoint import restore_checkpoint
+
+    state, manifest2 = restore_checkpoint(str(tmp_path), step=7)
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["w"]),
+        np.arange(64, dtype=np.float32).reshape(16, 4))
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["b"]),
+        np.arange(4, dtype=np.float32) * 10.0)
+
+
+@pytest.mark.slow
+def test_preemption_restart_with_sharded_checkpoint_two_processes(tmp_path):
+    """The whole-slice restart drill across a REAL 2-process world:
+    mid-training epoch bump (as the reconciler's preemption handler
+    writes) -> agreed stop at the same step on both hosts -> cooperative
+    sharded save -> both restart -> restore from the sharded index ->
+    run to completion. Loss continuity: the post-restart run must
+    continue improving from the checkpoint, not restart from scratch."""
+    from paddle_operator_tpu.elastic.server import MembershipServer
+    from paddle_operator_tpu.elastic.store import connect as kv_connect
+    from paddle_operator_tpu.elastic.sync import epoch_key, np_key
+
+    total_steps = 12
+    with MembershipServer() as server:
+        store = kv_connect(server.endpoint)
+        store.put(np_key("default", "mhdrill"), "2")
+        store.put(epoch_key("default", "mhdrill"), "1")
+
+        port = _free_port()
+        procs = [_spawn("drill", i, port, str(tmp_path),
+                        extra=("--elastic-server", server.endpoint,
+                               "--job-id", "default-mhdrill",
+                               "--total-steps", str(total_steps)))
+                 for i in (0, 1)]
+
+        # preempt once training is demonstrably underway: the first
+        # periodic sharded checkpoint (step 3) has been published
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (tmp_path / ("step_%012d" % 3) / "manifest.json").exists():
+                break
+            if any(p.poll() is not None for p in procs):
+                break  # finished/crashed early: _finish reports it
+            time.sleep(0.05)
+        else:
+            for p in procs:
+                p.kill()
+            raise AssertionError("no checkpoint appeared within 120s")
+        store.put(epoch_key("default", "mhdrill"), "2")  # whole-slice restart
+
+        outs = _finish(procs)
+
+    by_pid = {o["pid"]: o for o in outs}
+    assert set(by_pid) == {0, 1}
+    for o in outs:
+        # interrupted exactly once, resumed (not restarted from step 0),
+        # and finished the full run on the 8-device dp mesh both cycles
+        assert o["cycles"] == 2, o
+        assert o["steps"] == total_steps, o
+        assert o["mesh_history"] == [{"dp": 8}, {"dp": 8}], o
+    # BSP determinism: both processes report the identical final loss
+    assert by_pid[0]["loss"] == by_pid[1]["loss"], outs
+    assert 0.0 <= by_pid[0]["loss"] < 1.0
+
+    # CONTINUITY: cycle 1 started fresh (no restore), cycle 2 restored
+    # the interrupt checkpoint — not step 0 — on BOTH processes. The
+    # restore's value-correctness is proven by the save-mode test; this
+    # proves the drill actually trained on from the restored step.
+    for o in outs:
+        assert len(o["resume_steps"]) == 1, o
+        assert o["resume_steps"][0] >= 3, o
+    assert by_pid[0]["resume_steps"] == by_pid[1]["resume_steps"], outs
+
+    # the final checkpoint on disk is sharded format with shards from
+    # both processes
+    from paddle_operator_tpu.utils.checkpoint import (
+        latest_step, read_manifest)
+
+    last = latest_step(str(tmp_path))
+    assert last is not None
+    assert read_manifest(str(tmp_path), last)["format"] == "sharded"
+    step_dir = tmp_path / ("step_%012d" % last)
+    with open(step_dir / "shards.json") as f:
+        index = json.load(f)
+    w1_files = sorted(e["file"] for e in index["params/w1"]["shards"])
+    assert len(w1_files) == 8, w1_files  # every device wrote its block
