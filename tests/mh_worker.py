@@ -112,9 +112,17 @@ def run_drill(args):
         pred = (h @ params["w2"])[:, 0]
         return jnp.mean((pred - batch["y"]) ** 2), {}
 
+    import time as _time
+
     def make_batch(rng, step):
         # GLOBAL batch, identical on every host (same folded rng);
         # build_train_step materializes only this host's blocks
+        if step >= 4:
+            # hold the cycle open past the first checkpoint: the driver
+            # test bumps the epoch after step-3's manifest appears, and
+            # sub-millisecond steps must not race past the bump's
+            # propagation (store poll 0.05s + broadcast)
+            _time.sleep(0.05)
         x = jax.random.normal(jax.random.fold_in(rng, step), (32, 16))
         y = jnp.sin(x.sum(axis=1))
         return {"x": np.asarray(x), "y": np.asarray(y)}
