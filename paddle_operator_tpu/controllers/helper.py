@@ -181,6 +181,66 @@ def preemption_budget_exhausted(job: api.TpuJob) -> bool:
         preemption_budget(job)
 
 
+# App-crash restarts get a separate, much smaller budget: a preempted
+# TPU-VM deserves 10 patient whole-slice restarts, but a container that
+# EXITS non-zero on its own (bad config, OOM-killed app, import error)
+# is usually deterministic — burning 10 restarts plus checkpoint
+# restores on it delays the terminal Failed the user needs to see.
+MAX_APP_FAILURE_RESTARTS = 3
+ANNOT_MAX_APP_RESTARTS = "batch.tpujob.dev/max-app-failure-restarts"
+
+# Pod status.reason values that mean the NODE/system killed the pod —
+# the preemption/eviction family, never the app's own doing.
+_EVICTION_REASONS = {
+    "Evicted", "Preempted", "Shutdown", "NodeShutdown", "NodeLost",
+    "NodeAffinity", "UnexpectedAdmissionError", "Terminated",
+}
+
+
+def classify_pod_failure(pod: dict) -> str:
+    """``"preemption"`` (external kill) vs ``"app"`` (the container itself
+    failed). Eviction-family status reasons and SIGKILL/SIGTERM exit codes
+    (137/143 — the external kill signature) are preemption-like; a
+    container that terminated with any other non-zero exit chose to die.
+    No evidence at all (node vanished before the kubelet reported) stays
+    permissive: preemption."""
+    st = pod.get("status") or {}
+    if (st.get("reason") or "") in _EVICTION_REASONS:
+        return "preemption"
+    app_evidence = False
+    for cs in st.get("containerStatuses") or []:
+        for state_key in ("state", "lastState"):
+            term = (cs.get(state_key) or {}).get("terminated")
+            if term is None or term.get("exitCode") is None:
+                continue
+            code = int(term["exitCode"])
+            # the kubelet's OOMKilled also exits 137, but it is the
+            # APP exceeding its own memory limit — deterministic, not
+            # an external preemption
+            if term.get("reason") == "OOMKilled":
+                app_evidence = True
+            elif code not in (0, 137, 143):
+                app_evidence = True
+            break
+    return "app" if app_evidence else "preemption"
+
+
+def app_failure_budget(job: api.TpuJob) -> int:
+    ann = (job.metadata.get("annotations") or {}).get(ANNOT_MAX_APP_RESTARTS)
+    try:
+        return int(ann) if ann is not None else MAX_APP_FAILURE_RESTARTS
+    except ValueError:
+        return MAX_APP_FAILURE_RESTARTS
+
+
+def restart_budget_exhausted(job: api.TpuJob) -> bool:
+    """Either budget spent ends the restarting: the phase machine answers
+    terminal Failed instead of Restarting."""
+    return (preemption_budget_exhausted(job)
+            or int(job.status.get("appFailureRestarts") or 0)
+            >= app_failure_budget(job))
+
+
 def get_job_phase(job: api.TpuJob) -> str:
     """Sticky-final phase derivation, identical semantics to the reference."""
     if job.phase == api.Phase.COMPLETED:
@@ -198,7 +258,7 @@ def get_job_phase(job: api.TpuJob) -> str:
         # deterministically-crashing container would restart the slice
         # forever, so a restart budget bounds it: past the budget the
         # failure is treated as real and the job fails terminally.
-        if job.elastic is not None and not preemption_budget_exhausted(job):
+        if job.elastic is not None and not restart_budget_exhausted(job):
             return api.Phase.RESTARTING
         return api.Phase.FAILED
     if any(is_starting(s) for s in statuses.values()):

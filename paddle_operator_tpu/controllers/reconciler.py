@@ -256,8 +256,8 @@ class TpuJobReconciler:
         failed = [p for p in child_pods if k8s.pod_phase(p) == "Failed"]
         if not failed:
             return None
-        if helper.preemption_budget_exhausted(job):
-            # budget spent: get_job_phase has gone terminal Failed — let
+        if helper.restart_budget_exhausted(job):
+            # a budget spent: get_job_phase has gone terminal Failed — let
             # the clean-pod-policy path own the wreckage, don't restart
             return None
         fresh = [p for p in failed
@@ -281,28 +281,53 @@ class TpuJobReconciler:
         # resourceVersion is stale once the status-sync update above has
         # landed, so updating it again would conflict every time and the
         # budget would never count.
-        try:
-            cur = self.client.get(api.KIND, job.namespace, job.name)
-            count = int(cur.get("status", {})
-                        .get("preemptionRestarts") or 0) + 1
-            cur.setdefault("status", {})["preemptionRestarts"] = count
-            self.client.update_status(cur)
-            job.status["preemptionRestarts"] = count
-        except (ConflictError, NotFoundError):
-            # best-effort: a conflict loses this increment, erring on the
-            # permissive side of the budget; the next incident re-counts
-            # from the persisted value
-            job.status["preemptionRestarts"] = (
-                int(job.status.get("preemptionRestarts") or 0) + 1)
+        # Classify the incident: a container that exited non-zero on its
+        # own counts against the (much smaller) app-failure budget, not
+        # the preemption budget — a deterministic crash must not get 10
+        # patient whole-slice restarts (advisor round-4). ALL fresh pods
+        # must look app-crashed: during a real eviction the SURVIVORS
+        # crash out of their dead collectives with app-looking exits, so
+        # any eviction evidence in the batch marks the whole incident
+        # preemption.
+        incident_app = all(helper.classify_pod_failure(p) == "app"
+                           for p in fresh)
+        field = "appFailureRestarts" if incident_app else "preemptionRestarts"
+        budget = (helper.app_failure_budget(job) if incident_app
+                  else helper.preemption_budget(job))
+        # Bounded retry with a fresh GET per attempt: a lost increment
+        # under persistent status-update conflicts would let a
+        # deterministically-crashing container restart the slice past the
+        # intended budget (every pass re-reading the stale persisted
+        # count) — the budget must count durably, not best-effort.
+        persisted = False
+        for _attempt in range(4):
+            try:
+                cur = self.client.get(api.KIND, job.namespace, job.name)
+                count = int(cur.get("status", {}).get(field) or 0) + 1
+                cur.setdefault("status", {})[field] = count
+                self.client.update_status(cur)
+                job.status[field] = count
+                persisted = True
+                break
+            except ConflictError:
+                continue  # re-GET picks up the new resourceVersion
+            except NotFoundError:
+                break  # job deleted mid-incident: nothing to count against
+        if not persisted:
+            # still conflicting after retries: count in-memory so THIS
+            # pass's event/budget math is right, and requeue — the next
+            # pass re-reads the persisted value and the epoch-bump dedup
+            # (pods already deleting) prevents a double restart
+            job.status[field] = int(job.status.get(field) or 0) + 1
         self.recorder.event(
             job.obj, "Warning", "PreemptionRestart",
-            "%d pod(s) failed (%s); deleted for recreate%s (restart %d/%d)"
+            "%d pod(s) failed (%s, %s); deleted for recreate%s (%s %d/%d)"
             % (len(fresh),
                ", ".join(p["metadata"]["name"] for p in fresh),
+               "app crash" if incident_app else "preemption/eviction",
                "; membership epoch bumped to %s for whole-slice restart "
                "from checkpoint" % epoch if epoch else "",
-               int(job.status["preemptionRestarts"]),
-               helper.preemption_budget(job)))
+               field, int(job.status[field]), budget))
         return Result(requeue=True)
 
     def _sync_current_status(self, job: api.TpuJob, child_pods: List[dict]) -> None:
@@ -317,6 +342,8 @@ class TpuJobReconciler:
             new_status["completionTime"] = job.status["completionTime"]
         if job.status.get("preemptionRestarts"):
             new_status["preemptionRestarts"] = job.status["preemptionRestarts"]
+        if job.status.get("appFailureRestarts"):
+            new_status["appFailureRestarts"] = job.status["appFailureRestarts"]
 
         per_role = {}
         for pod in child_pods:

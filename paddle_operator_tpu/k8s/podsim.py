@@ -42,6 +42,7 @@ class PodSimulator:
         self.auto_admit_podgroups = auto_admit_podgroups
         self._released: Dict[str, bool] = {}  # pod name -> coord released
         self._desired: Dict[str, str] = {}    # pod name -> Succeeded/Failed
+        self._fail_reasons: Dict[str, str] = {}  # pod name -> status.reason
         self._ip_seq = 0
         if isinstance(client, FakeKubeClient):
             client.exec_handler = self._handle_exec
@@ -57,14 +58,23 @@ class PodSimulator:
 
     # -- test controls -------------------------------------------------
 
-    def finish(self, pod_name: str, succeeded: bool = True) -> None:
+    def finish(self, pod_name: str, succeeded: bool = True,
+               reason: str = "") -> None:
+        """``reason`` (e.g. "Evicted", "Shutdown") models a SYSTEM kill:
+        the kubelet writes it to pod status.reason and the container
+        exits 137 (SIGKILL) — the preemption signature
+        helper.classify_pod_failure keys on. Without it, a failure is an
+        APP crash (container exit 1)."""
         self._desired[pod_name] = "Succeeded" if succeeded else "Failed"
+        if reason:
+            self._fail_reasons[pod_name] = reason
 
     def clear(self, pod_name: str) -> None:
         """Forget a `finish` request: a RECREATED pod with the same name is
         driven back up instead of being re-killed — one `finish` + `clear`
         models a single preemption event against a healthy replacement."""
         self._desired.pop(pod_name, None)
+        self._fail_reasons.pop(pod_name, None)
 
     def finish_all(self, succeeded: bool = True) -> None:
         for pod in self._all("Pod"):
@@ -193,10 +203,15 @@ class PodSimulator:
 
         if phase == "Running" and desired:
             new_status["phase"] = desired
+            reason = self._fail_reasons.get(name)
+            if desired == "Failed" and reason:
+                new_status["reason"] = reason
+                exit_code = 137  # system SIGKILL, the eviction signature
+            else:
+                exit_code = 0 if desired == "Succeeded" else 1
             new_status["containerStatuses"] = [
                 {"name": c.get("name", "main"), "ready": False,
-                 "state": {"terminated": {
-                     "exitCode": 0 if desired == "Succeeded" else 1}}}
+                 "state": {"terminated": {"exitCode": exit_code}}}
                 for c in pod["spec"].get("containers", [])
             ]
             self._write(ns, name, new_status)

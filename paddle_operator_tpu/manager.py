@@ -61,6 +61,10 @@ def main(argv=None):
                     help="dir holding tls.crt/tls.key (cert-manager "
                          "mounted secret); empty = self-signed (local "
                          "runs only — the apiserver won't trust it)")
+    ap.add_argument("--webhook-cert-wait", type=float, default=120.0,
+                    help="seconds to wait for the cert pair to appear in "
+                         "--webhook-cert-dir before exiting (cert-manager "
+                         "may still be issuing at first boot)")
     ap.add_argument("--kube-api", default=None, help="apiserver URL override")
     ap.add_argument("--insecure-skip-tls-verify", action="store_true")
     args = ap.parse_args(argv)
@@ -124,9 +128,37 @@ def main(argv=None):
         cert = os.path.join(args.webhook_cert_dir, "tls.crt")
         key = os.path.join(args.webhook_cert_dir, "tls.key")
         # both halves or neither: a mid-rotation secret with only tls.crt
-        # must fall back, not crash load_cert_chain
+        # must not crash load_cert_chain
         have_certs = (args.webhook_cert_dir and os.path.exists(cert)
                       and os.path.exists(key))
+        if args.webhook_cert_dir and not have_certs:
+            # An EXPLICIT cert dir means the apiserver trusts
+            # cert-manager's CA: silently serving self-signed would
+            # reject every TpuJob write under failurePolicy=Fail with
+            # TLS errors, and since certs load once, the real secret
+            # landing later never heals it. Wait (cert-manager may still
+            # be issuing at first boot), then exit non-zero so the
+            # kubelet restarts this pod into the mounted cert.
+            import time as _time
+
+            log.warning("webhook: waiting up to %.0fs for %s/{tls.crt,"
+                        "tls.key} (cert-manager issuance)",
+                        args.webhook_cert_wait, args.webhook_cert_dir)
+            deadline = _time.monotonic() + args.webhook_cert_wait
+            while _time.monotonic() < deadline:
+                if os.path.exists(cert) and os.path.exists(key):
+                    have_certs = True
+                    break
+                _time.sleep(2.0)
+            if not have_certs:
+                log.error("webhook cert pair never appeared in %r; "
+                          "exiting so the kubelet restarts the pod "
+                          "(self-signed fallback is reserved for the "
+                          "no-cert-dir local path)", args.webhook_cert_dir)
+                if coord_srv is not None:
+                    coord_srv.stop()  # release the bind for the restart
+                cache.stop()
+                return 1
         if not have_certs:
             try:
                 cert_pem, key_pem = self_signed_cert()

@@ -334,7 +334,7 @@ def chunked_lm_xent(head_params, hidden, labels, mask=None,
     the dominant memory (and bandwidth) cost of the loss. Measured
     (scripts/perf_ce_chunk.py, XLA memory_analysis + readback-synced
     timing): at B=2/S=512/V=32k the chunked step needs 262 MB less XLA
-    temp memory (1.62x) and runs 1.56x faster than the dense loss; the
+    temp memory (1.62x) and runs ~1.5x faster than the dense loss; the
     bench's gpt stage (BENCH_GPT_CE_COMPARE) records the same on-TPU
     comparison at full scale. Here tokens are
     processed in ``chunk``-sized slices under ``jax.checkpoint``: the

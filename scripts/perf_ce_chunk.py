@@ -19,7 +19,6 @@ Emits one JSON line.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -53,6 +52,11 @@ def main():
                                      vocab_size=cfg["vocab_size"])
     opt = optim.adamw(1e-4)
 
+    # bench._timed_windows is THE home of the readback-sync timing
+    # methodology (this environment's block_until_ready lies) — reuse it
+    # so a future sync fix reaches this script too
+    import bench
+
     out = {"stage": "ce_chunk", "backend": jax.default_backend(),
            "batch": batch, "seq": seq, "vocab": cfg["vocab_size"],
            "chunk": chunk,
@@ -66,15 +70,8 @@ def main():
         mem = lowered.compile().memory_analysis()
         if mem is not None:
             out["%s_temp_bytes" % name] = int(mem.temp_size_in_bytes)
-        # wall time, host-readback synced
-        state, metrics = step_fn(state, batch_data)
-        float(metrics["loss"])  # compile + sync
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step_fn(state, batch_data)
-            float(metrics["loss"])
-        out["%s_step_ms" % name] = round(
-            (time.perf_counter() - t0) / steps * 1000, 1)
+        best = bench._timed_windows(step_fn, state, batch_data, steps)
+        out["%s_step_ms" % name] = round(best * 1000, 1)
         del state
     if "dense_temp_bytes" in out and "chunked_temp_bytes" in out:
         out["temp_bytes_saved"] = (out["dense_temp_bytes"]

@@ -218,7 +218,7 @@ def test_preemption_whole_slice_restart_over_real_http(tmp_path):
             assert reached.wait(120), "training never reached step 3"
 
             # preemption: the kubelet reports worker-1 Failed
-            sim.finish("drill-worker-1", succeeded=False)
+            sim.finish("drill-worker-1", succeeded=False, reason="Evicted")
             deadline = time.time() + 30
             while time.time() < deadline:
                 if int(store.get(epoch_key("default", "drill")) or 0) > epoch0:

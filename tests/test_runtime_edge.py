@@ -99,8 +99,8 @@ def test_preempted_failed_pod_elastic_bumps_epoch_and_restarts():
     assert h.get_job("prf").phase == api.Phase.RUNNING
     epoch0 = int(h.kv.get(epoch_key("default", "prf")) or "0")
 
-    h.sim.finish("prf-worker-1", succeeded=False)
-    h.sim.step()                      # kubelet reports the failure
+    h.sim.finish("prf-worker-1", succeeded=False, reason="Evicted")
+    h.sim.step()                      # kubelet reports the eviction
     h.reconciler.reconcile("default", "prf")  # one pass: observe + react
     job = h.get_job("prf")
     assert job.phase == api.Phase.RESTARTING
@@ -117,9 +117,9 @@ def test_preempted_failed_pod_elastic_bumps_epoch_and_restarts():
 
 
 def test_elastic_preemption_budget_exhaustion_fails_terminally():
-    """A deterministically-crashing container must not restart the slice
-    forever: past the (annotation-tunable) restart budget the job goes
-    terminally Failed instead of Restarting."""
+    """A repeatedly-EVICTED slice eventually fails terminally: past the
+    (annotation-tunable) preemption budget the job goes Failed instead
+    of Restarting."""
     h = OperatorHarness()
     job = api.new_tpujob("crashy", spec={
         "device": "tpu", "elastic": 1, "cleanPodPolicy": "Never",
@@ -133,12 +133,63 @@ def test_elastic_preemption_budget_exhaustion_fails_terminally():
     assert h.get_job("crashy").phase == api.Phase.RUNNING
 
     # podsim keeps re-killing the recreated pod (desired phase persists):
-    # the crash loop the budget exists for
-    h.sim.finish("crashy-worker-1", succeeded=False)
+    # the eviction loop the budget exists for
+    h.sim.finish("crashy-worker-1", succeeded=False, reason="Evicted")
     h.converge(max_ticks=200)
     job = h.get_job("crashy")
     assert job.phase == api.Phase.FAILED
     assert int(job.status["preemptionRestarts"]) == 2
+    assert "appFailureRestarts" not in job.status  # correctly classified
+
+
+def test_app_crash_burns_smaller_budget_than_preemption():
+    """Advisor round-4: a container that exits non-zero on its own (bad
+    config, app OOM) is usually deterministic — it gets the app-failure
+    budget (default 3), NOT the 10 patient preemption restarts."""
+    h = OperatorHarness()
+    job = api.new_tpujob("appcrash", spec={
+        "device": "tpu", "elastic": 1, "cleanPodPolicy": "Never",
+        "tpu": {"accelerator": "v5e", "topology": "2x4", "chipsPerHost": 4},
+        "worker": role_spec(2),
+    })
+    h.create_job(job)
+    h.converge()
+    assert h.get_job("appcrash").phase == api.Phase.RUNNING
+
+    # no eviction reason: podsim reports container exit 1 — an app crash
+    h.sim.finish("appcrash-worker-1", succeeded=False)
+    h.converge(max_ticks=600)
+    job = h.get_job("appcrash")
+    assert job.phase == api.Phase.FAILED
+    assert int(job.status["appFailureRestarts"]) == \
+        helper.MAX_APP_FAILURE_RESTARTS
+    # the preemption budget was never touched
+    assert int(job.status.get("preemptionRestarts") or 0) == 0
+
+
+def test_classify_pod_failure():
+    mk = lambda **st: {"status": st}
+    term = lambda code: [{"name": "c", "state": {
+        "terminated": {"exitCode": code}}}]
+    assert helper.classify_pod_failure(
+        mk(reason="Evicted", containerStatuses=term(1))) == "preemption"
+    assert helper.classify_pod_failure(
+        mk(containerStatuses=term(137))) == "preemption"  # SIGKILL
+    assert helper.classify_pod_failure(
+        mk(containerStatuses=term(143))) == "preemption"  # SIGTERM
+    assert helper.classify_pod_failure(
+        mk(containerStatuses=term(1))) == "app"
+    assert helper.classify_pod_failure(
+        mk(containerStatuses=term(127))) == "app"
+    assert helper.classify_pod_failure(mk()) == "preemption"  # no evidence
+    # OOMKilled exits 137 too, but it is the app exceeding its own limit
+    assert helper.classify_pod_failure(mk(containerStatuses=[{
+        "name": "c", "state": {"terminated": {
+            "exitCode": 137, "reason": "OOMKilled"}}}])) == "app"
+    # lastState fallback (current state is waiting on the restart)
+    assert helper.classify_pod_failure(mk(containerStatuses=[{
+        "name": "c", "state": {"waiting": {"reason": "CrashLoopBackOff"}},
+        "lastState": {"terminated": {"exitCode": 2}}}])) == "app"
 
 
 def test_preempted_pod_recreated_for_elastic_job():

@@ -141,6 +141,79 @@ def test_webhook_server_over_tls(tmp_path):
         srv.stop()
 
 
+def test_manager_exits_when_explicit_cert_dir_never_populates(tmp_path):
+    """Advisor round-4: with --webhook-cert-dir EXPLICITLY set but the
+    pair absent (cert-manager not done issuing, or a half-rotated
+    secret), the manager must wait then EXIT non-zero so the kubelet
+    restarts it into the cert — never silently serve a self-signed cert
+    the apiserver will reject every write against under
+    failurePolicy=Fail."""
+    from paddle_operator_tpu import manager
+    from paddle_operator_tpu.k8s.envtest import StubApiServer
+
+    srv = StubApiServer().start()
+    try:
+        # half-rotated: only tls.crt present
+        (tmp_path / "tls.crt").write_bytes(b"not-a-cert")
+        rc = manager.main([
+            "--kube-api", srv.url,
+            "--webhook-bind-address", "127.0.0.1:0",
+            "--webhook-cert-dir", str(tmp_path),
+            "--webhook-cert-wait", "0.6",
+            "--coordination-bind-address", "127.0.0.1:0",
+            "--metrics-bind-address", "127.0.0.1:0",
+            "--health-probe-bind-address", "127.0.0.1:0",
+        ])
+        assert rc == 1
+    finally:
+        srv.stop()
+
+
+def test_manager_proceeds_once_cert_pair_appears(tmp_path):
+    """The wait loop is a wait, not a crash: with the pair present the
+    manager starts and RUNS (no exit within the window) — run as a
+    subprocess since main() installs signal handlers."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    from paddle_operator_tpu.k8s.envtest import StubApiServer
+
+    cert, key = self_signed_cert()
+    (tmp_path / "tls.crt").write_bytes(cert)
+    (tmp_path / "tls.key").write_bytes(key)
+    srv = StubApiServer().start()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    errlog = tmp_path / "manager.stderr"
+    with open(errlog, "w") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_operator_tpu.manager",
+             "--kube-api", srv.url,
+             "--webhook-bind-address", "127.0.0.1:0",
+             "--webhook-cert-dir", str(tmp_path),
+             "--webhook-cert-wait", "0.6",
+             "--coordination-bind-address", "127.0.0.1:0",
+             "--metrics-bind-address", "127.0.0.1:0",
+             "--health-probe-bind-address", "127.0.0.1:0"],
+            cwd=repo, env=dict(os.environ, PYTHONPATH=repo),
+            stdout=subprocess.DEVNULL, stderr=errf)
+    try:
+        time.sleep(3.0)
+        # healthy managers run until signalled: still alive IS the pass
+        assert proc.poll() is None, (
+            "manager exited rc=%s\n%s"
+            % (proc.returncode, errlog.read_text()[-2000:]))
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+        srv.stop()
+
+
 def test_webhook_manifests_rendered():
     import os
 
