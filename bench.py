@@ -91,6 +91,11 @@ STAGE_DEADLINES = {
     "moe_bench": float(os.environ.get("BENCH_T_MOE", "300")),
     "data_pipeline": float(os.environ.get("BENCH_T_PIPELINE", "150")),
     "gang_latency": float(os.environ.get("BENCH_T_GANG", "300")),
+    # investigation extras (round-3 items 2/5): summaries of the
+    # scripts/perf_*.py harnesses inside the driver artifact — run LAST
+    # so a budget kill sacrifices them, never the established extras
+    "conv_microbench": float(os.environ.get("BENCH_T_CONV", "300")),
+    "attention_sweep": float(os.environ.get("BENCH_T_ATTN_SWEEP", "360")),
 }
 
 # Tighter deadlines for the tiny TPU canary probe: its whole job is to
@@ -390,10 +395,14 @@ def child_main():
             "data_pipeline": ("BENCH_PIPELINE", "data_pipeline",
                               lambda: _pipeline_bench(step, state,
                                                       batch_data)),
+            "conv": ("BENCH_CONV", "conv_microbench",
+                     lambda: _conv_microbench(calib_tflops)),
+            "attn_sweep": ("BENCH_ATTN_SWEEP", "attention_sweep",
+                           lambda: _attention_block_sweep(backend)),
         }
         order = os.environ.get(
             "BENCH_EXTRAS_ORDER",
-            "fused,bert,gpt,moe,attention,data_pipeline")
+            "fused,bert,gpt,moe,attention,data_pipeline,conv,attn_sweep")
         for key in (k.strip() for k in order.split(",")):
             if key in extras:
                 env_var, stage, thunk = extras[key]
@@ -402,6 +411,88 @@ def child_main():
                 # a typo'd key must not silently cost a benchmark entry
                 _log("BENCH_EXTRAS_ORDER: unknown extra %r skipped "
                      "(known: %s)" % (key, ",".join(extras)))
+
+
+def _load_perf_module(name):
+    """Import a scripts/perf_*.py harness with its stdout redirected to
+    stderr (their emit() prints JSON lines that would corrupt the bench's
+    stdout protocol) and its emit() captured into a list the caller owns."""
+    import contextlib
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts", "%s.py" % name)
+    spec = importlib.util.spec_from_file_location("bench_" + name, path)
+    mod = importlib.util.module_from_spec(spec)
+    with contextlib.redirect_stdout(sys.stderr):
+        spec.loader.exec_module(mod)
+    rows = []
+    mod.emit = lambda **kv: rows.append(kv)
+    return mod, rows
+
+
+def _conv_microbench(calib_tflops):
+    """Per-shape conv evidence for the ResNet MFU question (round-3 item
+    2), via scripts/perf_resnet.py stage B (fwd+bwd): every distinct
+    ResNet-50 conv shape timed alone, TFLOP/s each, plus the weighted
+    aggregate — so the driver artifact localizes WHERE conv MFU goes,
+    not just that it is low. The standalone script holds the full
+    ablation grid (NCHW/NHWC, remat, batch sweep); this is the summary
+    slice the bench budget affords."""
+    mod, rows = _load_perf_module("perf_resnet")
+    batch = int(os.environ.get("BENCH_CONV_BATCH", "128"))
+    mod.ITERS = int(os.environ.get("BENCH_CONV_ITERS", "4"))
+    orig_log = mod.log
+
+    def log_and_rearm(msg):  # one marker per shape: each compiles its
+        _stage("conv_microbench")  # own program, so budget them singly
+        orig_log(msg)
+
+    mod.log = log_and_rearm
+    out = {"batch": batch, "mode": "fwd+bwd"}
+    try:
+        agg = mod.stage_b(calib_tflops, batch=batch, mode="bwd")
+        out["aggregate_tflops"] = round(agg, 1)
+        out["aggregate_frac_ceiling"] = round(agg / calib_tflops, 3)
+    except Exception as e:
+        # shapes measured before the failure are evidence — keep them
+        # (run_extra's invariant: never lose results that completed)
+        out["error"] = repr(e)[:200]
+    out["per_shape"] = [r for r in rows if "shape" in r]
+    return out
+
+
+def _attention_block_sweep(backend):
+    """Compact block_q x block_k sweep at long context (round-3 item 5),
+    via scripts/perf_attention.py's bench_config: is the flash kernel's
+    34 TFLOP/s at S=8k a block-size artifact? ~6 configs fit the bench
+    budget; the standalone script maps the full {128..1024}^2 grid."""
+    mod, _rows = _load_perf_module("perf_attention")
+    interpret = backend != "tpu"
+    mod.ITERS = int(os.environ.get("BENCH_SWEEP_ITERS", "4"))
+    s = int(os.environ.get("BENCH_SWEEP_SEQ", "8192"))
+    b, h, d = 1, 8, 128
+    grid = [(256, 256), (512, 512), (512, 1024), (1024, 512),
+            (1024, 1024), (2048, 1024)]
+    if interpret:  # CPU smoke: one tiny config proves the path only
+        s, grid = 512, [(128, 128)]
+    results = []
+    for bq, bk in grid:
+        if s % bq or s % bk:
+            continue
+        _stage("attention_sweep")  # re-arm the watchdog per config
+        try:
+            dt, tflops = mod.bench_config(b, h, s, d, bq, bk, interpret)
+            results.append({"block_q": bq, "block_k": bk,
+                            "ms": round(dt * 1000, 3),
+                            "tflops": round(tflops, 1)})
+        except Exception as e:  # VMEM overflow etc.: map it, don't die
+            results.append({"block_q": bq, "block_k": bk,
+                            "error": repr(e)[:160]})
+    ok = [r for r in results if "tflops" in r]
+    best = max(ok, key=lambda r: r["tflops"]) if ok else None
+    return {"seq": s, "batch": b, "heads": h, "head_dim": d,
+            "results": results, "best": best}
 
 
 def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
