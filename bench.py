@@ -1048,6 +1048,7 @@ class _Attempt:
         # plus the child's last stderr line.
         self.stage_times = []      # [(stage, seconds)], closed stages
         self.last_stderr = None    # last non-marker stderr line seen
+        self.relay_tcp = None      # TCP-level relay check after a failure
         self.outcome = None  # "ok" | "killed:<stage>" | "exit:<rc>"
         self.stdout_lines = []
         self.result = None  # parsed JSON from child
@@ -1284,8 +1285,9 @@ def parent_main():
                  and att.result.get("canary") == "ok"
                  and att.result.get("backend") == "tpu")
         if not alive:
-            _log("TPU canary failed (%s); %.0fs budget left"
-                 % (att.outcome, remaining()))
+            att.relay_tcp = _relay_tcp_probe()
+            _log("TPU canary failed (%s); relay tcp %s; %.0fs budget left"
+                 % (att.outcome, att.relay_tcp, remaining()))
             min_next = fixed_canary_cost + CANARY_MIN_BACKEND
             if remaining() > min_next + probe_backoff:
                 time.sleep(probe_backoff)
@@ -1354,6 +1356,41 @@ def parent_main():
     }))
 
 
+def _relay_tcp_probe():
+    """Network-level evidence for the attempts log: distinguishes 'relay
+    process down' (connection REFUSED — the PJRT plugin's connect-retry
+    loop is then the backend_init hang) from 'relay up but wedged'
+    (connects, then init hangs). Ports per axon/register/pjrt.py: :8082
+    stateful session, :8083 stateless jax.devices(). A connect+close
+    sends no protocol bytes, so it cannot wedge anything."""
+    import socket
+
+    host = os.environ.get(
+        "PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")[0].strip()
+    out = {"host": host}
+
+    def check(port):
+        try:
+            with socket.create_connection((host, port), timeout=1.5):
+                out[str(port)] = "open"
+        except ConnectionRefusedError:
+            out[str(port)] = "refused"
+        except socket.timeout:
+            out[str(port)] = "timeout"
+        except OSError as e:
+            out[str(port)] = type(e).__name__
+
+    # concurrent: a SYN-dropping host would otherwise cost 2 serial
+    # timeouts of canary-probing budget per failed attempt
+    threads = [threading.Thread(target=check, args=(p,), daemon=True)
+               for p in (8082, 8083)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=3)
+    return out
+
+
 def _canary_backend_deadline(n_probes, remaining_s, fixed_cost, backoff=0.0):
     """Escalating backend_init deadline for canary probe #`n_probes`.
 
@@ -1395,6 +1432,8 @@ def _attempt_log(attempts):
                 a.deadlines.get("backend_init", 0))
         if a.last_stderr:
             rec["last_stderr"] = a.last_stderr
+        if a.relay_tcp is not None:
+            rec["relay_tcp"] = a.relay_tcp
         out.append(rec)
     return out
 

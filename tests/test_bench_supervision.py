@@ -116,6 +116,56 @@ class TestCanaryEscalation:
         assert any(d >= 300.0 for d in deadlines), deadlines
 
 
+class TestRelayTcpProbe:
+    def test_refused_port_is_classified(self, monkeypatch):
+        # nothing listens on the default relay ports on the test box:
+        # both must classify as refused/unreachable, never raise
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+        out = bench._relay_tcp_probe()
+        assert out["host"] == "127.0.0.1"
+        assert set(out) == {"host", "8082", "8083"}
+        for port in ("8082", "8083"):
+            assert out[port] in ("refused", "timeout", "open",
+                                 "OSError", "ConnectionResetError",
+                                 "gaierror")
+
+    def test_open_port_is_classified(self, monkeypatch):
+        import socket
+        import threading
+
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        def accept_quietly():
+            try:
+                while True:
+                    srv.accept()
+            except OSError:
+                pass  # closed at test end
+
+        t = threading.Thread(target=accept_quietly, daemon=True)
+        t.start()
+        real_cc = socket.create_connection
+
+        def fake_cc(addr, timeout=None):
+            return real_cc((addr[0], port), timeout=timeout)
+
+        monkeypatch.setattr(socket, "create_connection", fake_cc)
+        out = bench._relay_tcp_probe()
+        srv.close()
+        assert out["8082"] == "open" and out["8083"] == "open"
+
+    def test_failed_canary_attempt_carries_relay_tcp(self):
+        att = bench._Attempt(0, mode="canary")
+        att.outcome = "killed:backend_init"
+        att.relay_tcp = {"host": "127.0.0.1", "8082": "refused",
+                         "8083": "refused"}
+        (rec,) = bench._attempt_log([att])
+        assert rec["relay_tcp"]["8082"] == "refused"
+
+
 class TestAttemptEvidence:
     def test_attempt_log_carries_stage_times_and_deadline(self):
         att = bench._Attempt(0, mode="canary",
