@@ -23,6 +23,20 @@ math runs on the accelerator:
   version. Gradient transport is raw ``float32`` bytes (no pickle): the
   tree structure is derived from ``init_params`` deterministically on every
   node, so only the flat payload crosses the wire.
+* **Pserver fault tolerance** (reference design: a restarted parameter
+  server "can recover its parameters from the saved file",
+  docs/design-fault-tolerant.md:19): with ``snapshot_dir`` set, every
+  BSP apply atomically persists the dense shard (full vector — it is
+  the small part for CTR) and an append-only DELTA of the sparse rows
+  that round touched (writing the whole table per round would be the
+  dense-transfer cost the sparse path exists to avoid); deltas compact
+  into a base periodically. A restarted pserver restores the last
+  COMPLETED round. Trainers ride through the restart: connection
+  retries reconnect, and a pull that stalls re-pushes the round's
+  gradient — idempotent in every case (in-flight round: same payload
+  overwrites; applied round: acked-duplicate 200; restarted server that
+  lost the push: counted now), so the interrupted round completes with
+  BSP math intact.
 * **Sparse embedding tables** (the workload PS actually exists for —
   reference PS architecture: docs/design-arch.md:5-74 describes pservers
   holding the sparse CTR embedding shards) are ROW-sharded across pservers
@@ -45,6 +59,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.error
@@ -135,12 +150,14 @@ class SparseTable:
         return np.stack([self.row(int(i)) for i in ids])
 
     def apply(self, grads_by_worker: List[Tuple[np.ndarray, np.ndarray]],
-              lr: float, momentum: float, n_trainers: int) -> None:
+              lr: float, momentum: float,
+              n_trainers: int) -> List[int]:
         """SGD+momentum on exactly the touched rows. Row gradient = sum of
         per-trainer gradients / n_trainers — identical semantics to the
         dense vector's mean-across-trainers (a trainer whose batch misses
         a row contributes an implicit zero), so a sparse PS run stays
-        checkable against a single-process dense run."""
+        checkable against a single-process dense run. Returns the touched
+        row ids (the snapshot delta)."""
         acc: Dict[int, np.ndarray] = {}
         for ids, grads in grads_by_worker:
             for i, rid in enumerate(ids):
@@ -153,6 +170,116 @@ class SparseTable:
             slot = g if slot is None else momentum * slot + g
             self.slots[rid] = slot
             self.rows[rid] = self.row(rid) - lr * slot
+        return list(acc.keys())
+
+
+# ---------------------------------------------------------------------------
+# pserver snapshot store (fault tolerance)
+# ---------------------------------------------------------------------------
+
+class SnapshotStore:
+    """Atomic on-disk state for one pserver shard.
+
+    Layout under ``snapshot_dir``:
+      dense.npz                 {vec, slot, version}     (rewritten per apply)
+      sparse_base.npz           {ids, rows, slots, version}
+      sparse_delta_%012d.npz    {ids, rows, slots}       (one per apply)
+
+    Every write goes tmp + ``os.replace`` so a crash mid-write leaves the
+    previous state intact. Deltas replay in version order on restore and
+    compact into the base every ``compact_every`` rounds.
+    """
+
+    def __init__(self, path: str, compact_every: int = 50):
+        self.path = path
+        self.compact_every = compact_every
+        # serializes file operations between delta writes and the
+        # BACKGROUND compaction thread — never held together with the
+        # ParamServer condition lock, so no deadlock is possible
+        self._lock = threading.Lock()
+        os.makedirs(path, exist_ok=True)
+
+    def _write(self, name: str, **arrays) -> None:
+        # tmp keeps the .npz suffix so np.savez does not append its own
+        tmp = os.path.join(self.path, ".tmp_" + name)
+        np.savez(tmp, **arrays)
+        os.replace(tmp, os.path.join(self.path, name))
+
+    def save_dense(self, vec, slot, version: int) -> None:
+        self._write("dense.npz", vec=vec,
+                    slot=(slot if slot is not None
+                          else np.zeros_like(vec)),
+                    version=np.int64(version))
+
+    def load_dense(self):
+        f = os.path.join(self.path, "dense.npz")
+        if not os.path.exists(f):
+            return None
+        with np.load(f) as z:
+            return z["vec"].copy(), z["slot"].copy(), int(z["version"])
+
+    def save_sparse_delta(self, version: int, ids, rows, slots) -> None:
+        with self._lock:
+            self._write("sparse_delta_%012d.npz" % version,
+                        ids=np.asarray(ids, np.int64),
+                        rows=np.asarray(rows, np.float32),
+                        slots=np.asarray(slots, np.float32))
+        if self.compact_every and version % self.compact_every == 0:
+            # off the caller's (server-lock-holding) thread: compaction
+            # re-reads and rewrites O(table) files — pulls/pushes must
+            # not stall behind that disk I/O
+            threading.Thread(target=self.compact, daemon=True).start()
+
+    def _delta_files(self):
+        return sorted(
+            f for f in os.listdir(self.path)
+            if f.startswith("sparse_delta_"))
+
+    def load_sparse(self):
+        """(rows dict, slots dict, version): base + deltas in order."""
+        rows: Dict[int, np.ndarray] = {}
+        slots: Dict[int, np.ndarray] = {}
+        version = 1
+        base = os.path.join(self.path, "sparse_base.npz")
+        if os.path.exists(base):
+            with np.load(base) as z:
+                for i, rid in enumerate(z["ids"]):
+                    rows[int(rid)] = z["rows"][i].copy()
+                    slots[int(rid)] = z["slots"][i].copy()
+                version = int(z["version"])
+        for f in self._delta_files():
+            v = int(f[len("sparse_delta_"):-len(".npz")])
+            if v < version:
+                continue  # already folded into the base
+            with np.load(os.path.join(self.path, f)) as z:
+                for i, rid in enumerate(z["ids"]):
+                    rows[int(rid)] = z["rows"][i].copy()
+                    slots[int(rid)] = z["slots"][i].copy()
+            version = v + 1
+        return rows, slots, version
+
+    def compact(self) -> None:
+        # The slow part — reading base + deltas — runs WITHOUT the lock:
+        # written files are immutable (base replace is atomic), and a
+        # delta landing concurrently has version >= the one computed
+        # here, so it survives the removal filter below. Only the short
+        # base-write + delta-removal section excludes delta writers.
+        rows, slots, version = self.load_sparse()
+        if not rows:
+            return
+        ids = np.fromiter(rows.keys(), np.int64, len(rows))
+        with self._lock:
+            self._write("sparse_base.npz", ids=ids,
+                        rows=np.stack([rows[int(i)] for i in ids]),
+                        slots=np.stack([slots[int(i)] for i in ids]),
+                        version=np.int64(version))
+            for f in self._delta_files():
+                v = int(f[len("sparse_delta_"):-len(".npz")])
+                if v < version:
+                    try:
+                        os.remove(os.path.join(self.path, f))
+                    except FileNotFoundError:
+                        pass  # a concurrent compact got it first
 
 
 def _pack_sparse(ids: np.ndarray, rows: np.ndarray) -> bytes:
@@ -207,12 +334,14 @@ class ParamServer:
     def __init__(self, n_trainers: int, lr: float = 0.1,
                  momentum: float = 0.9, host: str = "127.0.0.1",
                  port: int = 0, sparse_dim: int = 0, sparse_seed: int = 0,
-                 sparse_init_scale: float = 0.01):
+                 sparse_init_scale: float = 0.01,
+                 snapshot_dir: Optional[str] = None):
         self.n_trainers = n_trainers
         self.lr, self.momentum = lr, momentum
         self._vec: Optional[np.ndarray] = None
         self._slot: Optional[np.ndarray] = None  # momentum buffer
         self.version = 0
+        self.snap = SnapshotStore(snapshot_dir) if snapshot_dir else None
         self._grads: Dict[int, np.ndarray] = {}
         # worker -> last version whose push was ACCEPTED (per plane).
         # Client connection-retries re-send POSTs; a push that was already
@@ -231,6 +360,28 @@ class ParamServer:
         self._sgrads: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         self._done: set = set()
         self._cond = threading.Condition()
+        if self.snap is not None:
+            # restore the last COMPLETED round: a crash mid-round lost
+            # that round's in-memory pushes; trainers re-push on stall
+            dense = self.snap.load_dense()
+            if dense is not None:
+                self._vec, self._slot, self.version = dense
+            if self.sparse is not None:
+                rows, slots, sver = self.snap.load_sparse()
+                self.sparse.rows.update(rows)
+                self.sparse.slots.update(slots)
+                self.sparse_version = sver
+            # Reconstruct the duplicate-ack state: an apply at round V
+            # means EVERY worker's push at V was accepted (that is what
+            # completes the barrier), so last-acked = restored version-1
+            # per plane. Without this, a push whose 200 was lost in the
+            # crash would be 409d on retry and desync the BSP barrier.
+            if self.version > 1:
+                self._acked = {w: self.version - 1
+                               for w in range(self.n_trainers)}
+            if self.sparse is not None and self.sparse_version > 1:
+                self._sacked = {w: self.sparse_version - 1
+                                for w in range(self.n_trainers)}
         self._httpd = ThreadingHTTPServer((host, port), self._handler())
         self._thread: Optional[threading.Thread] = None
 
@@ -266,6 +417,10 @@ class ParamServer:
         self._vec = self._vec - self.lr * self._slot
         self._grads.clear()
         self.version += 1
+        if self.snap is not None:
+            # inside the lock: a pull must never observe a version whose
+            # state could be lost to a crash an instant later
+            self.snap.save_dense(self._vec, self._slot, self.version)
         self._cond.notify_all()
 
     def _handler(server_self):  # noqa: N805 — closure over the server
@@ -359,8 +514,18 @@ class ParamServer:
                         s._sacked[worker] = ver
                         s._sgrads[worker] = (ids, grads)
                         if len(s._sgrads) >= s.n_trainers:
-                            s.sparse.apply(list(s._sgrads.values()),
-                                           s.lr, s.momentum, s.n_trainers)
+                            touched = s.sparse.apply(
+                                list(s._sgrads.values()),
+                                s.lr, s.momentum, s.n_trainers)
+                            if s.snap is not None:
+                                # empty rounds too: the version bump must
+                                # persist, or a restart rewinds the shard
+                                # behind the fleet and deadlocks it
+                                s.snap.save_sparse_delta(
+                                    s.sparse_version,
+                                    touched,
+                                    [s.sparse.rows[r] for r in touched],
+                                    [s.sparse.slots[r] for r in touched])
                             s._sgrads.clear()
                             s.sparse_version += 1
                             s._cond.notify_all()
@@ -372,6 +537,12 @@ class ParamServer:
                         if s._vec is None:
                             s._vec = vec
                             s.version = 1
+                            if s.snap is not None:
+                                # a restart before the first apply must
+                                # not lose the init (pulls would block
+                                # forever; stall-re-push cannot help)
+                                s.snap.save_dense(s._vec, s._slot,
+                                                  s.version)
                             s._cond.notify_all()
                     self._send(200)
                     return
@@ -470,30 +641,42 @@ class PsClient:
             self._req(url + "/init", vec[a:b].tobytes())
 
     def _long_poll(self, url: str, data: Optional[bytes], t0: float,
-                   deadline_s: float) -> Tuple[bytes, dict]:
+                   deadline_s: float, on_stall=None) -> Tuple[bytes, dict]:
         """Re-arm a long-poll request until 200. A server-side 408 is just
         the 30 s poll window expiring (e.g. a straggler trainer still
         computing its gradient) — keep waiting until `deadline_s` from
-        `t0`; any other status is a server fault, raised as such."""
+        `t0`; any other status is a server fault, raised as such.
+
+        ``on_stall`` fires every second consecutive 408 (~60 s of no
+        progress): a restarted pserver restores only COMPLETED rounds, so
+        this round's in-memory pushes may be gone — the caller re-pushes
+        them (idempotent in every case: in-flight round overwrites the
+        same payload, applied round is acked as duplicate, restarted
+        server counts the replay)."""
+        stalls = 0
         while True:
             status, body, headers = self._req(url, data)
             if status == 200:
                 return body, headers
             if status != 408:
                 raise RuntimeError("poll %s: HTTP %s" % (url, status))
+            stalls += 1
+            if on_stall is not None and stalls % 2 == 0:
+                on_stall()
             if time.monotonic() - t0 > deadline_s:
                 raise TimeoutError(
                     "poll %s: no new version after %.0fs"
                     % (url, time.monotonic() - t0))
 
-    def pull(self, after: int,
-             deadline_s: float = 600.0) -> Tuple[np.ndarray, int]:
+    def pull(self, after: int, deadline_s: float = 600.0,
+             on_stall=None) -> Tuple[np.ndarray, int]:
         """Long-poll every shard for version > after."""
         t0 = time.monotonic()
         parts, version = [], None
         for url in self.urls:
             body, headers = self._long_poll(
-                "%s/pull?after=%d" % (url, after), None, t0, deadline_s)
+                "%s/pull?after=%d" % (url, after), None, t0, deadline_s,
+                on_stall=on_stall)
             parts.append(np.frombuffer(body, dtype=np.float32))
             v = int(headers.get("X-Version", "0"))
             version = v if version is None else min(version, v)
@@ -522,7 +705,8 @@ class PsClient:
                 for k in range(len(self.urls))]
 
     def sparse_pull(self, ids: np.ndarray, after: int, dim: int,
-                    deadline_s: float = 600.0) -> Tuple[np.ndarray, int]:
+                    deadline_s: float = 600.0,
+                    on_stall=None) -> Tuple[np.ndarray, int]:
         """Rows for `ids` (any order, duplicates allowed) at a version >
         `after`, from every owning server. Servers that own none of the
         ids still participate in the version long-poll — BSP lockstep is
@@ -534,7 +718,7 @@ class PsClient:
         for url, pos in zip(self.urls, self._split_ids(ids)):
             body, headers = self._long_poll(
                 "%s/sparse/pull?after=%d" % (url, after),
-                ids[pos].tobytes(), t0, deadline_s)
+                ids[pos].tobytes(), t0, deadline_s, on_stall=on_stall)
             rows = np.frombuffer(body, dtype=np.float32).reshape(-1, dim)
             out[pos] = rows
             v = int(headers.get("X-Version", "0"))
@@ -597,6 +781,9 @@ class PsTrainJob:
     seed: int = 0
     embed_dim: int = 0         # >0 enables the sparse embedding path
     ids_fn: Optional[Callable] = None  # batch -> raw int64 ids (any shape)
+    # pserver fault tolerance: each pserver persists its shard here (its
+    # own ps<idx>/ subdir) and restores it on restart
+    snapshot_dir: str = ""
 
 
 def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
@@ -621,7 +808,10 @@ def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
                 n_trainers=cfg.num_workers, lr=job.lr,
                 momentum=job.momentum,
                 host=bind_host or host, port=int(port),
-                sparse_dim=job.embed_dim, sparse_seed=job.seed)
+                sparse_dim=job.embed_dim, sparse_seed=job.seed,
+                snapshot_dir=(os.path.join(job.snapshot_dir,
+                                           "ps%d" % cfg.worker_id)
+                              if job.snapshot_dir else None))
         server.serve_forever()
         return {"role": "PSERVER"}
 
@@ -660,8 +850,12 @@ def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
             params = unflatten_params(vec, treedef, shapes)
             _, grads = vg_fn(params, batch)
             gvec, _, _ = flatten_params(grads)
-        # barrier: our round applied; this pull is also next round's fetch
-        vec, version = client.pull(after=version)
+        # barrier: our round applied; this pull is also next round's
+        # fetch. on_stall replays the push — a pserver restart restores
+        # only completed rounds, so this round's push may be gone.
+        vec, version = client.pull(
+            after=version,
+            on_stall=lambda g=gvec, v=version: client.push(g, v))
     client.done()  # all trainers done -> servers stop -> pods Complete
     final = unflatten_params(vec, treedef, shapes)
     return {"role": "TRAINER", "losses": losses, "params": final,
@@ -694,13 +888,20 @@ def _train_sparse(job: PsTrainJob, client: PsClient, treedef,
     vec, version = client.pull(after=0)
     sver = 0
     dim = job.embed_dim
+    prev_spush = None  # last completed (uids, grads, version) sparse push
     for step in range(job.total_steps):
         batch = job.make_batch(jax.random.fold_in(rng, step), step)
         raw_ids = np.asarray(job.ids_fn(batch), np.int64).ravel()
         uids, inv = np.unique(raw_ids, return_inverse=True)
         n = len(uids)
         cap = _pow2ceil(max(n, 1))
-        rows_real, sver = client.sparse_pull(uids, after=sver, dim=dim)
+        # this pull is also the previous round's sparse barrier: on a
+        # stall, replay the previous push (a restarted pserver restores
+        # only completed rounds; 409-stale replays are ignored)
+        rows_real, sver = client.sparse_pull(
+            uids, after=sver, dim=dim,
+            on_stall=(None if prev_spush is None else
+                      (lambda p=prev_spush: client.sparse_push(*p))))
         while True:
             rows = np.zeros((cap, dim), np.float32)
             rows[:n] = rows_real
@@ -708,10 +909,11 @@ def _train_sparse(job: PsTrainJob, client: PsClient, treedef,
             loss, (gparams, grows) = vg_fn(
                 params, jnp.asarray(rows), jnp.asarray(inv), batch)
             gvec, _, _ = flatten_params(gparams)
+            grows_n = np.asarray(grows)[:n]
             ok_dense = client.push(gvec, version)
-            ok_sparse = client.sparse_push(
-                uids, np.asarray(grows)[:n], sver)
+            ok_sparse = client.sparse_push(uids, grows_n, sver)
             if ok_dense and ok_sparse:
+                prev_spush = (uids, grows_n, sver)
                 break
             # stale round (another BSP round completed while we computed):
             # re-pull BOTH planes and recompute on fresh state. A half-
@@ -724,7 +926,9 @@ def _train_sparse(job: PsTrainJob, client: PsClient, treedef,
         # barrier: dense plane applied; this pull is next round's fetch.
         # The sparse barrier is implicit in the NEXT round's sparse_pull
         # (after=sver long-polls until the round applies) — no extra trip.
-        vec, version = client.pull(after=version)
+        vec, version = client.pull(
+            after=version,
+            on_stall=lambda g=gvec, v=version: client.push(g, v))
     final = unflatten_params(vec, treedef, shapes)
     return {"role": "TRAINER", "losses": losses, "params": final,
             "version": version, "sparse_version": sver,

@@ -378,6 +378,188 @@ def test_duplicate_push_resend_is_acked_not_stale():
         srv.stop()
 
 
+class TestSnapshotStore:
+    def test_dense_roundtrip(self, tmp_path):
+        s = ps.SnapshotStore(str(tmp_path))
+        vec = np.arange(8, dtype=np.float32)
+        slot = vec * 0.5
+        s.save_dense(vec, slot, 7)
+        v2, s2, ver = s.load_dense()
+        np.testing.assert_array_equal(v2, vec)
+        np.testing.assert_array_equal(s2, slot)
+        assert ver == 7
+        assert ps.SnapshotStore(str(tmp_path / "empty")).load_dense() is None
+
+    def test_sparse_deltas_replay_in_order_and_compact(self, tmp_path):
+        s = ps.SnapshotStore(str(tmp_path), compact_every=0)
+        # round 1 touches rows 3, 5; round 2 overwrites 5, adds 9
+        s.save_sparse_delta(1, [3, 5],
+                            [[1.0, 1.0], [2.0, 2.0]],
+                            [[0.1, 0.1], [0.2, 0.2]])
+        s.save_sparse_delta(2, [5, 9],
+                            [[5.0, 5.0], [9.0, 9.0]],
+                            [[0.5, 0.5], [0.9, 0.9]])
+        rows, slots, ver = s.load_sparse()
+        assert ver == 3  # two applied rounds after the initial version 1
+        np.testing.assert_array_equal(rows[3], [1.0, 1.0])
+        np.testing.assert_array_equal(rows[5], [5.0, 5.0])  # round-2 wins
+        np.testing.assert_array_equal(slots[9], np.float32([0.9, 0.9]))
+        # compaction folds deltas into the base, removes them, and the
+        # restored state is unchanged
+        s.compact()
+        assert not s._delta_files()
+        rows2, slots2, ver2 = s.load_sparse()
+        assert ver2 == 3
+        np.testing.assert_array_equal(rows2[5], rows[5])
+        np.testing.assert_array_equal(slots2[3], slots[3])
+        # a delta after compaction still replays on top of the base
+        s.save_sparse_delta(3, [3], [[7.0, 7.0]], [[0.7, 0.7]])
+        rows3, _, ver3 = s.load_sparse()
+        assert ver3 == 4
+        np.testing.assert_array_equal(rows3[3], np.float32([7.0, 7.0]))
+
+
+def test_empty_sparse_rounds_persist_version_across_restart(tmp_path):
+    """Review finding: a shard whose rounds touch zero of its rows (ids
+    all hash elsewhere) still advances its version; that bump must
+    persist or a restart rewinds the shard behind the fleet and the
+    long-polls deadlock."""
+    snap = str(tmp_path / "snap")
+    srv = ps.ParamServer(n_trainers=1, sparse_dim=2, sparse_seed=0,
+                         snapshot_dir=snap).start()
+    try:
+        c = ps.PsClient([srv.endpoint], worker_id=0)
+        sver = 0
+        empty = np.array([], np.int64)
+        for _ in range(3):
+            _, sver = c.sparse_pull(empty, after=sver, dim=2)
+            assert c.sparse_push(empty, np.zeros((0, 2), np.float32),
+                                 sver)
+        assert srv.sparse_version == 4
+    finally:
+        srv.stop()
+    srv2 = ps.ParamServer(n_trainers=1, sparse_dim=2, sparse_seed=0,
+                          snapshot_dir=snap)
+    assert srv2.sparse_version == 4
+
+
+def test_restart_acks_push_of_already_applied_round(tmp_path):
+    """Review finding: a push whose 200 was lost in the crash is retried
+    by the client's connection-retry; the restarted server must ack it
+    as a duplicate (the apply at that round proves every worker's push
+    was counted), not 409 it into a barrier desync."""
+    snap = str(tmp_path / "snap")
+    srv = ps.ParamServer(n_trainers=1, lr=0.1, momentum=0.0,
+                         sparse_dim=2, sparse_seed=0,
+                         snapshot_dir=snap).start()
+    c = ps.PsClient([srv.endpoint], worker_id=0)
+    try:
+        c.init(np.zeros(4, np.float32))
+        vec, version = c.pull(after=0)
+        assert c.push(np.ones(4, np.float32), version)  # applies -> v+1
+        ids = np.array([3], np.int64)
+        _, sver = c.sparse_pull(ids, after=0, dim=2)
+        assert c.sparse_push(ids, np.ones((1, 2), np.float32), sver)
+    finally:
+        srv.stop()
+
+    srv2 = ps.ParamServer(n_trainers=1, lr=0.1, momentum=0.0,
+                          sparse_dim=2, sparse_seed=0,
+                          snapshot_dir=snap).start()
+    try:
+        c2 = ps.PsClient([srv2.endpoint], worker_id=0)
+        c2.ranges = ps.shard_ranges(4, 1)
+        # the "lost 200" replay: same pushes again -> duplicate-acked
+        # 200s, and the versions do NOT double-advance
+        assert c2.push(np.ones(4, np.float32), version)
+        assert srv2.version == version + 1
+        assert c2.sparse_push(ids, np.ones((1, 2), np.float32), sver)
+        assert srv2.sparse_version == sver + 1
+        # state unchanged by the replays: exactly one SGD step applied
+        vec2, _ = c2.pull(after=0)
+        np.testing.assert_allclose(vec2, -0.1 * np.ones(4, np.float32))
+    finally:
+        srv2.stop()
+
+
+def test_pserver_restart_mid_training_is_bit_transparent(tmp_path):
+    """THE fault-tolerance drill (reference design-fault-tolerant.md:19 —
+    'a restarted parameter server can recover its parameters from the
+    saved file'): kill the pserver mid-training, restart it from its
+    snapshot on the same port, and the trainer — riding connection
+    retries and stall re-pushes — finishes with results BIT-IDENTICAL
+    to an uninterrupted run."""
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    steps = 8
+    cfg = dict(SPARSE_CFG)
+    row_dim = wide_deep.sparse_row_dim(cfg)
+    import paddle_operator_tpu.launch as launch_mod
+
+    def run(snapshot_dir, port_, chaos):
+        srv = ps.ParamServer(
+            n_trainers=1, lr=0.1, momentum=0.9, sparse_dim=row_dim,
+            sparse_seed=0, port=port_,
+            snapshot_dir=snapshot_dir).start()
+        killed = {"done": False}
+
+        def maybe_chaos():
+            # kill + restart the pserver once, after round 3 persisted
+            if not chaos or killed["done"]:
+                return
+            if (srv.version or 0) >= 3:
+                killed["done"] = True
+                srv.stop()  # pod death: port released, memory gone
+                restarted = ps.ParamServer(
+                    n_trainers=1, lr=0.1, momentum=0.9,
+                    sparse_dim=row_dim, sparse_seed=0, port=port_,
+                    snapshot_dir=snapshot_dir).start()
+                servers.append(restarted)
+
+        servers = [srv]
+        job = _sparse_job(total_steps=steps, cfg=cfg)
+        orig_make = job.make_batch
+
+        def make_batch(rng, step):
+            maybe_chaos()
+            return orig_make(rng, step)
+
+        job.make_batch = make_batch
+        cfg_l = launch_mod.LaunchConfig(
+            worker_id=0, num_workers=1, role="TRAINER",
+            ps_endpoints=["127.0.0.1:%d" % port_])
+        try:
+            res = ps.run_ps_training(job, cfg_l)
+        finally:
+            for s in servers:
+                s.stop()
+        final_rows = dict(servers[-1].sparse.rows)
+        return res, final_rows, killed["done"]
+
+    res_chaos, rows_chaos, did_kill = run(str(tmp_path / "snap"), port, True)
+    assert did_kill, "the drill never killed the server"
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port2 = sock.getsockname()[1]
+    sock.close()
+    res_ref, rows_ref, _ = run(str(tmp_path / "ref"), port2, False)
+
+    # bit-identical dense params and embedding rows across the restart
+    p0, _, _ = ps.flatten_params(res_chaos["params"])
+    p1, _, _ = ps.flatten_params(res_ref["params"])
+    np.testing.assert_array_equal(p0, p1)
+    assert set(rows_chaos) == set(rows_ref)
+    for rid in rows_ref:
+        np.testing.assert_array_equal(rows_chaos[rid], rows_ref[rid])
+    assert res_chaos["losses"] == res_ref["losses"]
+
+
 def test_ps_client_retries_connection_refused_until_server_up():
     """Advisor fix: connection-level failures (pserver pod not yet
     listening when a released trainer fires) retry with backoff inside
