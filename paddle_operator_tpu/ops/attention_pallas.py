@@ -388,8 +388,8 @@ def flash_attention_lse(q, k, v, scale=None, block_q: int = None,
     tile-level numerics."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q = block_q or _auto_block(q.shape[2])
-    block_k = block_k or _auto_block(q.shape[2])
+    block_q = block_q or _auto_block(q.shape[2], "q")
+    block_k = block_k or _auto_block(q.shape[2], "k")
     _check_blocks(q.shape, block_q, block_k)
     return _flash_attention_lse(q, k, v, scale, block_q, block_k, interpret,
                                 causal)
@@ -417,18 +417,33 @@ def flash_attention(q, k, v, scale=None, block_q: int = None,
     ``block_q=block_k=128`` explicitly."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q = block_q or _auto_block(q.shape[2])
-    block_k = block_k or _auto_block(q.shape[2])
+    block_q = block_q or _auto_block(q.shape[2], "q")
+    block_k = block_k or _auto_block(q.shape[2], "k")
     _check_blocks(q.shape, block_q, block_k)
     return _flash_attention(q, k, v, scale, block_q, block_k, interpret, causal)
 
 
-def _auto_block(seq: int) -> int:
+def _auto_block(seq: int, which: str = "q") -> int:
     """Largest well-measured tile that divides the sequence. 512 measures
     ~1.9x faster than 128 for fwd+bwd at S=4k-8k on v5e (block sweep in the
     round-3 bench): bigger tiles feed the MXU [512,128]x[128,512] matmuls
     and amortize the online-softmax loop; beyond 512 the curve is flat and
-    VMEM pressure grows. Falls back down the ladder for short sequences."""
+    VMEM pressure grows. Falls back down the ladder for short sequences.
+
+    ``TPUJOB_FLASH_BLOCK_Q`` / ``TPUJOB_FLASH_BLOCK_K`` override the
+    auto choice fleet-wide (still subject to divisibility) — the bench's
+    attention_sweep stage maps the block space on hardware, and its best
+    config deploys through these without a code change."""
+    import os
+
+    env = os.environ.get("TPUJOB_FLASH_BLOCK_" + which.upper())
+    if env:
+        try:
+            b = int(env)
+            if b >= MIN_BLOCK and b % MIN_BLOCK == 0 and seq % b == 0:
+                return b
+        except ValueError:
+            pass  # fall through to auto — a typo must not break training
     for b in (512, 256, 128):
         if seq % b == 0:
             return b

@@ -88,3 +88,21 @@ def test_auto_block_selection_matches_small_blocks():
                                block_q=128, block_k=128)
     assert jnp.allclose(auto.astype(jnp.float32),
                         explicit.astype(jnp.float32), atol=2e-2)
+
+
+def test_block_env_override(monkeypatch):
+    """TPUJOB_FLASH_BLOCK_Q/K deploy a sweep-found block config without a
+    code change; invalid/non-dividing values fall back to auto."""
+    from paddle_operator_tpu.ops.attention_pallas import _auto_block
+
+    monkeypatch.setenv("TPUJOB_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("TPUJOB_FLASH_BLOCK_K", "1024")
+    assert _auto_block(4096, "q") == 256
+    assert _auto_block(4096, "k") == 1024
+    # doesn't divide the sequence: auto wins
+    assert _auto_block(384, "q") == 128
+    # garbage / sub-minimum: auto wins, never raises
+    monkeypatch.setenv("TPUJOB_FLASH_BLOCK_Q", "banana")
+    assert _auto_block(4096, "q") == 512
+    monkeypatch.setenv("TPUJOB_FLASH_BLOCK_Q", "64")
+    assert _auto_block(4096, "q") == 512
