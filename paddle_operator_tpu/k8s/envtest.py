@@ -153,10 +153,22 @@ class StubApiServer:
     def clear_admission_webhooks(self) -> None:
         self._admission.clear()
 
+    _ADMIT_SSL_CTX = None  # built once: trust = registration (caBundle)
+
+    @classmethod
+    def _admit_ssl_ctx(cls):
+        import ssl
+
+        if cls._ADMIT_SSL_CTX is None:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            cls._ADMIT_SSL_CTX = ctx
+        return cls._ADMIT_SSL_CTX
+
     def _admit(self, operation: str, kind: str, obj: dict,
                old: Optional[dict]) -> None:
         """Run every matching webhook; raise ApiError to refuse the write."""
-        import ssl
         import urllib.request
 
         for wh in self._admission:
@@ -175,9 +187,7 @@ class StubApiServer:
                     "oldObject": old,
                 },
             }
-            ctx = ssl.create_default_context()
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE  # trust = registration (caBundle)
+            ctx = self._admit_ssl_ctx()
             try:
                 req = urllib.request.Request(
                     wh["url"], data=json.dumps(review).encode(),

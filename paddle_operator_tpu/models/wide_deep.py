@@ -27,24 +27,13 @@ DEFAULT_CONFIG = dict(
 
 def init(key, config: Optional[dict] = None) -> Dict:
     cfg = dict(DEFAULT_CONFIG, **(config or {}))
-    keys = iter(jax.random.split(key, 8 + len(cfg["hidden"])))
-    params: Dict = {
-        # one shared table across slots keeps the pytree compact; slot id is
-        # folded into the row index by apply()
-        "embed": nn.embedding_init(
-            next(keys), cfg["num_slots"] * cfg["vocab_per_slot"], cfg["embed_dim"]
-        ),
-        "wide": nn.embedding_init(
-            next(keys), cfg["num_slots"] * cfg["vocab_per_slot"], 1
-        ),
-        "dense_proj": nn.dense_init(next(keys), cfg["dense_dim"], cfg["embed_dim"]),
-        "mlp": [],
-    }
-    in_dim = cfg["embed_dim"] * (cfg["num_slots"] + 1)
-    for h in cfg["hidden"]:
-        params["mlp"].append(nn.dense_init(next(keys), in_dim, h))
-        in_dim = h
-    params["out"] = nn.dense_init(next(keys), in_dim, 1)
+    k_embed, k_wide, k_dense = jax.random.split(key, 3)
+    params = init_dense(k_dense, cfg)
+    # one shared table across slots keeps the pytree compact; slot id is
+    # folded into the row index by apply()
+    rows = cfg["num_slots"] * cfg["vocab_per_slot"]
+    params["embed"] = nn.embedding_init(k_embed, rows, cfg["embed_dim"])
+    params["wide"] = nn.embedding_init(k_wide, rows, 1)
     return params
 
 
@@ -52,6 +41,17 @@ def _fold_slots(sparse_ids, vocab_per_slot):
     num_slots = sparse_ids.shape[-1]
     offsets = jnp.arange(num_slots) * vocab_per_slot
     return sparse_ids + offsets[None, :]
+
+
+def _deep_logit(params, emb, dense_feat, dtype):
+    """The deep tower shared by the dense and sparse-PS forwards:
+    concat(flattened slot embeddings, projected dense features) -> MLP ->
+    scalar logit."""
+    b = emb.shape[0]
+    deep = jnp.concatenate([emb.reshape(b, -1), dense_feat], axis=-1)
+    for layer in params["mlp"]:
+        deep = jax.nn.relu(nn.dense(layer, deep, dtype))
+    return nn.dense(params["out"], deep, jnp.float32)[:, 0]
 
 
 def apply(params, batch, dtype=jnp.bfloat16):
@@ -62,14 +62,8 @@ def apply(params, batch, dtype=jnp.bfloat16):
     emb = nn.embedding(params["embed"], ids, dtype)            # [B, S, E]
     wide = nn.embedding(params["wide"], ids, jnp.float32)      # [B, S, 1]
     dense_feat = nn.dense(params["dense_proj"], batch["dense"], dtype)  # [B, E]
-
-    b = emb.shape[0]
-    deep = jnp.concatenate([emb.reshape(b, -1), dense_feat], axis=-1)
-    for layer in params["mlp"]:
-        deep = jax.nn.relu(nn.dense(layer, deep, dtype))
-    deep_logit = nn.dense(params["out"], deep, jnp.float32)[:, 0]
     wide_logit = jnp.sum(wide[..., 0], axis=-1)
-    return deep_logit + wide_logit
+    return _deep_logit(params, emb, dense_feat, dtype) + wide_logit
 
 
 # ---------------------------------------------------------------------------
@@ -120,12 +114,8 @@ def sparse_loss_fn(params, rows, inv, batch, train=True,
     emb = picked[..., :-1].astype(dtype)        # [B, S, E]
     wide = picked[..., -1].astype(jnp.float32)  # [B, S]
     dense_feat = nn.dense(params["dense_proj"], batch["dense"], dtype)
-
-    deep = jnp.concatenate([emb.reshape(b, -1), dense_feat], axis=-1)
-    for layer in params["mlp"]:
-        deep = jax.nn.relu(nn.dense(layer, deep, dtype))
-    deep_logit = nn.dense(params["out"], deep, jnp.float32)[:, 0]
-    logits = deep_logit + jnp.sum(wide, axis=-1)
+    logits = (_deep_logit(params, emb, dense_feat, dtype)
+              + jnp.sum(wide, axis=-1))
     loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])
     pred = (logits > 0).astype(jnp.float32)
     acc = jnp.mean((pred == batch["label"].astype(jnp.float32)).astype(jnp.float32))
