@@ -35,6 +35,7 @@ def build_train_step(
     accum_steps: int = 1,
     steps_per_call: int = 1,
     init_state: bool = True,
+    host_local_batches: bool = False,
 ):
     """Returns (step_fn, sharded_state).
 
@@ -196,25 +197,34 @@ def build_train_step(
     if jax.process_count() > 1:
         # Multi-host: a host-local numpy/device batch cannot feed a jit
         # whose in_shardings span non-addressable devices ("passing
-        # non-trivial shardings for numpy inputs is not allowed"). The
-        # contract stays "make_batch returns the GLOBAL batch, identical
-        # on every host" (same folded rng everywhere); each process
-        # assembles the global jax.Array by materializing ONLY the blocks
-        # its own devices hold — no cross-host transfer.
-        step_fn = _globalize_batches(step_fn, batch_sh)
+        # non-trivial shardings for numpy inputs is not allowed"). Two
+        # input contracts, both assembling per-process jax.Arrays with
+        # no cross-host transfer:
+        #   host_local_batches=False (default): make_batch returns the
+        #     GLOBAL batch, identical on every host (same folded rng
+        #     everywhere); each process materializes only the blocks its
+        #     own devices hold.
+        #   host_local_batches=True: make_batch returns only THIS HOST'S
+        #     shard of the global batch (the scalable input-pipeline
+        #     pattern — each host loads 1/N of the data; fold
+        #     jax.process_index() into the rng or file sharding).
+        step_fn = _globalize_batches(step_fn, batch_sh,
+                                     host_local_batches)
     if not init_state:
         return step_fn, None
     state = jax.device_put(state, state_sh)
     return step_fn, state
 
 
-def _globalize_batches(step_fn, batch_sh):
+def _globalize_batches(step_fn, batch_sh, host_local):
     import numpy as np
 
     def to_global(leaf, sh):
         if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
             return leaf  # already a global array
         arr = np.asarray(leaf)
+        if host_local:
+            return jax.make_array_from_process_local_data(sh, arr)
         return jax.make_array_from_callback(
             arr.shape, sh, lambda idx: arr[idx])
 

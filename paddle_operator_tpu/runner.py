@@ -89,6 +89,11 @@ class TrainJob:
     # Sharded multi-host saves are always synchronous (they serialize on a
     # cross-host barrier anyway).
     async_checkpoint: bool = True
+    # multi-host input contract: False = make_batch returns the GLOBAL
+    # batch (identical on every host); True = make_batch returns only
+    # THIS HOST'S shard (scalable input pipelines — fold
+    # jax.process_index() into the rng/file sharding)
+    host_local_batches: bool = False
     seed: int = 0
 
 
@@ -180,6 +185,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             mesh=mesh, rules=job.rules, seq_axis=job.seq_axis,
             merge_stats=job.merge_stats, grad_clip=job.grad_clip,
             accum_steps=job.accum_steps,
+            host_local_batches=job.host_local_batches,
         )
         step_fn, state = build(steps_per_call=K)
         single_fn = None  # tail windows shorter than K, built lazily

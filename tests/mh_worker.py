@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--elastic-server", default="")
     ap.add_argument("--job-id", default="default-mhdrill")
     ap.add_argument("--total-steps", type=int, default=12)
+    ap.add_argument("--host-local", action="store_true",
+                    help="drill variant: each host's make_batch yields "
+                         "only its own shard of the global batch")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -115,15 +118,23 @@ def run_drill(args):
     import time as _time
 
     def make_batch(rng, step):
-        # GLOBAL batch, identical on every host (same folded rng);
-        # build_train_step materializes only this host's blocks
         if step >= 4:
             # hold the cycle open past the first checkpoint: the driver
             # test bumps the epoch after step-3's manifest appears, and
             # sub-millisecond steps must not race past the bump's
             # propagation (store poll 0.05s + broadcast)
             _time.sleep(0.05)
-        x = jax.random.normal(jax.random.fold_in(rng, step), (32, 16))
+        if args.host_local:
+            # HOST-LOCAL shard: this host contributes its own 16 rows of
+            # the 32-row global batch (rng folded by process index —
+            # the scalable input-pipeline pattern)
+            k = jax.random.fold_in(
+                jax.random.fold_in(rng, step), jax.process_index())
+            x = jax.random.normal(k, (16, 16))
+        else:
+            # GLOBAL batch, identical on every host (same folded rng);
+            # build_train_step materializes only this host's blocks
+            x = jax.random.normal(jax.random.fold_in(rng, step), (32, 16))
         y = jnp.sin(x.sum(axis=1))
         return {"x": np.asarray(x), "y": np.asarray(y)}
 
@@ -139,6 +150,7 @@ def run_drill(args):
         # genuinely cross-host shards (replicated params would collapse
         # to a single p0-written file)
         rules=[("w1", P("dp")), ("w2", P("dp"))],
+        host_local_batches=args.host_local,
         sharded_checkpoint=True,
         total_steps=args.total_steps, checkpoint_every=3,
         checkpoint_dir=args.ckpt_dir, log_every=0,

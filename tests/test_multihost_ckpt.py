@@ -112,6 +112,26 @@ def test_sharded_checkpoint_across_two_real_processes(tmp_path):
 
 
 @pytest.mark.slow
+def test_host_local_batches_two_processes(tmp_path):
+    """host_local_batches=True: each host's make_batch yields only its
+    own rows of the global batch (the scalable input-pipeline contract);
+    the two hosts see DIFFERENT data yet train in BSP lockstep to the
+    same final loss."""
+    port = _free_port()
+    procs = [_spawn("drill", i, port, str(tmp_path),
+                    extra=("--total-steps", "6", "--host-local"))
+             for i in (0, 1)]
+    outs = _finish(procs)
+    by_pid = {o["pid"]: o for o in outs}
+    for o in outs:
+        assert o["cycles"] == 1 and o["steps"] == 6, o
+        assert o["mesh_history"] == [{"dp": 8}], o
+    # BSP: identical final loss on both hosts despite distinct local data
+    assert by_pid[0]["loss"] == by_pid[1]["loss"], outs
+    assert 0.0 <= by_pid[0]["loss"] < 2.0
+
+
+@pytest.mark.slow
 def test_preemption_restart_with_sharded_checkpoint_two_processes(tmp_path):
     """The whole-slice restart drill across a REAL 2-process world:
     mid-training epoch bump (as the reconciler's preemption handler
