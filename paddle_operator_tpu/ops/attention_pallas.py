@@ -423,6 +423,22 @@ def flash_attention(q, k, v, scale=None, block_q: int = None,
     return _flash_attention(q, k, v, scale, block_q, block_k, interpret, causal)
 
 
+_warned_overrides = set()
+
+
+def _warn_block_override_once(which, env, seq):
+    key = (which, env, seq)
+    if key in _warned_overrides:
+        return
+    _warned_overrides.add(key)
+    import logging
+
+    logging.getLogger("tpujob.attention").warning(
+        "TPUJOB_FLASH_BLOCK_%s=%r ignored for seq=%d (must be a "
+        "%d-multiple that divides the sequence); using auto block",
+        which.upper(), env, seq, MIN_BLOCK)
+
+
 def _auto_block(seq: int, which: str = "q") -> int:
     """Largest well-measured tile that divides the sequence. 512 measures
     ~1.9x faster than 128 for fwd+bwd at S=4k-8k on v5e (block sweep in the
@@ -440,10 +456,13 @@ def _auto_block(seq: int, which: str = "q") -> int:
     if env:
         try:
             b = int(env)
-            if b >= MIN_BLOCK and b % MIN_BLOCK == 0 and seq % b == 0:
-                return b
         except ValueError:
-            pass  # fall through to auto — a typo must not break training
+            b = -1
+        if b >= MIN_BLOCK and b % MIN_BLOCK == 0 and seq % b == 0:
+            return b
+        # a typo must not break training, but a silently-discarded
+        # override would make a deployed sweep config an invisible no-op
+        _warn_block_override_once(which, env, seq)
     for b in (512, 256, 128):
         if seq % b == 0:
             return b

@@ -69,10 +69,14 @@ def test_mha_flash_impl_matches_einsum():
     assert jnp.allclose(y_einsum, y_flash, atol=2e-4)
 
 
-def test_auto_block_selection_matches_small_blocks():
+def test_auto_block_selection_matches_small_blocks(monkeypatch):
     """Default (auto) block sizes must compute the same attention as
     explicit 128-blocks, and pick the 512 tile for long sequences."""
     from paddle_operator_tpu.ops.attention_pallas import _auto_block
+
+    # the env override must not leak into the auto assertions below
+    monkeypatch.delenv("TPUJOB_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("TPUJOB_FLASH_BLOCK_K", raising=False)
 
     assert _auto_block(4096) == 512
     assert _auto_block(512) == 512
