@@ -10,16 +10,15 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import nn
-from .wide_deep import DEFAULT_CONFIG, _fold_slots
+from .wide_deep import DEFAULT_CONFIG, _fold_slots, ctr_loss
 
 
-def init(key, config: Optional[dict] = None) -> Dict:
+def init_dense(key, config: Optional[dict] = None) -> Dict:
+    """The non-embedding parameters only — the dense BSP vector in
+    sparse-PS mode (FM tables stay row-sharded on the servers)."""
     cfg = dict(DEFAULT_CONFIG, **(config or {}))
-    keys = iter(jax.random.split(key, 8 + len(cfg["hidden"])))
-    vocab = cfg["num_slots"] * cfg["vocab_per_slot"]
+    keys = iter(jax.random.split(key, 3 + len(cfg["hidden"])))
     params: Dict = {
-        "fm_first": nn.embedding_init(next(keys), vocab, 1),
-        "fm_embed": nn.embedding_init(next(keys), vocab, cfg["embed_dim"]),
         "dense_w": nn.dense_init(next(keys), cfg["dense_dim"], 1),
         "mlp": [],
     }
@@ -31,14 +30,21 @@ def init(key, config: Optional[dict] = None) -> Dict:
     return params
 
 
-def apply(params, batch, dtype=jnp.bfloat16):
-    vocab_per_slot = params["fm_embed"]["table"].shape[0] // batch["sparse"].shape[-1]
-    ids = _fold_slots(batch["sparse"], vocab_per_slot)
-    emb = nn.embedding(params["fm_embed"], ids, dtype)     # [B, S, E]
+def init(key, config: Optional[dict] = None) -> Dict:
+    cfg = dict(DEFAULT_CONFIG, **(config or {}))
+    k_first, k_embed, k_dense = jax.random.split(key, 3)
+    vocab = cfg["num_slots"] * cfg["vocab_per_slot"]
+    params = init_dense(k_dense, cfg)
+    params["fm_first"] = nn.embedding_init(k_first, vocab, 1)
+    params["fm_embed"] = nn.embedding_init(k_embed, vocab, cfg["embed_dim"])
+    return params
 
-    # FM first order
-    first = jnp.sum(nn.embedding(params["fm_first"], ids, jnp.float32)[..., 0], -1)
-    first = first + nn.dense(params["dense_w"], batch["dense"], jnp.float32)[:, 0]
+
+def _logits(params, emb, first_order, batch, dtype):
+    """FM second order + deep tower, shared by the dense and sparse-PS
+    forwards. emb: [B, S, E]; first_order: [B] (slot weights summed)."""
+    first = first_order + nn.dense(
+        params["dense_w"], batch["dense"], jnp.float32)[:, 0]
 
     # FM second order: 0.5 * ((Σv)² - Σv²)
     sum_sq = jnp.square(jnp.sum(emb, axis=1))
@@ -55,12 +61,35 @@ def apply(params, batch, dtype=jnp.bfloat16):
     return first + second + deep_logit
 
 
+def apply(params, batch, dtype=jnp.bfloat16):
+    vocab_per_slot = params["fm_embed"]["table"].shape[0] // batch["sparse"].shape[-1]
+    ids = _fold_slots(batch["sparse"], vocab_per_slot)
+    emb = nn.embedding(params["fm_embed"], ids, dtype)     # [B, S, E]
+    first = jnp.sum(
+        nn.embedding(params["fm_first"], ids, jnp.float32)[..., 0], -1)
+    return _logits(params, emb, first, batch, dtype)
+
+
+def sparse_loss_fn(params, rows, inv, batch, train=True,
+                   dtype=jnp.bfloat16):
+    """Sparse-PS forward: one fused server-side table of width
+    embed_dim+1 carries [fm_embed | fm_first] per row; lookup =
+    rows[inv] over the pulled rows (ps.PsTrainJob contract, same shape
+    as wide_deep.sparse_loss_fn)."""
+    b, s = batch["sparse"].shape
+    picked = rows[inv].reshape(b, s, -1)          # [B, S, E+1]
+    emb = picked[..., :-1].astype(dtype)          # [B, S, E]
+    first = jnp.sum(picked[..., -1].astype(jnp.float32), axis=-1)  # [B]
+    logits = _logits(params, emb, first, batch, dtype)
+    return ctr_loss(logits, batch["label"])
+
+
 def loss_fn(params, batch, train=True, dtype=jnp.bfloat16):
     logits = apply(params, batch, dtype)
-    loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])
-    pred = (logits > 0).astype(jnp.float32)
-    acc = jnp.mean((pred == batch["label"].astype(jnp.float32)).astype(jnp.float32))
-    return loss, {"accuracy": acc}
+    return ctr_loss(logits, batch["label"])
 
 
-from .wide_deep import synthetic_batch  # noqa: E402,F401  (same input schema)
+# same input schema and sparse-PS helpers as wide_deep (shared slot-id
+# folding and fused row layout)
+from .wide_deep import (  # noqa: E402,F401
+    sparse_ids, sparse_row_dim, synthetic_batch)

@@ -43,6 +43,15 @@ def _fold_slots(sparse_ids, vocab_per_slot):
     return sparse_ids + offsets[None, :]
 
 
+def ctr_loss(logits, labels):
+    """Sigmoid BCE + accuracy — THE loss tail shared by every CTR model's
+    dense and sparse forwards (one place to change the metric/reduction)."""
+    loss = nn.sigmoid_binary_cross_entropy(logits, labels)
+    pred = (logits > 0).astype(jnp.float32)
+    acc = jnp.mean((pred == labels.astype(jnp.float32)).astype(jnp.float32))
+    return loss, {"accuracy": acc}
+
+
 def _deep_logit(params, emb, dense_feat, dtype):
     """The deep tower shared by the dense and sparse-PS forwards:
     concat(flattened slot embeddings, projected dense features) -> MLP ->
@@ -116,18 +125,12 @@ def sparse_loss_fn(params, rows, inv, batch, train=True,
     dense_feat = nn.dense(params["dense_proj"], batch["dense"], dtype)
     logits = (_deep_logit(params, emb, dense_feat, dtype)
               + jnp.sum(wide, axis=-1))
-    loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])
-    pred = (logits > 0).astype(jnp.float32)
-    acc = jnp.mean((pred == batch["label"].astype(jnp.float32)).astype(jnp.float32))
-    return loss, {"accuracy": acc}
+    return ctr_loss(logits, batch["label"])
 
 
 def loss_fn(params, batch, train=True, dtype=jnp.bfloat16):
     logits = apply(params, batch, dtype)
-    loss = nn.sigmoid_binary_cross_entropy(logits, batch["label"])
-    pred = (logits > 0).astype(jnp.float32)
-    acc = jnp.mean((pred == batch["label"].astype(jnp.float32)).astype(jnp.float32))
-    return loss, {"accuracy": acc}
+    return ctr_loss(logits, batch["label"])
 
 
 def synthetic_batch(key, batch_size: int, config: Optional[dict] = None):
