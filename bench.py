@@ -952,15 +952,19 @@ def _attention_bench(backend):
 
 
 def _pipeline_bench(step, state, batch_data):
-    """Input-pipeline overlap: ShardedLoader prefetch vs fully-serial
-    feeding, driving the SAME compiled train step with host-generated
-    numpy batches (the H2D + host-work overlap data.py exists for)."""
+    """Input-pipeline overlap: ShardedLoader background prefetch vs
+    fully-serial feeding, driving the SAME compiled train step with
+    host-generated numpy batches (the H2D + host-work overlap data.py
+    exists for), plus the host-overlap stage breakdown (batch-build /
+    enqueue-wait / dequeue-wait / device-put / dispatch-gap) from the
+    loader's StageTimes instrumentation."""
     import numpy as np
 
     import jax
     import jax.numpy as jnp
 
     from paddle_operator_tpu.data import ShardedLoader, synthetic_source
+    from paddle_operator_tpu.utils.trace import StageTimes
 
     bsz = int(batch_data["image"].shape[0])
     img = int(batch_data["image"].shape[1])
@@ -986,32 +990,47 @@ def _pipeline_bench(step, state, batch_data):
 
     def run(prefetch, serial):
         nonlocal state
+        times = StageTimes()
         loader = ShardedLoader(
             synthetic_source(host_batch),
-            batch_sharding=shardings, prefetch=prefetch)
-        it = iter(loader)
-        # warm one step (first loader batch may include H2D compile)
-        s, m = step(state, next(it))
-        float(m["loss"])  # host readback — the only honest sync here
-        state = s
-        t0 = time.perf_counter()
-        m = None
-        for _ in range(n_steps):
-            b = next(it)
-            s, m = step(state, b)
-            if serial:
-                float(m["loss"])  # per-step sync: no H2D/compute overlap
+            batch_sharding=shardings, prefetch=prefetch, timings=times)
+        try:
+            it = iter(loader)
+            # warm one step (first loader batch may include H2D compile)
+            s, m = step(state, next(it))
+            float(m["loss"])  # host readback — the only honest sync here
             state = s
-        float(m["loss"])  # overlapped mode syncs once at the end
-        return (time.perf_counter() - t0) / n_steps
+            times.reset()  # breakdown covers the timed window only
+            t0 = time.perf_counter()
+            m = None
+            t_dispatched = None
+            for _ in range(n_steps):
+                b = next(it)
+                if t_dispatched is not None:
+                    times.add("dispatch_gap",
+                              time.perf_counter() - t_dispatched)
+                s, m = step(state, b)
+                t_dispatched = time.perf_counter()
+                if serial:
+                    float(m["loss"])  # per-step sync: no H2D/compute overlap
+                state = s
+            float(m["loss"])  # overlapped mode syncs once at the end
+            return (time.perf_counter() - t0) / n_steps, times.summary()
+        finally:
+            loader.close()  # the infinite source never ends on its own
 
-    serial_s = run(prefetch=0, serial=True)
-    overlap_s = run(prefetch=2, serial=False)
+    serial_s, serial_stages = run(prefetch=0, serial=True)
+    overlap_s, overlap_stages = run(prefetch=2, serial=False)
     return {
         "steps": n_steps,
         "serial_step_ms": round(serial_s * 1000, 2),
         "prefetch_step_ms": round(overlap_s * 1000, 2),
         "overlap_speedup": round(serial_s / overlap_s, 2),
+        # host-overlap breakdown: where the loop's host time goes in each
+        # mode (batch_build/device_put on the producer thread in prefetch
+        # mode, dequeue_wait = consumer starvation, dispatch_gap = host
+        # time between dispatches)
+        "stages": {"serial": serial_stages, "prefetch": overlap_stages},
     }
 
 

@@ -13,7 +13,7 @@ from .sharding import (  # noqa: F401
     shard_tree, named, P, bert_rules, gpt_rules, resnet_rules, ctr_rules,
     moe_rules,
 )
-from .train import build_train_step  # noqa: F401
+from .train import batch_shardings, build_train_step  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from .context import (  # noqa: F401
     ring_attention, ring_flash_attention, ulysses_attention,

@@ -21,6 +21,45 @@ from ..ops.optim import Optimizer, clip_by_global_norm
 from .sharding import Rules, named, shard_tree
 
 
+def batch_shardings(
+    sample_batch,
+    mesh: Mesh,
+    batch_axis: str = "dp",
+    seq_axis: Optional[str] = None,
+    accum_steps: int = 1,
+    steps_per_call: int = 1,
+):
+    """The sharding pytree :func:`build_train_step`'s jit expects for its
+    batch input. Exposed so input pipelines (``data.ShardedLoader``) can
+    prestage batches/windows on device with the exact shardings the step
+    was traced with, instead of paying the transfer at dispatch time.
+
+    ``steps_per_call > 1`` adds the unsharded leading ``[K]`` window axis
+    to every leaf's spec; ``accum_steps > 1`` the unsharded microbatch
+    axis; ``seq_axis`` shards the token axis too (context parallelism).
+    """
+
+    def spec(leaf):
+        nd = getattr(leaf, "ndim", 0)
+        lead = (None,) if accum_steps > 1 else ()  # microbatch axis: unsharded
+        if nd <= len(lead):
+            p = P()
+        elif seq_axis is not None and nd >= 2 + len(lead):
+            # sequence/context parallelism: tokens sharded over `sp` too —
+            # GSPMD gathers the sequence where attention needs it and keeps
+            # embedding/loss work token-sharded.
+            p = P(*lead, batch_axis, seq_axis)
+        else:
+            p = P(*lead, batch_axis)
+        if steps_per_call > 1:
+            # every leaf carries the leading [K] window axis: unsharded
+            # window dimension, per-step spec for the rest
+            p = P(*((None,) + tuple(p)))
+        return named(mesh, p)
+
+    return jax.tree_util.tree_map(spec, sample_batch)
+
+
 def build_train_step(
     loss_fn: Callable,
     optimizer: Optimizer,
@@ -164,29 +203,9 @@ def build_train_step(
     param_sh = shard_tree(params, mesh, rules)
     opt_sh = shard_tree(state["opt"], mesh, rules)
     state_sh = {"params": param_sh, "opt": opt_sh}
-    def batch_spec(leaf):
-        nd = getattr(leaf, "ndim", 0)
-        lead = (None,) if accum_steps > 1 else ()  # microbatch axis: unsharded
-        if nd <= len(lead):
-            return P()
-        if seq_axis is not None and nd >= 2 + len(lead):
-            # sequence/context parallelism: tokens sharded over `sp` too —
-            # GSPMD gathers the sequence where attention needs it and keeps
-            # embedding/loss work token-sharded.
-            return P(*lead, batch_axis, seq_axis)
-        return P(*lead, batch_axis)
-
-    if steps_per_call == 1:
-        batch_sh = jax.tree_util.tree_map(
-            lambda leaf: named(mesh, batch_spec(leaf)), sample_batch
-        )
-    else:
-        # every leaf carries the leading [K] window axis: unsharded window
-        # dimension, per-step spec for the rest
-        batch_sh = jax.tree_util.tree_map(
-            lambda leaf: named(mesh, P(*((None,) + tuple(batch_spec(leaf))))),
-            sample_batch,
-        )
+    batch_sh = batch_shardings(
+        sample_batch, mesh, batch_axis=batch_axis, seq_axis=seq_axis,
+        accum_steps=accum_steps, steps_per_call=steps_per_call)
 
     step_fn = jax.jit(
         top,

@@ -17,18 +17,18 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
+from .data import DeferredMetrics, ShardedLoader, job_window_source
 from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distributed
 from .ops.optim import Optimizer
-from .parallel import build_train_step, make_mesh
+from .parallel import batch_shardings, build_train_step, make_mesh
 from .parallel.sharding import Rules
 from .utils.checkpoint import (
     AsyncCheckpointer, latest_step, read_manifest, restore_checkpoint,
     restore_checkpoint_sharded, save_checkpoint, save_checkpoint_sharded,
 )
-from .utils.trace import profile_steps, tracer
+from .utils.trace import StageTimes, profile_steps, tracer
 
 log = logging.getLogger("tpujob.runner")
 
@@ -77,7 +77,8 @@ class TrainJob:
     grad_clip: Optional[float] = None
     accum_steps: int = 1        # >1: make_batch returns [accum, mb, ...]
     # >1: K optimizer steps fused into one dispatch (lax.scan) — amortizes
-    # the host->device round trip; the loop stacks K make_batch windows
+    # the host->device round trip; the input pipeline assembles and
+    # prestages [K, ...] make_batch windows while the current one computes
     steps_per_call: int = 1
     total_steps: int = 100
     log_every: int = 10
@@ -94,6 +95,11 @@ class TrainJob:
     # THIS HOST'S shard (scalable input pipelines — fold
     # jax.process_index() into the rng/file sharding)
     host_local_batches: bool = False
+    # input-pipeline depth: how many batches/windows the background
+    # producer (data.ShardedLoader) keeps ahead of the training loop —
+    # batch build + H2D overlap compute. 0 = inline, no producer thread.
+    # make_batch runs on the producer thread (sequentially, one caller).
+    prefetch: int = 2
     seed: int = 0
 
 
@@ -177,11 +183,11 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         except (TypeError, ValueError):
             pass
         K = max(1, job.steps_per_call)
+        sample = job.make_batch(rng, 0)
         # one builder for the fused fn and the tail fallback, so the two can
         # never train with different semantics
         build = functools.partial(
-            build_train_step, loss_fn, job.optimizer, params,
-            job.make_batch(rng, 0),
+            build_train_step, loss_fn, job.optimizer, params, sample,
             mesh=mesh, rules=job.rules, seq_axis=job.seq_axis,
             merge_stats=job.merge_stats, grad_clip=job.grad_clip,
             accum_steps=job.accum_steps,
@@ -224,50 +230,91 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         metrics = {}
         prof = profile_steps()
         trc = tracer()
+        times = StageTimes()
+        deferred = DeferredMetrics()
+
+        def log_resolved(resolved):
+            """Log a boundary resolved by the deferred-readback helper:
+            metrics submitted at boundary N are read back (already landed
+            on host) and logged at boundary N+1, so float(loss) never
+            stalls the dispatch pipeline."""
+            if resolved is None:
+                return
+            pstep, t_submit, host = resolved
+            rate = (pstep - start_step) / max(t_submit - t0, 1e-9)
+            log.info("step %d loss=%.4f steps/s=%.2f",
+                     pstep, float(host["loss"]), rate)
+
+        # Input pipeline: batches/windows are built by a background
+        # producer (and, single-process, prestaged on device with the
+        # shardings the step was traced with); the loop only dequeues.
+        multi = jax.process_count() > 1
+        if mesh is not None and not multi:
+            single_sh = batch_shardings(
+                sample, mesh, seq_axis=job.seq_axis,
+                accum_steps=job.accum_steps)
+            window_sh = batch_shardings(
+                sample, mesh, seq_axis=job.seq_axis,
+                accum_steps=job.accum_steps,
+                steps_per_call=K) if K > 1 else None
+            nd0 = getattr(jax.tree_util.tree_leaves(sample)[0], "ndim", 0)
+
+            def pick_sharding(payload):
+                leaf0 = jax.tree_util.tree_leaves(payload)[0]
+                is_window = K > 1 and getattr(leaf0, "ndim", 0) == nd0 + 1
+                return window_sh if is_window else single_sh
+        else:
+            # multi-host: stay host-resident — the _globalize_batches
+            # wrapper inside step_fn assembles the per-process jax.Arrays
+            pick_sharding = None
+        loader = ShardedLoader(
+            job_window_source(job.make_batch, rng, start_step,
+                              job.total_steps, steps_per_call=K,
+                              force_host_windows=multi),
+            batch_sharding=pick_sharding, prefetch=job.prefetch,
+            place=not multi, timings=times)
+        t_dispatched = None  # end of the previous dispatch (host clock)
+
+        def dispatch(fn, batch):
+            """One step_fn/single_fn call, with the host gap between
+            consecutive dispatches (batch wait + logging + checkpoint
+            time) recorded as the `dispatch_gap` stage."""
+            nonlocal t_dispatched
+            if t_dispatched is not None:
+                times.add("dispatch_gap", time.perf_counter() - t_dispatched)
+            out = fn(state, batch)
+            t_dispatched = time.perf_counter()
+            return out
+
         try:
             step = start_step
             last_saved = -1  # dedups the stop-path save at a boundary step
             while step < job.total_steps:
                 k_here = min(K, job.total_steps - step)
                 prof.before(step, span=k_here)
-                if k_here == K and K > 1:
-                    window = [
-                        job.make_batch(jax.random.fold_in(rng, s), s)
-                        for s in range(step, step + K)
-                    ]
-                    # multi-host: stack on HOST — a jnp.stack would land
-                    # the window on device only for the globalization
-                    # wrapper to read it all back before re-sharding
-                    stack = (jnp.stack if jax.process_count() == 1
-                             else (lambda ls: np.stack(
-                                 [jax.device_get(x) for x in ls])))
-                    stacked = jax.tree_util.tree_map(
-                        lambda *ls: stack(ls), *window)
-                    state, metrics = step_fn(state, stacked)
-                    # fused metrics come back stacked [K]; report the last
-                    metrics = jax.tree_util.tree_map(
-                        lambda x: x[-1], metrics)
-                elif K > 1:
+                if k_here == K:
+                    # full window (K>1) or plain per-step batch (K==1),
+                    # prestaged by the loader
+                    state, metrics = dispatch(step_fn, next(loader))
+                    if K > 1:
+                        # fused metrics come back stacked [K]; report the last
+                        metrics = jax.tree_util.tree_map(
+                            lambda x: x[-1], metrics)
+                else:
                     # tail shorter than the fused window: per-step fallback
                     # (the scan length is fixed at trace time)
                     if single_fn is None:
                         single_fn = make_single_fn()
-                    for s in range(step, step + k_here):
-                        batch = job.make_batch(jax.random.fold_in(rng, s), s)
-                        state, metrics = single_fn(state, batch)
-                else:
-                    batch = job.make_batch(
-                        jax.random.fold_in(rng, step), step)
-                    state, metrics = step_fn(state, batch)
+                    for _ in range(k_here):
+                        state, metrics = dispatch(single_fn, next(loader))
                 prof.after(step, span=k_here)
                 step += k_here
                 trc.event("train_step", step=step, epoch=epoch)
                 if job.log_every and (
                         step % job.log_every < k_here):
-                    loss = float(metrics["loss"])
-                    rate = (step - start_step) / (time.perf_counter() - t0)
-                    log.info("step %d loss=%.4f steps/s=%.2f",
-                             step, loss, rate)
+                    # deferred readback: start the D2H copy for THIS
+                    # boundary, log the PREVIOUS one (already on host)
+                    log_resolved(deferred.start(step, metrics))
                 if job.checkpoint_dir and (
                         step % job.checkpoint_every < k_here):
                     save(step, state, epoch)
@@ -275,6 +322,10 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 if should_stop():
                     log.info("membership epoch moved at step %d; restarting",
                              step)
+                    # the elastic interrupt must not swallow the pending
+                    # deferred log boundary — it is the loss line closest
+                    # to the restart an operator will want to see
+                    log_resolved(deferred.resolve())
                     if job.checkpoint_dir:
                         # skip the rewrite when the periodic save just
                         # covered this exact step — the stop path only
@@ -287,8 +338,12 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 result["steps"] = step
         finally:
             # a step that raises mid-window must still finalize the device
-            # trace, or the capture is lost and re-entry hits "already active"
+            # trace, or the capture is lost and re-entry hits "already
+            # active" — and the producer thread must never outlive the cycle
             prof.close()
+            loader.close()
+            result["host_stages"] = times.summary()
+        log_resolved(deferred.resolve())  # flush the last pending boundary
         if metrics:
             result["loss"] = float(metrics["loss"])
         return True

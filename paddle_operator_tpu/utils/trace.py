@@ -101,6 +101,54 @@ def tracer() -> Tracer:
     return _global
 
 
+class StageTimes:
+    """Thread-safe accumulator of per-stage host time.
+
+    The async input pipeline (`data.ShardedLoader`) and the training loop
+    record where host wall-clock goes — ``batch_build`` (source pull +
+    window stack), ``device_put`` (H2D issue), ``enqueue_wait`` (producer
+    blocked on a full queue = consumer is the bottleneck), ``dequeue_wait``
+    (consumer starved = producer is the bottleneck), ``dispatch_gap`` (host
+    time between step dispatches). ``summary()`` is the breakdown bench.py
+    and ``run_training`` report.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._total[stage] = self._total.get(stage, 0.0) + seconds
+            self._count[stage] = self._count.get(stage, 0) + 1
+
+    @contextmanager
+    def timed(self, stage: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(stage, time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                stage: {
+                    "ms": round(self._total[stage] * 1e3, 3),
+                    "count": self._count[stage],
+                    "mean_ms": round(
+                        self._total[stage] * 1e3 / self._count[stage], 3),
+                }
+                for stage in sorted(self._total)
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._total.clear()
+            self._count.clear()
+
+
 class profile_steps:
     """Step-window gate for the XLA device profiler.
 

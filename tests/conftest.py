@@ -53,6 +53,9 @@ _SLOW_TESTS = {
     "test_remat_same_loss",
     "test_bert_moe_ep_train_step",
     "test_loss_mask_applies_to_labels",
+    # async-pipeline equivalence: compiles the single-step, fused-window
+    # AND tail programs back to back
+    "test_runner_windowed_prefetch_matches_inline",
 }
 
 

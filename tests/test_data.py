@@ -1,10 +1,14 @@
-"""Input-pipeline tests: sharding, prefetch, file source."""
+"""Input-pipeline tests: sharding, background prefetch, windows, file source."""
+
+import threading
+import time
 
 import numpy as np
 import pytest
 
 from paddle_operator_tpu.data import (
-    ShardedLoader, numpy_file_source, process_shard, synthetic_source,
+    DeferredMetrics, ShardedLoader, job_window_source, numpy_file_source,
+    process_shard, stack_window, synthetic_source,
 )
 
 
@@ -39,8 +43,8 @@ def test_sharded_loader_places_with_sharding():
     mesh = make_mesh({"dp": 8})
     sharding = {"x": named(mesh, P("dp"))}
     src = synthetic_source(lambda step: {"x": np.zeros((16, 3), np.float32)})
-    loader = ShardedLoader(src, batch_sharding=sharding, prefetch=1)
-    batch = next(loader)
+    with ShardedLoader(src, batch_sharding=sharding, prefetch=1) as loader:
+        batch = next(loader)
     assert batch["x"].sharding.spec == P("dp")
 
 
@@ -75,9 +79,217 @@ def test_process_shard_rejects_indivisible_batch():
         process_shard(batch, process_index=0, process_count=4)
 
 
-def test_numpy_file_source_rejects_undersized_shard(tmp_path):
+def test_numpy_file_source_skips_short_shard(tmp_path):
+    """One short tail shard must not kill a long run: it is skipped with a
+    warning and the full shards still stream."""
+    np.savez(tmp_path / "a_full.npz", x=np.arange(8))
+    np.savez(tmp_path / "b_tiny.npz", x=np.arange(3))
+    paths = sorted(str(p) for p in tmp_path.glob("*.npz"))
+    src = numpy_file_source(paths, batch_size=4, loop=False)
+    batches = list(src)
+    assert len(batches) == 2  # 2 batches from the full shard, tiny skipped
+    assert set(np.concatenate([b["x"] for b in batches])) == set(range(8))
+
+
+def test_numpy_file_source_all_short_epoch_raises(tmp_path):
+    """An epoch in which EVERY shard was short must raise, not silently
+    spin the training loop on an empty source forever."""
     path = tmp_path / "tiny.npz"
     np.savez(path, x=np.arange(3))
     src = numpy_file_source([str(path)], batch_size=8)
     with pytest.raises(ValueError, match="rows < batch_size"):
         next(src)
+
+
+# ---- background producer -------------------------------------------------
+
+
+def test_loader_background_thread_preserves_order():
+    """The producer thread feeds batches in source order, all of them."""
+    batches = iter([{"x": np.full((4,), i)} for i in range(20)])
+    with ShardedLoader(batches, prefetch=3) as loader:
+        seen = [float(b["x"][0]) for b in loader]
+    assert seen == [float(i) for i in range(20)]
+
+
+def test_loader_propagates_source_exception():
+    """A source exception is re-raised on the consumer thread after the
+    batches that preceded it, and the loader is exhausted afterwards."""
+
+    def source():
+        yield {"x": np.zeros((2,))}
+        yield {"x": np.ones((2,))}
+        raise RuntimeError("shard file corrupt")
+
+    with ShardedLoader(source(), prefetch=2) as loader:
+        assert float(next(loader)["x"][0]) == 0.0
+        assert float(next(loader)["x"][0]) == 1.0
+        with pytest.raises(RuntimeError, match="shard file corrupt"):
+            next(loader)
+        with pytest.raises(StopIteration):
+            next(loader)
+
+
+def test_loader_bounded_queue_backpressure():
+    """A full queue backpressures the producer: with nothing consumed, at
+    most prefetch batches sit in the queue plus one in the producer's
+    hands — the source is never drained ahead unboundedly."""
+    pulled = []
+
+    def source():
+        for i in range(100):
+            pulled.append(i)
+            yield {"x": np.full((2,), i)}
+
+    loader = ShardedLoader(source(), prefetch=2)
+    try:
+        deadline = time.time() + 5
+        while len(pulled) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.3)  # producer gets every chance to overrun
+        assert len(pulled) <= 3  # prefetch=2 queued + 1 blocked on put
+        next(loader)  # consuming one frees one slot
+        deadline = time.time() + 5
+        while len(pulled) < 4 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(pulled) <= 4
+    finally:
+        loader.close()
+
+
+def test_loader_close_joins_thread_while_blocked():
+    """close() must stop a producer blocked on a full queue — no leaked
+    thread, even when the consumer never drained a single batch."""
+    loader = ShardedLoader(
+        synthetic_source(lambda i: {"x": np.zeros((2,))}), prefetch=1)
+    time.sleep(0.05)  # let the producer fill the queue and block
+    thread = loader._thread
+    assert thread.is_alive()
+    loader.close()
+    assert not thread.is_alive()
+    loader.close()  # idempotent
+
+
+def test_loader_abandoned_without_close_is_collectable():
+    """An abandoned loader (caller never closed it) must not pin a
+    producer thread forever: the thread holds only a weakref between
+    items, so GC collects the loader and the producer exits."""
+    import gc
+
+    loader = ShardedLoader(
+        synthetic_source(lambda i: {"x": np.zeros((2,))}), prefetch=1)
+    time.sleep(0.05)  # producer up, queue full, producer in its retry loop
+    thread = loader._thread
+    del loader
+    gc.collect()
+    deadline = time.time() + 5
+    while thread.is_alive() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not thread.is_alive()
+
+
+def test_loader_prefetch_zero_is_inline():
+    """prefetch=0: no thread, fully synchronous pulls."""
+    loader = ShardedLoader(
+        iter([{"x": np.zeros((2,))}]), prefetch=0)
+    assert loader._thread is None
+    assert float(next(loader)["x"][0]) == 0.0
+    with pytest.raises(StopIteration):
+        next(loader)
+
+
+def test_loader_overlaps_build_with_consumer():
+    """The reason the loader exists: with a slow source, the producer
+    builds batch N+1 while the consumer holds batch N — consuming STEPS
+    batches costs ~max(build, consume) per step, not build + consume."""
+    build_s = 0.02
+
+    def slow(_i):
+        time.sleep(build_s)
+        return {"x": np.zeros((2,))}
+
+    n = 10
+    with ShardedLoader(synthetic_source(slow), prefetch=2) as loader:
+        next(loader)  # producer warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            next(loader)
+            time.sleep(build_s)  # the consumer's "compute"
+        overlapped = time.perf_counter() - t0
+    # serial would be n * 2 * build_s; require >=25% saved (CI-noise slack)
+    assert overlapped < n * 2 * build_s * 0.75, overlapped
+
+
+# ---- windows -------------------------------------------------------------
+
+
+def test_stack_window_numpy_stays_on_host():
+    """Host-resident batches stack via np.stack — NO device round trip
+    (the [K, ...] window the fused path consumes)."""
+    window = [{"x": np.full((4, 3), i, np.float32)} for i in range(3)]
+    stacked = stack_window(window)
+    assert isinstance(stacked["x"], np.ndarray)
+    assert stacked["x"].shape == (3, 4, 3)
+    assert stacked["x"][2, 0, 0] == 2.0
+
+
+def test_stack_window_device_leaves_stack_on_device():
+    import jax
+
+    window = [{"x": jax.numpy.full((4,), i)} for i in range(2)]
+    stacked = stack_window(window)
+    assert isinstance(stacked["x"], jax.Array)
+    assert stacked["x"].shape == (2, 4)
+    # force_host: multi-host globalization consumes host windows
+    hosted = stack_window(window, force_host=True)
+    assert isinstance(hosted["x"], np.ndarray)
+
+
+def test_job_window_source_full_windows_then_tail():
+    """K-windows while >= K steps remain, then per-step singles for the
+    tail — and the rng folding matches fold_in(rng, step) exactly."""
+    import jax
+
+    calls = []
+
+    def make_batch(rng, step):
+        calls.append((int(jax.random.key_data(rng)[-1]), step))
+        return {"x": np.full((2,), step, np.float32)}
+
+    rng = jax.random.PRNGKey(0)
+    items = list(job_window_source(make_batch, rng, 0, 7, steps_per_call=3))
+    # 2 full windows (steps 0-2, 3-5) + 1 single tail (step 6)
+    assert [i["x"].shape for i in items] == [(3, 2), (3, 2), (2,)]
+    assert items[0]["x"][:, 0].tolist() == [0.0, 1.0, 2.0]
+    assert items[2]["x"][0] == 6.0
+    expected_keys = [int(jax.random.key_data(
+        jax.random.fold_in(rng, s))[-1]) for s in range(7)]
+    assert [c[0] for c in calls] == expected_keys
+    assert [c[1] for c in calls] == list(range(7))
+
+
+def test_job_window_source_k1_yields_singles():
+    import jax
+
+    items = list(job_window_source(
+        lambda rng, step: {"x": np.full((2,), step)},
+        jax.random.PRNGKey(0), 2, 5, steps_per_call=1))
+    assert [i["x"][0] for i in items] == [2, 3, 4]
+
+
+# ---- deferred metrics ----------------------------------------------------
+
+
+def test_deferred_metrics_resolves_previous_on_start():
+    import jax.numpy as jnp
+
+    d = DeferredMetrics()
+    assert d.start(10, {"loss": jnp.float32(1.5)}) is None
+    resolved = d.start(20, {"loss": jnp.float32(2.5)})
+    assert resolved is not None
+    step, t_submit, host = resolved
+    assert step == 10
+    assert float(host["loss"]) == 1.5
+    step, _, host = d.resolve()
+    assert step == 20 and float(host["loss"]) == 2.5
+    assert d.resolve() is None  # flushed
