@@ -1,0 +1,30 @@
+"""Deterministic fault injection + convergence invariants.
+
+The chaos subsystem spans all three planes the operator must survive:
+
+* **API plane** (:mod:`.api_faults`) — injected 409/410/500/503 responses,
+  request latency, and watch disconnects against the operator's client;
+* **pod plane** (:mod:`.pod_faults`) — TPU maintenance-event preemptions,
+  OOM kills, and whole-slice drains driven through the kubelet simulator;
+* **data plane** (:mod:`.data_faults`) — stalls and transient source errors
+  inside the ShardedLoader producer.
+
+Schedules are :class:`~.plan.ChaosPlan`\\ s built deterministically from a
+``(scenario, seed)`` pair; :class:`~.harness.ChaosHarness` executes one and
+audits convergence invariants afterwards. ``scripts/chaos_stress.py`` sweeps
+seeds; every later scaling PR regression-tests against this harness.
+"""
+
+from .api_faults import ChaosKubeClient, FaultInjector
+from .data_faults import ChaosSourceError, FaultySource, run_loader_scenario
+from .harness import ChaosHarness, ChaosReport, run_scenario
+from .plan import CONTROL_SCENARIOS, SCENARIOS, ChaosPlan, FaultEvent, \
+    build_plan
+from .pod_faults import PodChaos
+
+__all__ = [
+    "ChaosHarness", "ChaosKubeClient", "ChaosPlan", "ChaosReport",
+    "ChaosSourceError", "CONTROL_SCENARIOS", "FaultEvent", "FaultInjector",
+    "FaultySource", "PodChaos", "SCENARIOS", "build_plan",
+    "run_loader_scenario", "run_scenario",
+]

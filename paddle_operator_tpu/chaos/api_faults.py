@@ -1,0 +1,146 @@
+"""API-plane fault injection: armed errors/latency + the client wrapper.
+
+:class:`FaultInjector` is the single accounting point for EVERY injected
+fault (API, pod, watch, loader): drivers call :meth:`record`, and the counts
+surface both in the per-seed chaos summary and as the
+``tpujob_chaos_faults_injected_total{kind=...}`` metric family.
+
+:class:`ChaosKubeClient` interposes on any :class:`KubeClient` — in the
+hermetic harness it wraps the reconciler's CachedKubeClient; against the
+envtest stub the same faults can be driven server-side via
+``StubApiServer.fault_hook``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..k8s.client import KubeClient
+from ..k8s.errors import (
+    ApiError, ConflictError, GoneError, NetworkError, ServerError,
+)
+
+_ERROR_BY_CODE = {
+    409: ConflictError,
+    410: GoneError,
+    500: ServerError,
+    503: NetworkError,
+}
+
+
+class FaultInjector:
+    """Armed API faults + the global injected-fault ledger."""
+
+    def __init__(self):
+        self.counts: Dict[str, int] = {}
+        self._armed: List[dict] = []
+
+    # -- ledger --------------------------------------------------------
+
+    def record(self, kind: str, n: int = 1) -> None:
+        self.counts[kind] = self.counts.get(kind, 0) + n
+
+    def kill_count(self) -> int:
+        """Total pod kills injected — the budget-consistency bound."""
+        return (self.counts.get("pod_preempt", 0)
+                + self.counts.get("pod_oom", 0))
+
+    def metrics_block(self) -> str:
+        """``tpujob_chaos_faults_injected_total`` exposition family, for
+        Manager.add_metrics_provider."""
+        name = "tpujob_chaos_faults_injected_total"
+        lines = [
+            "# HELP %s Chaos faults injected, by fault kind." % name,
+            "# TYPE %s counter" % name,
+        ]
+        for kind in sorted(self.counts):
+            lines.append('%s{kind="%s"} %d' % (name, kind, self.counts[kind]))
+        return "\n".join(lines)
+
+    # -- arming --------------------------------------------------------
+
+    def arm_error(self, code: int, count: int = 1,
+                  verbs: Tuple[str, ...] = ("any",)) -> None:
+        if code not in _ERROR_BY_CODE:
+            raise ValueError("unsupported chaos error code %d" % code)
+        self._armed.append({"type": "error", "code": code,
+                            "verbs": tuple(verbs), "remaining": int(count)})
+
+    def arm_latency(self, seconds: float, count: int = 1,
+                    verbs: Tuple[str, ...] = ("any",)) -> None:
+        self._armed.append({"type": "latency", "seconds": float(seconds),
+                            "verbs": tuple(verbs), "remaining": int(count)})
+
+    # -- the interposition point ----------------------------------------
+
+    def before(self, verb: str, kind: str) -> None:
+        """Called by ChaosKubeClient ahead of every API call. Fires at most
+        one armed fault per call: latency sleeps, errors raise. Event
+        writes are exempt — the recorder is best-effort by contract and a
+        fault consumed by it would be silently wasted."""
+        if kind == "Event" or not self._armed:
+            return
+        for fault in self._armed:
+            if fault["remaining"] <= 0:
+                continue
+            if fault["verbs"] != ("any",) and verb not in fault["verbs"]:
+                continue
+            fault["remaining"] -= 1
+            if fault["type"] == "latency":
+                self.record("api_latency")
+                time.sleep(fault["seconds"])
+                return
+            self.record("api_error_%d" % fault["code"])
+            raise _ERROR_BY_CODE[fault["code"]](
+                "chaos: injected %d on %s %s" % (fault["code"], verb, kind))
+
+
+class ChaosKubeClient(KubeClient):
+    """Passes every call through ``injector.before(verb, kind)`` first."""
+
+    def __init__(self, inner: KubeClient, injector: FaultInjector):
+        self.inner = inner
+        self.injector = injector
+
+    def register_kind(self, api_version, kind, plural):
+        self.inner.register_kind(api_version, kind, plural)
+
+    def get(self, kind, namespace, name):
+        self.injector.before("get", kind)
+        return self.inner.get(kind, namespace, name)
+
+    def list(self, kind, namespace=None, label_selector=None):
+        self.injector.before("list", kind)
+        return self.inner.list(kind, namespace, label_selector)
+
+    def list_owned(self, kind, owner, namespace=None):
+        self.injector.before("list", kind)
+        return self.inner.list_owned(kind, owner, namespace)
+
+    def create(self, obj):
+        self.injector.before("create", obj.get("kind", ""))
+        return self.inner.create(obj)
+
+    def update(self, obj):
+        self.injector.before("update", obj.get("kind", ""))
+        return self.inner.update(obj)
+
+    def update_status(self, obj):
+        self.injector.before("update_status", obj.get("kind", ""))
+        return self.inner.update_status(obj)
+
+    def delete(self, kind, namespace, name):
+        self.injector.before("delete", kind)
+        self.inner.delete(kind, namespace, name)
+
+    def watch(self, kind, namespace=None, resource_version=None,
+              timeout_seconds=300):
+        return self.inner.watch(kind, namespace, resource_version,
+                                timeout_seconds)
+
+    def exec_in_pod(self, namespace, pod_name, container, command,
+                    timeout=60.0):
+        self.injector.before("exec", "Pod")
+        return self.inner.exec_in_pod(namespace, pod_name, container,
+                                      command, timeout)

@@ -1,0 +1,354 @@
+"""ChaosHarness — run the operator under a seeded fault plan, then audit.
+
+One run = OperatorHarness (fake apiserver + informer cache + reconciler +
+kubelet simulator) + a :class:`ChaosPlan` executed tick by tick:
+
+    for tick:  fire due faults → manager.drain() → sim.step() → clear kills
+
+until quiescence (no apiserver writes, no kubelet transitions, empty
+workqueues, no pending kills, for two consecutive ticks) or the tick budget
+runs out. Everything on the path is deterministic and single-threaded, so a
+``(scenario, seed)`` pair replays byte-identically — any failure report
+prints the seed and the seed IS the repro.
+
+After the run, :meth:`ChaosHarness.check_invariants` audits the world:
+
+* **convergence** — every job is terminal (Completed/Failed) or steadily
+  Running; nothing is stuck Pending/Starting/Restarting;
+* **gang atomicity** — a Running job has exactly ``replicas`` pods, all
+  real-running, never a partial gang;
+* **no orphans** — every controller-owned Pod/Service/ConfigMap/PodGroup
+  has a live owner, and nothing is wedged mid-deletion;
+* **budget consistency** — preemption/app-failure restart counters never
+  exceed their budgets nor the number of injected kills;
+* **barrier/membership** — non-elastic Running jobs have their ConfigMap
+  barrier; elastic Running jobs' published world size matches the spec.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..api import types as api
+from ..controllers import helper
+from ..elastic.sync import np_key
+from ..k8s.errors import NotFoundError
+from ..testing import OperatorHarness
+from .api_faults import ChaosKubeClient, FaultInjector
+from .data_faults import run_loader_scenario
+from .plan import CONTROL_SCENARIOS, ChaosPlan, build_plan
+from .pod_faults import PodChaos
+
+
+class ChaosReport:
+    def __init__(self, scenario: str, seed: int, converged: bool, ticks: int,
+                 faults: Dict[str, int], jobs: Dict[str, dict],
+                 violations: List[str], wall_s: float):
+        self.scenario = scenario
+        self.seed = seed
+        self.converged = converged
+        self.ticks = ticks
+        self.faults = faults
+        self.jobs = jobs
+        self.violations = violations
+        self.wall_s = wall_s
+
+    def fingerprint(self) -> dict:
+        """Everything that must be identical on a same-seed re-run
+        (wall time excluded)."""
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "converged": self.converged,
+            "ticks": self.ticks,
+            "faults": dict(sorted(self.faults.items())),
+            "jobs": self.jobs,
+            "violations": list(self.violations),
+        }
+
+    def summary_line(self) -> str:
+        faults = " ".join("%s=%d" % kv for kv in sorted(self.faults.items()))
+        jobs = " ".join(
+            "%s=%s(pr=%d,ar=%d)" % (name, st["phase"],
+                                    st["preemptionRestarts"],
+                                    st["appFailureRestarts"])
+            for name, st in sorted(self.jobs.items()))
+        return ("[%s seed=%d] %s ticks=%d %.2fs  faults: %s  jobs: %s  "
+                "violations=%d"
+                % (self.scenario, self.seed,
+                   "converged" if self.converged else "DID NOT CONVERGE",
+                   self.ticks, self.wall_s, faults or "-", jobs or "-",
+                   len(self.violations)))
+
+
+class ChaosHarness:
+    """One control-plane chaos run (see :mod:`.plan` for scenarios)."""
+
+    def __init__(self, plan: ChaosPlan):
+        if plan.scenario not in CONTROL_SCENARIOS:
+            raise ValueError("%s is not a control-plane scenario"
+                             % plan.scenario)
+        self.plan = plan
+        self.injector = FaultInjector()
+        self.h = OperatorHarness(
+            client_middleware=lambda c: ChaosKubeClient(c, self.injector))
+        self.h.manager.add_metrics_provider(self.injector.metrics_block)
+        self.pod_chaos = PodChaos(self.h.sim, self.h.client, self.injector)
+        # run-time rng (target picks) — separate stream from plan building,
+        # same determinism contract
+        self._rng = random.Random("chaos-run:%s:%d"
+                                  % (plan.scenario, plan.seed))
+        self._jobs: List[str] = []
+        self._create_workload()
+
+    # -- workload -------------------------------------------------------
+
+    def _role(self, replicas: int) -> dict:
+        return {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "main", "image": "img"}]}}}
+
+    def _create_workload(self) -> None:
+        s = self.plan.scenario
+        if s == "preemption_burst":
+            self._add_job(api.new_tpujob("burst", spec={
+                "device": "tpu",
+                "tpu": {"accelerator": "v5e", "topology": "4x8"},
+                "worker": self._role(4), "elastic": 1,
+            }))
+        elif s == "apiserver_flake":
+            self._add_job(api.new_tpujob("flake", spec={
+                "ps": self._role(1), "worker": self._role(2),
+                "intranet": "Service",
+            }))
+        elif s == "slice_drain_resize":
+            self._add_job(api.new_tpujob("drainy", spec={
+                "device": "tpu",
+                "tpu": {"accelerator": "v5e", "topology": "4x8"},
+                "worker": self._role(4), "elastic": 1,
+            }))
+
+    def _add_job(self, job: dict) -> None:
+        self.h.create_job(job)
+        self._jobs.append(job["metadata"]["name"])
+
+    # -- fault dispatch --------------------------------------------------
+
+    def _job_pods(self, job_name: str) -> List[dict]:
+        try:
+            obj = self.h.client.get(api.KIND, "default", job_name)
+        except NotFoundError:
+            return []
+        pods = self.h.client.list_owned("Pod", obj)
+        return sorted(pods, key=lambda p: p["metadata"]["name"])
+
+    def _fire(self, ev) -> None:
+        p = ev.params
+        if ev.kind == "api_error":
+            self.injector.arm_error(p["code"], count=p.get("count", 1))
+        elif ev.kind == "api_latency":
+            self.injector.arm_latency(p["seconds"], count=p.get("count", 1))
+        elif ev.kind == "watch_drop":
+            self.h.client.suspend_watch(p.get("kind"))
+            self.injector.record("watch_drop")
+        elif ev.kind == "watch_restore":
+            kind = p.get("kind")
+            self.h.client.resume_watch(kind)
+            self.injector.record("watch_restore")
+            # heal the staleness the way a real informer does: re-list
+            for k in ([kind] if kind else self.h.cache.kinds()):
+                self.h.cache.resync(k)
+        elif ev.kind in ("pod_preempt", "pod_oom"):
+            pods = [pod for pod in self._job_pods(p["job"])
+                    if (pod.get("status") or {}).get("phase")
+                    not in ("Failed", "Succeeded")]
+            if not pods:
+                return
+            pod = pods[self._rng.randrange(len(pods))]
+            if ev.kind == "pod_preempt":
+                self.pod_chaos.preempt(pod)
+            else:
+                self.pod_chaos.oom_kill(pod)
+        elif ev.kind == "slice_drain":
+            pods = [pod for pod in self._job_pods(p["job"])
+                    if (pod.get("status") or {}).get("phase")
+                    not in ("Failed", "Succeeded")]
+            if pods:
+                self.pod_chaos.drain_slice(pods)
+        elif ev.kind == "elastic_resize":
+            self.injector.record("elastic_resize")
+
+            def mutate(obj, params=p):
+                obj["spec"]["worker"]["replicas"] = params["replicas"]
+                obj["spec"]["tpu"]["topology"] = params["topology"]
+            try:
+                self.h.update_job_spec(p["job"], mutate)
+            except NotFoundError:
+                pass
+        else:
+            raise ValueError("unknown fault kind %r" % ev.kind)
+
+    # -- the run ----------------------------------------------------------
+
+    def run(self) -> ChaosReport:
+        t0 = time.perf_counter()
+        events = deque(self.plan.events)
+        max_ticks = self.plan.horizon
+        converged = False
+        ticks = 0
+        stable = 0
+        for tick in range(max_ticks):
+            ticks = tick + 1
+            fired = False
+            while events and events[0].tick <= tick:
+                self._fire(events.popleft())
+                fired = True
+            rv_before = self.h.client.resource_version
+            self.h.manager.drain()
+            sim_changed = self.h.sim.step()
+            self.pod_chaos.tick()
+            # deferred counts as pending work: an error-backoff retry parked
+            # by the LAST injected fault must still get its clean pass
+            # before the run may call itself quiesced
+            queues_empty = all(
+                len(c.queue) == 0 and c.queue.pending_deferred == 0
+                for c in self.h.manager.controllers)
+            if (not fired and not events
+                    and rv_before == self.h.client.resource_version
+                    and not sim_changed and queues_empty
+                    and self.pod_chaos.pending == 0):
+                stable += 1
+                if stable >= 2:
+                    converged = True
+                    break
+            else:
+                stable = 0
+        violations = self.check_invariants(converged, ticks)
+        jobs = self._job_states()
+        self.h.close()
+        return ChaosReport(self.plan.scenario, self.plan.seed, converged,
+                           ticks, dict(self.injector.counts), jobs,
+                           violations, time.perf_counter() - t0)
+
+    def _job_states(self) -> Dict[str, dict]:
+        out = {}
+        for name in self._jobs:
+            try:
+                job = self.h.get_job(name)
+            except NotFoundError:
+                out[name] = {"phase": "<deleted>",
+                             "preemptionRestarts": 0, "appFailureRestarts": 0}
+                continue
+            out[name] = {
+                "phase": job.phase,
+                "preemptionRestarts": int(
+                    job.status.get("preemptionRestarts") or 0),
+                "appFailureRestarts": int(
+                    job.status.get("appFailureRestarts") or 0),
+            }
+        return out
+
+    # -- invariants -------------------------------------------------------
+
+    def check_invariants(self, converged: bool, ticks: int) -> List[str]:
+        v: List[str] = []
+        store = self.h.client
+        if not converged:
+            v.append("did not quiesce within %d ticks" % ticks)
+
+        # ownership: every controller-owned object has a live owner, and
+        # nothing is wedged mid-deletion
+        uids = {o["metadata"].get("uid")
+                for o in store.all_objects() if o.get("kind") != "Event"}
+        for obj in store.all_objects():
+            kind = obj.get("kind")
+            if kind == "Event":
+                continue
+            meta = obj.get("metadata", {})
+            if meta.get("deletionTimestamp"):
+                v.append("%s %s stuck terminating at quiescence"
+                         % (kind, meta.get("name")))
+            for ref in meta.get("ownerReferences") or []:
+                if ref.get("controller") and ref.get("uid") not in uids:
+                    v.append("orphaned %s %s (owner %s/%s gone)"
+                             % (kind, meta.get("name"), ref.get("kind"),
+                                ref.get("name")))
+
+        kills = self.injector.kill_count()
+        for name in self._jobs:
+            try:
+                job = api.TpuJob(store.get(api.KIND, "default", name))
+            except NotFoundError:
+                continue
+            phase = job.phase
+            if phase not in (api.Phase.RUNNING, api.Phase.COMPLETED,
+                             api.Phase.FAILED):
+                v.append("job %s stuck in non-terminal phase %r"
+                         % (name, phase))
+
+            pr = int(job.status.get("preemptionRestarts") or 0)
+            ar = int(job.status.get("appFailureRestarts") or 0)
+            if pr > helper.preemption_budget(job):
+                v.append("job %s preemptionRestarts %d exceeds budget %d"
+                         % (name, pr, helper.preemption_budget(job)))
+            if ar > helper.app_failure_budget(job):
+                v.append("job %s appFailureRestarts %d exceeds budget %d"
+                         % (name, ar, helper.app_failure_budget(job)))
+            if pr + ar > kills:
+                v.append("job %s counted %d restarts but only %d kills "
+                         "were injected" % (name, pr + ar, kills))
+            if kills and job.elastic is not None and \
+                    phase == api.Phase.RUNNING and pr + ar == 0:
+                v.append("job %s recovered to Running but no restart "
+                         "was counted against %d injected kills"
+                         % (name, kills))
+
+            if phase != api.Phase.RUNNING:
+                continue
+            # gang atomicity at quiescence: full complement, all running
+            total = helper.get_total_replicas(job)
+            pods = store.list_owned("Pod", job.obj)
+            if len(pods) != total:
+                v.append("job %s Running with partial gang: %d/%d pods"
+                         % (name, len(pods), total))
+            for pod in pods:
+                if not helper.is_pod_real_running(pod):
+                    v.append("job %s Running but pod %s is not"
+                             % (name, pod["metadata"]["name"]))
+            if job.elastic is None:
+                try:
+                    store.get("ConfigMap", "default", name)
+                except NotFoundError:
+                    v.append("job %s Running without its ConfigMap barrier"
+                             % name)
+            elif self.h.kv is not None:
+                want = str((job.spec.get(api.RES_WORKER)
+                            or {}).get("replicas"))
+                got = self.h.kv.get(np_key("default", name))
+                if got != want:
+                    v.append("job %s published np=%s but spec says %s"
+                             % (name, got, want))
+
+        for ctrl in self.h.manager.controllers:
+            if len(ctrl.queue):
+                v.append("workqueue %s not drained (%d keys)"
+                         % (ctrl.name, len(ctrl.queue)))
+        return v
+
+
+def run_scenario(scenario: str, seed: int, quick: bool = True) -> ChaosReport:
+    """Build the plan and run one scenario to a report (the one entry point
+    tests and scripts/chaos_stress.py share)."""
+    plan = build_plan(scenario, seed, quick=quick)
+    if scenario == "loader_faults":
+        t0 = time.perf_counter()
+        injector = FaultInjector()
+        summary, violations = run_loader_scenario(plan, injector)
+        return ChaosReport(
+            scenario, seed, converged=summary["delivered"] > 0,
+            ticks=summary["batches"], faults=dict(injector.counts),
+            jobs={}, violations=violations,
+            wall_s=time.perf_counter() - t0)
+    return ChaosHarness(plan).run()

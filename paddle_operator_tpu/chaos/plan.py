@@ -1,0 +1,170 @@
+"""ChaosPlan — seeded, deterministic fault schedules.
+
+A plan is a list of :class:`FaultEvent`\\ s pinned to harness ticks. All
+randomness is drawn from ``random.Random(scenario + seed)`` at *plan build
+time*, so the schedule — and therefore the whole run, since the harness
+executes single-threaded against deterministic components — replays
+byte-identically from ``(scenario, seed)``. That is the debugging contract:
+any invariant violation prints its seed, and the seed reproduces the run.
+
+Fault taxonomy (``FaultEvent.kind``):
+
+========================  ====================================================
+``api_error``             arm N apiserver errors (409/410/500/503) on the
+                          operator's client calls
+``api_latency``           arm N slow apiserver round trips
+``watch_drop``            disconnect watch delivery for a kind (subscribers
+                          go stale; writes still land)
+``watch_restore``         reconnect + force the informer re-list that heals
+                          the staleness
+``pod_preempt``           kill one pod with TPU maintenance-event semantics
+                          (eviction reason + SIGKILL exit 137)
+``pod_oom``               kill one pod OOMKilled (exit 137, container-level
+                          reason, NO eviction reason — an APP failure)
+``slice_drain``           preempt every pod of a job at once (the physical
+                          TPU slice goes down for maintenance)
+``elastic_resize``        mutate worker replicas + topology mid-run
+``loader_error``          transient source error inside the input pipeline
+``loader_stall``          producer-side stall inside the input pipeline
+========================  ====================================================
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+#: control-plane scenarios run the operator harness; ``loader_faults`` runs
+#: the data plane only (ShardedLoader + FaultySource).
+CONTROL_SCENARIOS = (
+    "preemption_burst", "apiserver_flake", "slice_drain_resize",
+)
+SCENARIOS = CONTROL_SCENARIOS + ("loader_faults",)
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    tick: int
+    kind: str
+    params: dict = field(default_factory=dict)
+
+
+class ChaosPlan:
+    def __init__(self, scenario: str, seed: int,
+                 events: List[FaultEvent], horizon: int):
+        self.scenario = scenario
+        self.seed = seed
+        # stable sort preserves generation order within a tick
+        self.events = sorted(events, key=lambda e: e.tick)
+        self.horizon = horizon
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    def __repr__(self):
+        return "ChaosPlan(%s, seed=%d, %d events, horizon=%d)" % (
+            self.scenario, self.seed, len(self.events), self.horizon)
+
+
+def _plan_rng(scenario: str, seed: int) -> random.Random:
+    # string seeding hashes the bytes (sha512), NOT hash() — stable across
+    # processes regardless of PYTHONHASHSEED
+    return random.Random("chaos:%s:%d" % (scenario, seed))
+
+
+def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
+    if scenario not in SCENARIOS:
+        raise ValueError("unknown scenario %r (have %s)"
+                         % (scenario, ", ".join(SCENARIOS)))
+    rng = _plan_rng(scenario, seed)
+    builder = {
+        "preemption_burst": _preemption_burst,
+        "apiserver_flake": _apiserver_flake,
+        "slice_drain_resize": _slice_drain_resize,
+        "loader_faults": _loader_faults,
+    }[scenario]
+    events, horizon = builder(rng, quick)
+    return ChaosPlan(scenario, seed, events, horizon)
+
+
+# ---------------------------------------------------------------------------
+# scenario schedules
+# ---------------------------------------------------------------------------
+
+def _preemption_burst(rng: random.Random, quick: bool
+                      ) -> Tuple[List[FaultEvent], int]:
+    """Maintenance events hit an elastic slice several times in a short
+    window; one run in two also OOM-kills a container so both budgets get
+    spent in the same incident stream."""
+    events = []
+    n_kills = rng.randint(2, 4)
+    for _ in range(n_kills):
+        events.append(FaultEvent(rng.randint(4, 14), "pod_preempt",
+                                 {"job": "burst"}))
+    if rng.random() < 0.5:
+        events.append(FaultEvent(rng.randint(6, 16), "pod_oom",
+                                 {"job": "burst"}))
+    return events, 48 if quick else 96
+
+
+def _apiserver_flake(rng: random.Random, quick: bool
+                     ) -> Tuple[List[FaultEvent], int]:
+    """A flaking apiserver during bring-up: 5xx/conflict bursts, request
+    latency, and a dropped pod watch that leaves the operator reconciling
+    against a stale cache until the re-list heals it."""
+    events = []
+    for _ in range(rng.randint(2, 4)):
+        events.append(FaultEvent(
+            rng.randint(1, 10), "api_error",
+            {"code": rng.choice([500, 500, 409, 410, 503]),
+             "count": rng.randint(1, 3)}))
+    for _ in range(rng.randint(1, 2)):
+        events.append(FaultEvent(
+            rng.randint(1, 10), "api_latency",
+            {"seconds": rng.choice([0.001, 0.002, 0.005]),
+             "count": rng.randint(1, 3)}))
+    t0 = rng.randint(2, 8)
+    events.append(FaultEvent(t0, "watch_drop", {"kind": "Pod"}))
+    events.append(FaultEvent(t0 + rng.randint(2, 4), "watch_restore",
+                             {"kind": "Pod"}))
+    return events, 48 if quick else 96
+
+
+def _slice_drain_resize(rng: random.Random, quick: bool
+                        ) -> Tuple[List[FaultEvent], int]:
+    """The hardest composite: the whole physical slice drains for
+    maintenance while the user resizes the elastic job — the resize and the
+    whole-slice restart race through the same reconcile loop. Sometimes an
+    apiserver error lands mid-incident for good measure."""
+    drain_at = rng.randint(4, 10)
+    events = [FaultEvent(drain_at, "slice_drain", {"job": "drainy"})]
+    events.append(FaultEvent(
+        drain_at + rng.randint(0, 2), "elastic_resize",
+        {"job": "drainy", "replicas": 8, "topology": "8x8"}))
+    if rng.random() < 0.5:
+        events.append(FaultEvent(
+            drain_at + rng.randint(4, 8), "elastic_resize",
+            {"job": "drainy", "replicas": 4, "topology": "4x8"}))
+    if rng.random() < 0.5:
+        events.append(FaultEvent(
+            rng.randint(drain_at, drain_at + 3), "api_error",
+            {"code": 500, "count": rng.randint(1, 2)}))
+    return events, 60 if quick else 120
+
+
+def _loader_faults(rng: random.Random, quick: bool
+                   ) -> Tuple[List[FaultEvent], int]:
+    """Data-plane schedule: batch indices (not harness ticks) at which the
+    source stalls or fails once, transiently."""
+    n = 30 if quick else 120
+    error_at = rng.randrange(5, n // 2)
+    stalls = sorted(rng.sample(range(n), k=3))
+    events = [FaultEvent(error_at, "loader_error", {})]
+    events.extend(FaultEvent(s, "loader_stall",
+                             {"seconds": 0.002 if quick else 0.01})
+                  for s in stalls)
+    return events, n

@@ -1,0 +1,69 @@
+"""Pod-plane fault drivers: preemption, OOM kills, whole-slice drains.
+
+Sits on top of :class:`~paddle_operator_tpu.k8s.podsim.PodSimulator` and owns
+the one piece of bookkeeping podsim deliberately leaves to the caller: a
+`finish` request is sticky, so a replacement pod recreated under the same
+name would be killed again forever. :meth:`PodChaos.tick` clears each kill
+once it has been observed applied (pod Failed, or the object already gone),
+turning one injected fault into exactly one incident.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..k8s.errors import NotFoundError
+from .api_faults import FaultInjector
+
+
+class PodChaos:
+    def __init__(self, sim, client, injector: FaultInjector):
+        self.sim = sim
+        self.client = client  # the raw store client (no fault interposition)
+        self.injector = injector
+        self._pending: Set[Tuple[str, str]] = set()  # (ns, pod name)
+
+    # -- kills ----------------------------------------------------------
+
+    def preempt(self, pod: dict, reason: str = "Terminated") -> None:
+        """TPU maintenance event / spot reclaim on the pod's host."""
+        name = pod["metadata"]["name"]
+        self.sim.preempt(name, reason=reason)
+        self.injector.record("pod_preempt")
+        self._pending.add((pod["metadata"].get("namespace", "default"), name))
+
+    def oom_kill(self, pod: dict) -> None:
+        """Kernel OOM-kills the training container (an APP failure)."""
+        name = pod["metadata"]["name"]
+        self.sim.oom_kill(name)
+        self.injector.record("pod_oom")
+        self._pending.add((pod["metadata"].get("namespace", "default"), name))
+
+    def drain_slice(self, pods: List[dict], reason: str = "Terminated") -> None:
+        """The whole physical slice goes down at once: every pod of the job
+        gets the maintenance-event kill in the same tick."""
+        self.injector.record("slice_drain")
+        for pod in pods:
+            self.preempt(pod, reason=reason)
+
+    # -- per-tick upkeep -------------------------------------------------
+
+    def tick(self) -> None:
+        """Clear kills that have been applied, so replacements run. A kill
+        whose pod vanished before it applied (scale-down raced it) is
+        cleared too — the fault targeted a pod that no longer exists."""
+        for ns, name in list(self._pending):
+            try:
+                pod = self.client.get("Pod", ns, name)
+            except NotFoundError:
+                self.sim.clear(name)
+                self._pending.discard((ns, name))
+                continue
+            if (pod.get("status") or {}).get("phase") in ("Failed",
+                                                          "Succeeded"):
+                self.sim.clear(name)
+                self._pending.discard((ns, name))
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)

@@ -13,8 +13,9 @@ differences are called out inline.
 from __future__ import annotations
 
 import logging
+import zlib
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..api import types as api
 from ..elastic.store import KVStore
@@ -50,6 +51,8 @@ class TpuJobReconciler:
         port_allocator: Optional[PortRangeAllocator] = None,
         kv_store: Optional[KVStore] = None,
         coordination_url: str = "",
+        backoff_base: float = 1.0,
+        backoff_cap: float = 30.0,
     ):
         self.client = client
         self.recorder = recorder or EventRecorder(client, "tpujob-controller")
@@ -66,12 +69,66 @@ class TpuJobReconciler:
         # jobs already warned about exec-release failure: the failure
         # repeats every 1s requeue pass, the Event must not (apiserver flood)
         self._exec_release_warned: set = set()
+        # Error-path requeue backoff: consecutive failing passes on the
+        # same key escalate requeue_after exponentially (base*2^n, capped)
+        # with deterministic jitter, instead of the old fixed 1.0s — under
+        # a flaking apiserver a fixed cadence hammers it in lockstep.
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._err_streak: Dict[Tuple[str, str], int] = {}
+        self._err_hit: set = set()
+
+    # ------------------------------------------------------------------
+    # error-requeue backoff
+    # ------------------------------------------------------------------
+
+    def _backoff_for(self, key: Tuple[str, str], n: int) -> float:
+        # cap the exponent BEFORE 2**: a key failing for days would
+        # otherwise grow a multi-kilobyte big int per pass just to be
+        # discarded by min()
+        base = min(self.backoff_base * (2 ** min(n - 1, 32)),
+                   self.backoff_cap)
+        # jitter must be deterministic (chaos runs replay byte-identically
+        # from a seed), so derive it from (key, streak), not a global rng
+        salt = zlib.crc32(("%s/%s#%d" % (key[0], key[1], n)).encode())
+        return base * (0.5 + 0.5 * (salt % 1000) / 999.0)
+
+    def _requeue_error(self, key: Tuple[str, str]) -> Result:
+        """An error-path requeue: escalate this key's streak and park it
+        for the backed-off delay. The wrapper resets the streak on the
+        first pass that completes without calling this."""
+        self._err_hit.add(key)
+        n = self._err_streak.get(key, 0) + 1
+        self._err_streak[key] = n
+        return Result(requeue_after=self._backoff_for(key, n))
+
+    def current_backoff(self) -> float:
+        """Max armed error-requeue backoff in seconds (workqueue gauge)."""
+        out = 0.0
+        for key, n in self._err_streak.items():
+            out = max(out, self._backoff_for(key, n))
+        return out
 
     # ------------------------------------------------------------------
     # main loop
     # ------------------------------------------------------------------
 
     def reconcile(self, namespace: str, name: str) -> Result:
+        key = (namespace, name)
+        self._err_hit.discard(key)
+        try:
+            result = self._reconcile(namespace, name)
+        except Exception:
+            # a panicking pass keeps its streak: the Controller's own retry
+            # backoff requeues it, and the NEXT error-path requeue must
+            # start from the escalated delay, not from scratch
+            self._err_streak[key] = self._err_streak.get(key, 0) + 1
+            raise
+        if key not in self._err_hit:
+            self._err_streak.pop(key, None)
+        return result
+
+    def _reconcile(self, namespace: str, name: str) -> Result:
         try:
             obj = self.client.get(api.KIND, namespace, name)
         except NotFoundError:
@@ -108,7 +165,7 @@ class TpuJobReconciler:
             try:
                 self.client.update_status(job.obj)
             except ConflictError:
-                return Result(requeue_after=1.0)
+                return self._requeue_error((namespace, name))
             except NotFoundError:
                 return Result()
 
@@ -163,7 +220,7 @@ class TpuJobReconciler:
                 np = sync_np(self.kv, job)
             except Exception as e:  # store unreachable — surface and retry
                 log.error("elastic sync failed: %s", e)
-                return Result(requeue=True)
+                return self._requeue_error((namespace, name))
             if np is not None:
                 self.recorder.event(
                     job.obj, "Normal", "Scaled", "scaled replicas to %s" % np
@@ -274,7 +331,7 @@ class TpuJobReconciler:
                 epoch = bump_epoch(self.kv, job)
             except Exception as e:  # store unreachable — surface and retry
                 log.error("elastic epoch bump failed: %s", e)
-                return Result(requeue=True)
+                return self._requeue_error((job.namespace, job.name))
         for pod in fresh:
             self._delete_resource(job, pod)
         # Increment the restart count against the FRESH object: job.obj's

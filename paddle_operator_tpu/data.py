@@ -162,7 +162,8 @@ class ShardedLoader:
 
     def __init__(self, source: Iterator[Any], batch_sharding=None,
                  prefetch: int = 2, place: bool = True,
-                 timings: Optional[StageTimes] = None):
+                 timings: Optional[StageTimes] = None,
+                 fault_hook: Optional[Callable[[str], None]] = None):
         import jax
 
         self._source = source
@@ -170,6 +171,11 @@ class ShardedLoader:
         self._prefetch = max(0, int(prefetch))
         self._do_place = place
         self._timings = timings
+        # chaos hook: called with the stage name ("batch_build") right
+        # before each source pull, ON the producer thread — sleep inside it
+        # to inject a stall, raise to inject a transient source error (it
+        # re-raises on the consumer exactly like a source exception)
+        self._fault_hook = fault_hook
         self._proc = jax.process_index()
         self._nproc = jax.process_count()
         self._exhausted = False
@@ -232,6 +238,8 @@ class ShardedLoader:
         if self._staged is None:
             try:
                 with self._timed("batch_build"):
+                    if self._fault_hook is not None:
+                        self._fault_hook("batch_build")
                     nxt = next(self._source)
             except StopIteration:
                 self._staged, self._final = ("end", None), True
@@ -270,6 +278,8 @@ class ShardedLoader:
         if not self._prefetch:
             with self._timed("batch_build"):
                 try:
+                    if self._fault_hook is not None:
+                        self._fault_hook("batch_build")
                     nxt = next(self._source)
                 except StopIteration:
                     self._exhausted = True
@@ -294,6 +304,12 @@ class ShardedLoader:
         raise StopIteration
 
     # ---- lifecycle ---------------------------------------------------------
+
+    def producer_alive(self) -> bool:
+        """True while the background producer thread exists and runs —
+        False after close() (or for prefetch=0). The chaos harness's
+        no-thread-leak invariant reads this."""
+        return self._thread is not None and self._thread.is_alive()
 
     def close(self) -> None:
         """Stop the producer and join its thread (idempotent)."""

@@ -28,6 +28,24 @@ from .errors import ApiError
 from .fake import FakeKubeClient
 from .objects import deep_copy
 
+
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that treats client-side connection teardown as
+    routine. A client dropping a keep-alive socket mid-request (watch
+    resumption, test teardown, an injected disconnect) otherwise escapes to
+    socketserver.handle_error, which prints 'Exception occurred during
+    processing of request' straight to stderr — interleaving with (and
+    corrupting) pytest's progress output."""
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            ConnectionAbortedError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
+
 # plural -> kind for the core routes HttpKubeClient knows out of the box
 _BUILTIN_PLURALS = {
     "pods": "Pod",
@@ -49,6 +67,12 @@ class StubApiServer:
         # WebSocket exec route: fn(ns, pod, container, command) -> stdout.
         # Raising -> Failure status on channel 3 (like a real kubelet).
         self.exec_handler = None
+        # chaos hook: fn(method, kind, subresource) called after auth+route
+        # on every request; raise ApiError -> apimachinery Status error body
+        # (injected 409/410/500), sleep inside it -> request latency. Watch
+        # faults use inject_error_event/compact, which this server already
+        # models natively.
+        self.fault_hook = None
         self.exec_calls: List[Tuple[str, str, str, tuple]] = []
         self.fragment_exec_frames = False  # test RFC6455 reassembly
         # ValidatingWebhookConfiguration analog: registered webhooks are
@@ -84,7 +108,7 @@ class StubApiServer:
             def do_DELETE(self):  # noqa: N802
                 outer._dispatch(self, "DELETE")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd = _QuietThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle -------------------------------------------------------
@@ -253,6 +277,8 @@ class StubApiServer:
             return
         kind, namespace, name, subresource = route
         try:
+            if self.fault_hook is not None:
+                self.fault_hook(method, kind, subresource)
             if (method == "GET" and kind == "Pod" and subresource == "exec"
                     and "websocket" in req.headers.get("Upgrade", "").lower()):
                 raw_query = urllib.parse.parse_qsl(parsed.query)

@@ -59,6 +59,16 @@ class GoneError(ApiError):
     code = 410
 
 
+class ServerError(ApiError):
+    """Apiserver-side 5xx (overload, etcd timeout, admission plugin crash).
+    Always transient from the client's point of view: the only correct
+    response is retry-with-backoff, which is exactly what the chaos
+    harness injects it to prove."""
+
+    reason = "InternalError"
+    code = 500
+
+
 def ignore_not_found(exc: Exception) -> None:
     """Re-raise unless the error is NotFound (client.IgnoreNotFound analog)."""
     if isinstance(exc, NotFoundError):

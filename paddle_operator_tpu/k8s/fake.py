@@ -34,6 +34,11 @@ class FakeKubeClient(KubeClient):
         # kind-agnostic event tap: fn(etype, obj) — used by the envtest
         # stub apiserver to build its watch event history
         self.event_sink: Optional[Callable] = None
+        # kinds whose push-watch delivery is suspended ("*" = every kind):
+        # models a dropped watch connection — writes land in the store (and
+        # the event_sink history, which a real resuming watch would replay)
+        # but subscribers see nothing until resume + re-list
+        self._watch_suspended: set = set()
 
     # -- registration ------------------------------------------------------
 
@@ -53,6 +58,8 @@ class FakeKubeClient(KubeClient):
     def _notify(self, etype: str, obj: dict) -> None:
         if self.event_sink is not None:
             self.event_sink(etype, deep_copy(obj))
+        if self._watch_suspended & {"*", obj.get("kind", "")}:
+            return
         for kind, ns, cb in list(self._watchers):
             if kind != obj.get("kind"):
                 continue
@@ -66,6 +73,26 @@ class FakeKubeClient(KubeClient):
         """Push-style watch used by the informer layer."""
         with self._lock:
             self._watchers.append((kind, namespace, callback))
+
+    # -- watch fault injection (chaos harness) -----------------------------
+
+    def suspend_watch(self, kind: Optional[str] = None) -> None:
+        """Stop delivering watch events for ``kind`` (None = all kinds).
+        Writes still mutate the store; subscribers go stale — the fake-client
+        analog of a dropped watch connection."""
+        with self._lock:
+            self._watch_suspended.add(kind or "*")
+
+    def resume_watch(self, kind: Optional[str] = None) -> None:
+        """Reconnect a suspended watch. Events that fired during the
+        suspension are gone (like a real disconnect); the subscriber must
+        re-list to heal — chaos.api_faults resyncs the informer cache."""
+        with self._lock:
+            self._watch_suspended.discard(kind or "*")
+
+    def watch_suspended(self, kind: str) -> bool:
+        with self._lock:
+            return bool(self._watch_suspended & {"*", kind})
 
     # -- CRUD --------------------------------------------------------------
 
@@ -88,6 +115,19 @@ class FakeKubeClient(KubeClient):
                     continue
                 out.append(deep_copy(o))
             return out
+
+    def list_raw(self, kind, namespace=None):
+        """List + the snapshot resourceVersion, like a real LIST response
+        (rv taken under the same lock, so a resync from it is race-free)."""
+        with self._lock:
+            return {"metadata": {"resourceVersion": str(self._rv)},
+                    "items": self.list(kind, namespace)}
+
+    @property
+    def resource_version(self) -> str:
+        """Current global resourceVersion (the write counter)."""
+        with self._lock:
+            return str(self._rv)
 
     def create(self, obj: dict) -> dict:
         with self._lock:

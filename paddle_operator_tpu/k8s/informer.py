@@ -220,6 +220,23 @@ class InformerCache:
     def has(self, kind: str) -> bool:
         return kind in self._informers
 
+    def kinds(self) -> List[str]:
+        return list(self._informers)
+
+    def resync(self, kind: str) -> None:
+        """Force one re-list for ``kind`` — what a real informer does after
+        a dropped watch reconnects (the chaos harness's watch-restore heal
+        uses this; the periodic resync in _run_watch is the same motion)."""
+        if kind not in self._informers:
+            return
+        if hasattr(self.client, "list_raw"):
+            raw = self.client.list_raw(kind, self.namespace)
+        else:
+            raw = {"items": self.client.list(kind, self.namespace)}
+        self._informers[kind].replace_all(
+            raw.get("items", []),
+            list_rv=raw.get("metadata", {}).get("resourceVersion"))
+
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "InformerCache":

@@ -43,6 +43,7 @@ class PodSimulator:
         self._released: Dict[str, bool] = {}  # pod name -> coord released
         self._desired: Dict[str, str] = {}    # pod name -> Succeeded/Failed
         self._fail_reasons: Dict[str, str] = {}  # pod name -> status.reason
+        self._oom: set = set()  # pods whose container dies OOMKilled
         self._ip_seq = 0
         if isinstance(client, FakeKubeClient):
             client.exec_handler = self._handle_exec
@@ -69,12 +70,28 @@ class PodSimulator:
         if reason:
             self._fail_reasons[pod_name] = reason
 
+    def preempt(self, pod_name: str, reason: str = "Terminated") -> None:
+        """TPU maintenance-event / spot-preemption kill: the node manager
+        SIGKILLs the pod and the kubelet records an eviction-family
+        status.reason — classify_pod_failure must answer "preemption",
+        never "app", so the incident spends the (large) preemption budget."""
+        self.finish(pod_name, succeeded=False, reason=reason)
+
+    def oom_kill(self, pod_name: str) -> None:
+        """Container killed by the kernel OOM killer: exit 137 like an
+        eviction, but the kubelet marks the container state OOMKilled and
+        sets NO eviction reason on the pod — the one 137 that
+        classify_pod_failure must charge to the APP budget."""
+        self._desired[pod_name] = "Failed"
+        self._oom.add(pod_name)
+
     def clear(self, pod_name: str) -> None:
         """Forget a `finish` request: a RECREATED pod with the same name is
         driven back up instead of being re-killed — one `finish` + `clear`
         models a single preemption event against a healthy replacement."""
         self._desired.pop(pod_name, None)
         self._fail_reasons.pop(pod_name, None)
+        self._oom.discard(pod_name)
 
     def finish_all(self, succeeded: bool = True) -> None:
         for pod in self._all("Pod"):
@@ -204,14 +221,19 @@ class PodSimulator:
         if phase == "Running" and desired:
             new_status["phase"] = desired
             reason = self._fail_reasons.get(name)
+            term = {}
             if desired == "Failed" and reason:
                 new_status["reason"] = reason
-                exit_code = 137  # system SIGKILL, the eviction signature
+                term = {"exitCode": 137}  # SIGKILL, the eviction signature
+            elif desired == "Failed" and name in self._oom:
+                # OOMKilled: 137 like an eviction, but container-level
+                # reason and NO pod status.reason — an app failure
+                term = {"exitCode": 137, "reason": "OOMKilled"}
             else:
-                exit_code = 0 if desired == "Succeeded" else 1
+                term = {"exitCode": 0 if desired == "Succeeded" else 1}
             new_status["containerStatuses"] = [
                 {"name": c.get("name", "main"), "ready": False,
-                 "state": {"terminated": {"exitCode": exit_code}}}
+                 "state": {"terminated": dict(term)}}
                 for c in pod["spec"].get("containers", [])
             ]
             self._write(ns, name, new_status)

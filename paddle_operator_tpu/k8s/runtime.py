@@ -122,6 +122,9 @@ class Controller:
         # (controller-runtime exposes the same as a histogram)
         self.duration_sum = 0.0
         self.duration_count = 0
+        # optional gauge: current max error-requeue backoff armed by the
+        # reconciler (seconds); wired by whoever owns the reconciler
+        self.backoff_provider: Optional[Callable[[], float]] = None
 
     def watch(self, client, kind: str, mapper: Callable, namespace=None,
               cache=None) -> None:
@@ -167,8 +170,15 @@ class Controller:
             self.metrics["reconcile_errors_total"] += 1
             n = self._failures.get(key, 0) + 1
             self._failures[key] = n
-            if n <= self.max_retries:
-                self.queue.add_after(key, min(0.1 * (2 ** n), 30.0))
+            # NEVER drop a failing key: this controller is level-triggered,
+            # so if the world stays quiet no watch event will ever
+            # re-enqueue it and the object wedges forever (the chaos
+            # harness caught exactly that under an 8+ burst of injected
+            # 5xxs). controller-runtime's rate limiter has the same
+            # retry-forever semantics; max_retries only caps the backoff
+            # exponent, not the attempt count.
+            self.queue.add_after(
+                key, min(0.1 * (2 ** min(n, self.max_retries)), 30.0))
             return True
         finally:
             self.duration_sum += time.monotonic() - t0
@@ -231,6 +241,9 @@ class Manager:
         self.on_lost_lease = on_lost_lease
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # extra exposition blocks (chaos fault counters, subsystem gauges):
+        # each provider returns fully formatted text-exposition lines
+        self._metric_providers: List[Callable[[], str]] = []
 
     def add_controller(
         self,
@@ -364,25 +377,76 @@ class Manager:
 
     # -- metrics -------------------------------------------------------
 
+    def add_metrics_provider(self, provider: Callable[[], str]) -> None:
+        """Register an extra exposition block (e.g. chaos fault counters).
+        The provider returns complete text-exposition lines, HELP/TYPE
+        headers included, with no trailing newline."""
+        self._metric_providers.append(provider)
+
+    # metric family -> (help, type). Families are emitted header-first with
+    # every controller's sample under ONE header, as real Prometheus
+    # scrapers require (a repeated header is a parse error).
+    _FAMILIES = [
+        ("tpujob_reconcile_total",
+         "Reconcile invocations.", "counter"),
+        ("tpujob_reconcile_errors_total",
+         "Reconciles that raised (retried with backoff).", "counter"),
+        ("tpujob_requeue_total",
+         "Reconcile results that requested a requeue.", "counter"),
+        ("tpujob_reconcile_duration_seconds",
+         "Reconcile latency (all outcomes).", "summary"),
+        ("tpujob_workqueue_depth",
+         "Keys ready to be processed.", "gauge"),
+        ("tpujob_workqueue_deferred",
+         "Keys parked behind a requeue-after delay.", "gauge"),
+        ("tpujob_workqueue_backoff_seconds",
+         "Max error-requeue backoff currently armed by the reconciler.",
+         "gauge"),
+    ]
+
     def metrics_text(self) -> str:
         """Prometheus text exposition of controller metrics
         (reference: controller-runtime /metrics on :8080)."""
-        lines = []
+        samples: Dict[str, List[str]] = {name: [] for name, _, _ in
+                                         self._FAMILIES}
+        extra_families: List[str] = []
         for ctrl in self.controllers:
+            label = 'controller="%s"' % ctrl.name
             for metric, value in sorted(ctrl.metrics.items()):
-                lines.append(
-                    'tpujob_%s{controller="%s"} %d' % (metric, ctrl.name, value)
-                )
-            lines.append(
-                'tpujob_reconcile_duration_seconds_sum{controller="%s"} %.6f'
-                % (ctrl.name, ctrl.duration_sum))
-            lines.append(
-                'tpujob_reconcile_duration_seconds_count{controller="%s"} %d'
-                % (ctrl.name, ctrl.duration_count))
-            lines.append(
-                'tpujob_workqueue_depth{controller="%s"} %d'
-                % (ctrl.name, len(ctrl.queue)))
-            lines.append(
-                'tpujob_workqueue_deferred{controller="%s"} %d'
-                % (ctrl.name, ctrl.queue.pending_deferred))
+                fam = "tpujob_%s" % metric
+                if fam not in samples:
+                    # controllers may grow ad-hoc counters; emit them
+                    # untyped rather than crashing the /metrics endpoint
+                    extra_families.append(fam)
+                samples.setdefault(fam, []).append(
+                    'tpujob_%s{%s} %d' % (metric, label, value))
+            samples["tpujob_reconcile_duration_seconds"].append(
+                'tpujob_reconcile_duration_seconds_sum{%s} %.6f'
+                % (label, ctrl.duration_sum))
+            samples["tpujob_reconcile_duration_seconds"].append(
+                'tpujob_reconcile_duration_seconds_count{%s} %d'
+                % (label, ctrl.duration_count))
+            samples["tpujob_workqueue_depth"].append(
+                'tpujob_workqueue_depth{%s} %d' % (label, len(ctrl.queue)))
+            samples["tpujob_workqueue_deferred"].append(
+                'tpujob_workqueue_deferred{%s} %d'
+                % (label, ctrl.queue.pending_deferred))
+            if ctrl.backoff_provider is not None:
+                samples["tpujob_workqueue_backoff_seconds"].append(
+                    'tpujob_workqueue_backoff_seconds{%s} %.3f'
+                    % (label, ctrl.backoff_provider()))
+        lines = []
+        for name, help_text, mtype in self._FAMILIES:
+            if not samples[name]:
+                continue
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, mtype))
+            lines.extend(samples[name])
+        for name in sorted(set(extra_families)):
+            lines.append("# TYPE %s untyped" % name)
+            lines.extend(samples[name])
+        for provider in self._metric_providers:
+            block = provider()
+            if block:
+                lines.append(block)
         return "\n".join(lines) + "\n"

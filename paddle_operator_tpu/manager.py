@@ -213,12 +213,13 @@ def main(argv=None):
         on_lost_lease=lost_lease,
         cache=cache,
     )
-    mgr.add_controller(
+    ctrl = mgr.add_controller(
         "tpujob", reconciler.reconcile,
         for_kind=api.KIND,
         owns=[k for k in kinds if k != api.KIND],
         owner_api_version=api.API_VERSION, owner_kind=api.KIND,
     )
+    ctrl.backoff_provider = reconciler.current_backoff
 
     class Probes(BaseHTTPRequestHandler):
         def do_GET(self):

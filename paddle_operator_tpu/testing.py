@@ -31,6 +31,7 @@ class OperatorHarness:
         auto_admit_podgroups: bool = True,
         namespace: Optional[str] = None,
         http_coordination: bool = False,
+        client_middleware=None,
     ):
         self.client = FakeKubeClient()
         self.client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
@@ -49,6 +50,11 @@ class OperatorHarness:
             self.cache.informer(kind)
         self.cached_client = CachedKubeClient(self.client, self.cache)
         self.cache.start()
+        # middleware wraps the client the CONTROL PLANE sees (reconciler,
+        # coordination, manager) — the chaos harness interposes fault
+        # injection here; test introspection (self.client) stays unwrapped
+        if client_middleware is not None:
+            self.cached_client = client_middleware(self.cached_client)
         # Production release channel: a real CoordinationServer on localhost;
         # the pod simulator polls it over real HTTP like the init container.
         self.coord_server = None
@@ -77,6 +83,7 @@ class OperatorHarness:
             owner_api_version=api.API_VERSION,
             owner_kind=api.KIND,
         )
+        self.controller.backoff_provider = self.reconciler.current_backoff
 
     def close(self) -> None:
         if self.coord_server is not None:

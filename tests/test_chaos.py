@@ -1,0 +1,270 @@
+"""Chaos subsystem: deterministic fault injection + convergence invariants.
+
+Tier-1 keeps one fast deterministic run per plane (control plane, data
+plane) plus determinism and backoff/metrics checks; the multi-seed sweep —
+the regression harness every scaling PR runs against — is slow-marked.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.chaos import (
+    CONTROL_SCENARIOS, SCENARIOS, ChaosSourceError, FaultInjector,
+    FaultySource, build_plan, run_scenario,
+)
+from paddle_operator_tpu.data import ShardedLoader
+from paddle_operator_tpu.testing import OperatorHarness
+
+
+def role_spec(replicas):
+    return {"replicas": replicas, "template": {"spec": {"containers": [
+        {"name": "main", "image": "img"}]}}}
+
+
+def elastic_tpu_job(name, workers=4, topology="4x8"):
+    return api.new_tpujob(name, spec={
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": topology},
+        "worker": role_spec(workers), "elastic": 1,
+    })
+
+
+# ---------------------------------------------------------------------------
+# fast single-seed runs (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_chaos_preemption_burst_single_seed():
+    report = run_scenario("preemption_burst", seed=7, quick=True)
+    assert report.converged, report.summary_line()
+    assert report.violations == [], report.summary_line()
+    assert report.faults.get("pod_preempt", 0) >= 2
+    # the job survived its preemptions and counted them
+    st = report.jobs["burst"]
+    assert st["phase"] in ("Running", "Failed")
+    assert st["preemptionRestarts"] + st["appFailureRestarts"] >= 1
+
+
+def test_chaos_apiserver_flake_single_seed():
+    report = run_scenario("apiserver_flake", seed=3, quick=True)
+    assert report.converged, report.summary_line()
+    assert report.violations == [], report.summary_line()
+    assert report.faults.get("watch_drop") == 1
+    assert report.jobs["flake"]["phase"] == "Running"
+
+
+def test_chaos_same_seed_replays_identically():
+    a = run_scenario("slice_drain_resize", seed=11, quick=True)
+    b = run_scenario("slice_drain_resize", seed=11, quick=True)
+    assert a.violations == [] and b.violations == []
+    assert a.fingerprint() == b.fingerprint()
+
+
+def test_chaos_plan_is_deterministic_and_seed_sensitive():
+    p1 = build_plan("preemption_burst", 5)
+    p2 = build_plan("preemption_burst", 5)
+    assert [(e.tick, e.kind, e.params) for e in p1.events] == \
+        [(e.tick, e.kind, e.params) for e in p2.events]
+    different = any(
+        [(e.tick, e.kind, e.params) for e in build_plan(s, 5).events]
+        != [(e.tick, e.kind, e.params) for e in build_plan(s, 6).events]
+        for s in SCENARIOS)
+    assert different
+
+
+# ---------------------------------------------------------------------------
+# data plane: loader fault injection
+# ---------------------------------------------------------------------------
+
+def test_loader_source_error_reraises_and_never_leaks_thread():
+    def gen():
+        for i in range(10):
+            yield {"x": np.full((2,), i, np.float32)}
+
+    src = FaultySource(gen(), error_at=(4,))
+    loader = ShardedLoader(src, prefetch=2, place=False)
+    seen = []
+    with pytest.raises(ChaosSourceError):
+        for b in loader:
+            seen.append(int(b["x"][0]))
+    assert seen == [0, 1, 2, 3]  # everything before the fault, in order
+    loader.close()
+    assert not loader.producer_alive()
+    assert not any(t.name == "sharded-loader" and t.is_alive()
+                   for t in threading.enumerate())
+    # the error was transient: a fresh loader resumes without data loss
+    with ShardedLoader(src, prefetch=2, place=False) as loader2:
+        seen += [int(b["x"][0]) for b in loader2]
+    assert seen == list(range(10))
+    assert not loader2.producer_alive()
+
+
+def test_loader_fault_hook_stall_and_error():
+    calls = []
+
+    def hook(stage):
+        calls.append(stage)
+        if len(calls) == 3:
+            raise ChaosSourceError("hook-injected")
+
+    def gen():
+        while True:
+            yield {"x": np.zeros((2,), np.float32)}
+
+    loader = ShardedLoader(gen(), prefetch=1, place=False, fault_hook=hook)
+    with pytest.raises(ChaosSourceError):
+        for _ in loader:
+            pass
+    loader.close()
+    assert not loader.producer_alive()
+    assert calls.count("batch_build") == 3
+
+
+def test_loader_scenario_end_to_end():
+    report = run_scenario("loader_faults", seed=2, quick=True)
+    assert report.violations == [], report.violations
+    assert report.faults["loader_error"] == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: podsim kill semantics, backoff, metrics exposition
+# ---------------------------------------------------------------------------
+
+def test_podsim_preempt_spends_preemption_budget_only():
+    from paddle_operator_tpu.chaos import PodChaos
+
+    h = OperatorHarness()
+    h.create_job(elastic_tpu_job("pre"))
+    h.converge()
+    # PodChaos turns the sticky sim kill into exactly ONE incident
+    chaos = PodChaos(h.sim, h.client, FaultInjector())
+    chaos.preempt(h.client.get("Pod", "default", "pre-worker-1"))
+    for _ in range(30):
+        h.manager.drain()
+        h.sim.step()
+        chaos.tick()
+    job = h.get_job("pre")
+    assert job.phase == api.Phase.RUNNING
+    assert int(job.status.get("preemptionRestarts")) == 1
+    assert not job.status.get("appFailureRestarts")
+
+
+def test_podsim_oom_kill_burns_app_budget_to_terminal_failed():
+    """OOMKilled exits 137 like an eviction but must charge the APP budget:
+    without clearing the kill the container 'crashes' deterministically on
+    every restart, so the job must fail terminally after exactly the
+    app-failure budget (3), never the 10 preemption restarts."""
+    h = OperatorHarness()
+    h.create_job(elastic_tpu_job("oomy"))
+    h.converge()
+    h.sim.oom_kill("oomy-worker-0")
+    h.converge(max_ticks=120)
+    job = h.get_job("oomy")
+    assert job.phase == api.Phase.FAILED
+    assert int(job.status.get("appFailureRestarts")) == 3
+    assert not job.status.get("preemptionRestarts")
+
+
+def test_error_requeue_backoff_escalates_and_resets():
+    from paddle_operator_tpu.controllers.reconciler import TpuJobReconciler
+    from paddle_operator_tpu.k8s.fake import FakeKubeClient
+
+    r = TpuJobReconciler(FakeKubeClient())
+    key = ("default", "j")
+    delays = [r._requeue_error(key).requeue_after for _ in range(8)]
+    # escalates from the jittered base toward the cap...
+    assert 0.5 <= delays[0] <= 1.0
+    assert delays[3] > delays[0]
+    assert all(d <= r.backoff_cap for d in delays)
+    assert delays[-1] > r.backoff_cap * 0.49  # capped region reached
+    assert r.current_backoff() == max(0.0, delays[-1])
+    # ...and a clean pass through reconcile() resets the streak
+    r.reconcile("default", "j")  # NotFound -> clean Result()
+    assert r._err_streak == {}
+    assert r.current_backoff() == 0.0
+
+
+def test_backoff_is_deterministic_across_instances():
+    from paddle_operator_tpu.controllers.reconciler import TpuJobReconciler
+    from paddle_operator_tpu.k8s.fake import FakeKubeClient
+
+    a = TpuJobReconciler(FakeKubeClient())
+    b = TpuJobReconciler(FakeKubeClient())
+    key = ("ns", "job")
+    assert [a._requeue_error(key).requeue_after for _ in range(5)] == \
+        [b._requeue_error(key).requeue_after for _ in range(5)]
+
+
+def test_metrics_exposition_has_headers_backoff_and_chaos_counters():
+    from paddle_operator_tpu.chaos.harness import ChaosHarness
+
+    h = ChaosHarness(build_plan("preemption_burst", seed=1, quick=True))
+    h.run()
+    text = h.h.manager.metrics_text()
+    # prometheus exposition contract: one HELP/TYPE header per family
+    assert "# HELP tpujob_reconcile_total" in text
+    assert "# TYPE tpujob_reconcile_total counter" in text
+    assert text.count("# TYPE tpujob_workqueue_depth gauge") == 1
+    assert 'tpujob_workqueue_backoff_seconds{controller="tpujob"}' in text
+    assert "# TYPE tpujob_chaos_faults_injected_total counter" in text
+    assert 'tpujob_chaos_faults_injected_total{kind="pod_preempt"}' in text
+
+
+def test_envtest_fault_hook_injects_over_real_http():
+    """The same fault taxonomy drives the envtest stub server-side: a hook
+    raising ApiError surfaces to HttpKubeClient as the mapped error."""
+    from paddle_operator_tpu.k8s.client import HttpKubeClient
+    from paddle_operator_tpu.k8s.envtest import StubApiServer
+    from paddle_operator_tpu.k8s.errors import ApiError, ServerError
+
+    srv = StubApiServer().start()
+    try:
+        client = HttpKubeClient(base_url=srv.url, token=None)
+        client.create({"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "p", "namespace": "default"},
+                       "spec": {"containers": [{"name": "m"}]}})
+        injector = FaultInjector()
+        injector.arm_error(500, count=1, verbs=("get",))
+
+        def hook(method, kind, subresource):
+            injector.before({"GET": "get"}.get(method, method.lower()), kind)
+        srv.fault_hook = hook
+        with pytest.raises(ApiError) as exc:
+            client.get("Pod", "default", "p")
+        assert exc.value.code == ServerError.code
+        assert injector.counts == {"api_error_500": 1}
+        # fault spent: the next read succeeds
+        assert client.get("Pod", "default", "p")["metadata"]["name"] == "p"
+    finally:
+        srv.stop()
+
+
+def test_fake_client_watch_drop_and_restore():
+    from paddle_operator_tpu.k8s.fake import FakeKubeClient
+
+    c = FakeKubeClient()
+    seen = []
+    c.add_watch_callback("Pod", None, lambda et, o: seen.append(et))
+    c.create({"kind": "Pod", "metadata": {"name": "a"}})
+    c.suspend_watch("Pod")
+    c.create({"kind": "Pod", "metadata": {"name": "b"}})
+    assert seen == ["ADDED"]  # b's event was dropped
+    assert c.watch_suspended("Pod")
+    c.resume_watch("Pod")
+    c.create({"kind": "Pod", "metadata": {"name": "c"}})
+    assert seen == ["ADDED", "ADDED"]
+
+
+# ---------------------------------------------------------------------------
+# slow: the multi-seed sweep every scaling PR regression-tests against
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", SCENARIOS)
+def test_chaos_seed_sweep(scenario):
+    for seed in range(20):
+        report = run_scenario(scenario, seed, quick=True)
+        assert report.converged, report.summary_line()
+        assert report.violations == [], report.summary_line()
