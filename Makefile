@@ -4,7 +4,7 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all test test-fast chaos bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all test test-fast chaos obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
@@ -22,6 +22,18 @@ test-fast:
 # (see docs/design.md "Fault model & chaos harness")
 chaos:
 	$(PY) scripts/chaos_stress.py --seeds 20 --quick
+
+# observability lanes (see docs/observability.md):
+#   obs          — rebuild a failure timeline from a recorded chaos run
+#                  (trace + events alone), proving obs_report end-to-end
+#   metrics-lint — strict text-exposition validation of a live
+#                  Manager.metrics_text() with every provider registered,
+#                  so an undeclared/unescaped family can't ship
+obs:
+	$(PY) scripts/obs_report.py --chaos preemption_burst --seed 1
+
+metrics-lint:
+	$(PY) scripts/metrics_lint.py --selftest
 
 bench:
 	$(PY) bench.py

@@ -35,12 +35,14 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..api import types as api
 from ..k8s.client import KubeClient
 from ..k8s.errors import ApiError, NotFoundError
+from ..utils.trace import tracer
 from . import helper
 
 log = logging.getLogger("tpujob.coordination")
@@ -143,8 +145,30 @@ class CoordinationServer:
     """Serves release decisions over HTTP from a KubeClient's view of the
     world. One instance per manager; share-nothing per request."""
 
-    def __init__(self, client: KubeClient, bind: str = ":8082"):
+    def __init__(self, client: KubeClient, bind: str = ":8082",
+                 job_metrics=None):
         self.client = client
+        # barrier-wait bookkeeping: first denied poll per pod starts the
+        # clock; the first grant stops it and feeds JobMetrics (when
+        # wired) + the trace. Keys are (ns, job, pod).
+        self.obs = job_metrics
+        # handler threads are concurrent (ThreadingHTTPServer): this
+        # bookkeeping is the one piece of shared mutable state, so all
+        # access goes through _barrier_lock. Both maps carry a monotonic
+        # timestamp and are TTL-pruned (released pods never poll again,
+        # so without expiry every (ns, job, pod) ever released would leak
+        # forever across job churn); a barrier wait outliving the TTL is
+        # pathological and merely restarts its clock.
+        self._barrier_lock = threading.Lock()
+        self._barrier_ttl = 3600.0
+        # a grant for a key released more than this long ago is a NEW pod
+        # incarnation polling for the first time (released init containers
+        # exit and stop polling; only a lost-response retry re-polls, and
+        # it does so within seconds) — count and trace it afresh
+        self._regrant_grace = 10.0
+        self._last_prune = 0.0
+        self._first_denied: Dict[Tuple[str, str, str], float] = {}
+        self._released_pods: Dict[Tuple[str, str, str], float] = {}
         host, _, port = bind.rpartition(":")
         outer = self
 
@@ -176,6 +200,17 @@ class CoordinationServer:
     def url(self) -> str:
         return "http://127.0.0.1:%d" % self.port
 
+    def _prune_locked(self, now: float) -> None:
+        """Drop barrier entries older than the TTL (call under
+        _barrier_lock; amortized — runs at most once a minute)."""
+        if now - self._last_prune < 60.0:
+            return
+        self._last_prune = now
+        cutoff = now - self._barrier_ttl
+        for table in (self._released_pods, self._first_denied):
+            for k in [k for k, t in table.items() if t < cutoff]:
+                table.pop(k, None)
+
     # -- request handling ----------------------------------------------
 
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
@@ -191,15 +226,58 @@ class CoordinationServer:
                 job = api.TpuJob(obj)
                 pods = self.client.list_owned("Pod", obj)
             except NotFoundError:
+                # job gone: drop its barrier bookkeeping (bounded memory
+                # across job churn)
+                with self._barrier_lock:
+                    for table in (self._first_denied, self._released_pods):
+                        for k in [k for k in table
+                                  if k[0] == ns and k[1] == job_name]:
+                            table.pop(k, None)
                 self._send(req, 404, "job not found\n")
                 return
             except ApiError as e:
                 self._send(req, 500, "apiserver error: %s\n" % e)
                 return
             ok, reason = compute_release(job, pods, pod_name)
+            key = (ns, job_name, pod_name)
             if ok:
+                now = time.monotonic()
+                with self._barrier_lock:
+                    self._prune_locked(now)
+                    prev_grant = self._released_pods.get(key)
+                    first_grant = (prev_grant is None
+                                   or now - prev_grant > self._regrant_grace)
+                    if first_grant:
+                        self._released_pods[key] = now
+                        waited = now - self._first_denied.pop(key, now)
+                if first_grant:
+                    if self.obs is not None:
+                        self.obs.observe_release(ns, job_name, pod_name,
+                                                 waited)
+                    else:
+                        tracer().event(
+                            "coordination_release", job="%s/%s"
+                            % (ns, job_name), pod=pod_name,
+                            waited_s=round(waited, 6))
                 self._send(req, 200, "go\n")
             else:
+                now = time.monotonic()
+                with self._barrier_lock:
+                    self._prune_locked(now)
+                    # a previously-released name denied again is a NEW pod
+                    # incarnation (whole-slice restart recreates same
+                    # names): track its barrier wait afresh
+                    self._released_pods.pop(key, None)
+                    first_deny = key not in self._first_denied
+                    if first_deny:
+                        # first denial starts the barrier-wait clock (and
+                        # is the one deny worth tracing; re-polls are
+                        # cadence)
+                        self._first_denied[key] = now
+                if first_deny:
+                    tracer().event("coordination_deny", job="%s/%s"
+                                   % (ns, job_name), pod=pod_name,
+                                   reason=reason)
                 # 503 + Retry-After: busybox wget exits nonzero, the init
                 # container loop sleeps and re-polls.
                 self._send(req, 503, reason + "\n", retry_after="1")

@@ -13,6 +13,7 @@ differences are called out inline.
 from __future__ import annotations
 
 import logging
+import threading
 import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -23,6 +24,8 @@ from ..elastic.sync import bump_epoch, sync_np
 from ..k8s import objects as k8s
 from ..k8s.client import EventRecorder, KubeClient
 from ..k8s.errors import ApiError, ConflictError, NotFoundError
+from ..obs import JobMetrics, ObservedEventRecorder, incident_cause
+from ..utils.trace import tracer
 from . import helper
 from .hostport import PortRangeAllocator
 
@@ -53,9 +56,16 @@ class TpuJobReconciler:
         coordination_url: str = "",
         backoff_base: float = 1.0,
         backoff_cap: float = 30.0,
+        job_metrics: Optional[JobMetrics] = None,
     ):
         self.client = client
-        self.recorder = recorder or EventRecorder(client, "tpujob-controller")
+        # Per-job observability collector: phase gauges/histograms,
+        # cause-split restart counters, flight recorder. Whoever owns the
+        # Manager registers ``self.obs.metrics_block`` as a provider.
+        self.obs = job_metrics if job_metrics is not None else JobMetrics()
+        # every Event also lands in the flight recorder + process trace
+        self.recorder = ObservedEventRecorder(
+            recorder or EventRecorder(client, "tpujob-controller"), self.obs)
         self.scheduling = scheduling
         self.init_image = init_image
         self.ports = port_allocator
@@ -75,6 +85,10 @@ class TpuJobReconciler:
         # a flaking apiserver a fixed cadence hammers it in lockstep.
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        # streak table is written by the worker thread and READ by the
+        # /metrics scrape thread (current_backoff as a workqueue gauge):
+        # iteration during concurrent insert raises, so all access locks
+        self._err_lock = threading.Lock()
         self._err_streak: Dict[Tuple[str, str], int] = {}
         self._err_hit: set = set()
 
@@ -97,15 +111,18 @@ class TpuJobReconciler:
         """An error-path requeue: escalate this key's streak and park it
         for the backed-off delay. The wrapper resets the streak on the
         first pass that completes without calling this."""
-        self._err_hit.add(key)
-        n = self._err_streak.get(key, 0) + 1
-        self._err_streak[key] = n
+        with self._err_lock:
+            self._err_hit.add(key)
+            n = self._err_streak.get(key, 0) + 1
+            self._err_streak[key] = n
         return Result(requeue_after=self._backoff_for(key, n))
 
     def current_backoff(self) -> float:
         """Max armed error-requeue backoff in seconds (workqueue gauge)."""
+        with self._err_lock:
+            streaks = list(self._err_streak.items())
         out = 0.0
-        for key, n in self._err_streak.items():
+        for key, n in streaks:
             out = max(out, self._backoff_for(key, n))
         return out
 
@@ -115,17 +132,20 @@ class TpuJobReconciler:
 
     def reconcile(self, namespace: str, name: str) -> Result:
         key = (namespace, name)
-        self._err_hit.discard(key)
+        with self._err_lock:
+            self._err_hit.discard(key)
         try:
             result = self._reconcile(namespace, name)
         except Exception:
             # a panicking pass keeps its streak: the Controller's own retry
             # backoff requeues it, and the NEXT error-path requeue must
             # start from the escalated delay, not from scratch
-            self._err_streak[key] = self._err_streak.get(key, 0) + 1
+            with self._err_lock:
+                self._err_streak[key] = self._err_streak.get(key, 0) + 1
             raise
-        if key not in self._err_hit:
-            self._err_streak.pop(key, None)
+        with self._err_lock:
+            if key not in self._err_hit:
+                self._err_streak.pop(key, None)
         return result
 
     def _reconcile(self, namespace: str, name: str) -> Result:
@@ -135,6 +155,7 @@ class TpuJobReconciler:
             # Job is gone: drop its warn-once marker so memory stays bounded
             # across job churn and a recreated same-name job warns afresh.
             self._exec_release_warned.discard((namespace, name))
+            self.obs.forget_job(namespace, name)
             return Result()
         job = api.TpuJob(obj)
 
@@ -161,6 +182,11 @@ class TpuJobReconciler:
         # -- status derivation (reference :122-131) ---------------------
         old_status = k8s.deep_copy(job.status)
         self._sync_current_status(job, child_pods)
+        # observe the freshly derived phase (no-op when unchanged): this
+        # is the one site every phase transition flows through, so the
+        # phase gauge / time-in-phase histogram / flight recorder see the
+        # same machine the status subresource does
+        self.obs.observe_phase(namespace, name, job.phase)
         if job.status != old_status:
             try:
                 self.client.update_status(job.obj)
@@ -222,6 +248,7 @@ class TpuJobReconciler:
                 log.error("elastic sync failed: %s", e)
                 return self._requeue_error((namespace, name))
             if np is not None:
+                self.obs.observe_resize(namespace, name, np=np)
                 self.recorder.event(
                     job.obj, "Normal", "Scaled", "scaled replicas to %s" % np
                 )
@@ -376,6 +403,10 @@ class TpuJobReconciler:
             # pass re-reads the persisted value and the epoch-bump dedup
             # (pods already deleting) prevents a double restart
             job.status[field] = int(job.status.get(field) or 0) + 1
+        # cause-split restart counter: preemption vs app-OOM vs app-error
+        # (the same evidence the budget split keys on, one level finer)
+        self.obs.observe_restart(job.namespace, job.name,
+                                 incident_cause(fresh))
         self.recorder.event(
             job.obj, "Warning", "PreemptionRestart",
             "%d pod(s) failed (%s, %s); deleted for recreate%s (%s %d/%d)"
@@ -556,10 +587,16 @@ class TpuJobReconciler:
                         continue
                     if helper.is_coord_container_running(pod):
                         try:
-                            self.client.exec_in_pod(
-                                job.namespace, pod["metadata"]["name"],
-                                helper.COORD_CONTAINER_NAME, ["touch", "goon"],
-                            )
+                            with tracer().span(
+                                    "coordination_release", job=job.name,
+                                    namespace=job.namespace,
+                                    pod=pod["metadata"]["name"],
+                                    channel="exec"):
+                                self.client.exec_in_pod(
+                                    job.namespace, pod["metadata"]["name"],
+                                    helper.COORD_CONTAINER_NAME,
+                                    ["touch", "goon"],
+                                )
                         except Exception as e:
                             # A silent warning here strands the whole gang in
                             # init containers (the shipped ClusterRole grants
@@ -649,7 +686,9 @@ class TpuJobReconciler:
     def _create_resource(self, job: api.TpuJob, obj: dict) -> None:
         kind, name = obj.get("kind", ""), obj["metadata"]["name"]
         try:
-            self.client.create(obj)
+            with tracer().span("create", kind=kind, obj=name,
+                               job=job.name, namespace=job.namespace):
+                self.client.create(obj)
         except ApiError as e:
             self.recorder.event(
                 job.obj, "Warning", "Create", "create failed %s %s" % (kind, name)
@@ -663,7 +702,9 @@ class TpuJobReconciler:
         kind, name = obj.get("kind", ""), obj["metadata"]["name"]
         ns = obj["metadata"].get("namespace", "default")
         try:
-            self.client.delete(kind, ns, name)
+            with tracer().span("delete", kind=kind, obj=name,
+                               job=job.name, namespace=job.namespace):
+                self.client.delete(kind, ns, name)
         except NotFoundError:
             return
         except ApiError:

@@ -305,6 +305,12 @@ class ShardedLoader:
 
     # ---- lifecycle ---------------------------------------------------------
 
+    def queue_depth(self) -> int:
+        """Batches/windows currently prestaged ahead of the consumer
+        (0 for prefetch=0). Approximate by nature (the producer may be
+        mid-put) — an observability gauge, not a synchronization API."""
+        return self._queue.qsize() if self._prefetch else 0
+
     def producer_alive(self) -> bool:
         """True while the background producer thread exists and runs —
         False after close() (or for prefetch=0). The chaos harness's

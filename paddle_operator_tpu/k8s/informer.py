@@ -31,6 +31,7 @@ from .client import KubeClient
 from .errors import GoneError, NotFoundError
 from .fake import FakeKubeClient
 from .objects import deep_copy, get_controller_of, match_labels
+from ..utils.trace import tracer
 
 log = logging.getLogger("tpujob.informer")
 
@@ -229,6 +230,7 @@ class InformerCache:
         uses this; the periodic resync in _run_watch is the same motion)."""
         if kind not in self._informers:
             return
+        tracer().event("informer_resync", kind=kind)
         if hasattr(self.client, "list_raw"):
             raw = self.client.list_raw(kind, self.namespace)
         else:
@@ -265,6 +267,11 @@ class InformerCache:
 
     def stop(self) -> None:
         self._stop.set()
+
+    def is_synced(self) -> bool:
+        """Non-blocking: every registered informer has completed its
+        initial list (the /readyz gate — a probe must never block)."""
+        return all(inf.synced.is_set() for inf in self._informers.values())
 
     def wait_for_sync(self, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
@@ -306,12 +313,16 @@ class InformerCache:
                 # clean server timeout / resync break: loop re-checks
             except GoneError:
                 log.info("informer %s: rv %s compacted; re-listing", kind, rv)
+                tracer().event("watch_restart", kind=kind, reason="gone",
+                               rv=rv)
                 rv = None
             except Exception as e:
                 if self._stop.is_set():
                     return
                 log.warning("informer %s watch dropped (%s); resuming rv=%s",
                             kind, e, rv)
+                tracer().event("watch_restart", kind=kind,
+                               reason=str(e), rv=rv)
                 self._stop.wait(2)
 
 

@@ -30,6 +30,33 @@ from ..utils.trace import tracer
 log = logging.getLogger("tpujob.runtime")
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label escaping. Object names normally
+    can't carry ``"``/``\\``, but webhook-bypassed writes can — an
+    unescaped value would corrupt the whole scrape."""
+    return (str(value).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def fold_suffix(metric: str, get_type: Callable[[str], Optional[str]]):
+    """Resolve a sample's metric name to its family: the name itself if
+    ``get_type`` knows it, else a ``_bucket``/``_sum``/``_count`` fold
+    onto a histogram/summary base. The ONE implementation of the suffix
+    rules — shared by the provider-block merger below and the strict
+    parser in :mod:`..obs`, so they can never drift. Returns None when
+    no declared family matches."""
+    if get_type(metric) is not None:
+        return metric
+    for suffix, kinds in (("_bucket", ("histogram",)),
+                          ("_sum", ("histogram", "summary")),
+                          ("_count", ("histogram", "summary"))):
+        if metric.endswith(suffix):
+            base = metric[: -len(suffix)]
+            if get_type(base) in kinds:
+                return base
+    return None
+
+
 class WorkQueue:
     """Deduplicating FIFO of (namespace, name) keys with deferred entries."""
 
@@ -163,13 +190,27 @@ class Controller:
             # metric exactly when it matters (controller-runtime's histogram
             # likewise observes every outcome)
             with tracer().span("reconcile", controller=self.name,
-                               namespace=key[0], obj=key[1]):
-                result = self.reconcile(*key)
+                               namespace=key[0], obj=key[1]) as sp:
+                try:
+                    result = self.reconcile(*key)
+                except Exception:
+                    sp.set(outcome="error")
+                    raise
+                if result is not None and getattr(result, "requeue", False):
+                    sp.set(outcome="requeue")
+                elif result is not None and getattr(result, "requeue_after",
+                                                    None):
+                    sp.set(outcome="requeue_after",
+                           delay_s=result.requeue_after)
+                else:
+                    sp.set(outcome="done")
         except Exception:
             log.exception("reconcile %s/%s panicked", *key)
             self.metrics["reconcile_errors_total"] += 1
             n = self._failures.get(key, 0) + 1
             self._failures[key] = n
+            tracer().event("reconcile_backoff", controller=self.name,
+                           namespace=key[0], obj=key[1], failures=n)
             # NEVER drop a failing key: this controller is level-triggered,
             # so if the world stays quiet no watch event will ever
             # re-enqueue it and the object wedges forever (the chaos
@@ -406,47 +447,98 @@ class Manager:
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of controller metrics
-        (reference: controller-runtime /metrics on :8080)."""
-        samples: Dict[str, List[str]] = {name: [] for name, _, _ in
-                                         self._FAMILIES}
-        extra_families: List[str] = []
+        (reference: controller-runtime /metrics on :8080).
+
+        Hardened: label values are escaped, and provider blocks are MERGED
+        family-wise — when two providers emit the same family, the samples
+        are grouped under one ``# HELP``/``# TYPE`` pair (a repeated
+        header, or a family's samples split across the scrape, is a parse
+        error to real Prometheus scrapers)."""
+        # family -> {"help": str|None, "type": str|None, "samples": [...]}
+        blocks: "OrderedDict[str, Dict[str, object]]" = OrderedDict()
+
+        def block(fam: str) -> Dict[str, object]:
+            b = blocks.get(fam)
+            if b is None:
+                b = blocks[fam] = {"help": None, "type": None, "samples": []}
+            return b
+
+        for name, help_text, mtype in self._FAMILIES:
+            b = block(name)
+            b["help"], b["type"] = help_text, mtype
         for ctrl in self.controllers:
-            label = 'controller="%s"' % ctrl.name
+            label = 'controller="%s"' % escape_label_value(ctrl.name)
             for metric, value in sorted(ctrl.metrics.items()):
                 fam = "tpujob_%s" % metric
-                if fam not in samples:
-                    # controllers may grow ad-hoc counters; emit them
-                    # untyped rather than crashing the /metrics endpoint
-                    extra_families.append(fam)
-                samples.setdefault(fam, []).append(
+                # controllers may grow ad-hoc counters; emit them untyped
+                # rather than crashing the /metrics endpoint
+                if blocks.get(fam) is None:
+                    block(fam)["type"] = "untyped"
+                blocks[fam]["samples"].append(
                     'tpujob_%s{%s} %d' % (metric, label, value))
-            samples["tpujob_reconcile_duration_seconds"].append(
+            b = block("tpujob_reconcile_duration_seconds")
+            b["samples"].append(
                 'tpujob_reconcile_duration_seconds_sum{%s} %.6f'
                 % (label, ctrl.duration_sum))
-            samples["tpujob_reconcile_duration_seconds"].append(
+            b["samples"].append(
                 'tpujob_reconcile_duration_seconds_count{%s} %d'
                 % (label, ctrl.duration_count))
-            samples["tpujob_workqueue_depth"].append(
+            block("tpujob_workqueue_depth")["samples"].append(
                 'tpujob_workqueue_depth{%s} %d' % (label, len(ctrl.queue)))
-            samples["tpujob_workqueue_deferred"].append(
+            block("tpujob_workqueue_deferred")["samples"].append(
                 'tpujob_workqueue_deferred{%s} %d'
                 % (label, ctrl.queue.pending_deferred))
             if ctrl.backoff_provider is not None:
-                samples["tpujob_workqueue_backoff_seconds"].append(
+                block("tpujob_workqueue_backoff_seconds")["samples"].append(
                     'tpujob_workqueue_backoff_seconds{%s} %.3f'
                     % (label, ctrl.backoff_provider()))
-        lines = []
-        for name, help_text, mtype in self._FAMILIES:
-            if not samples[name]:
-                continue
-            lines.append("# HELP %s %s" % (name, help_text))
-            lines.append("# TYPE %s %s" % (name, mtype))
-            lines.extend(samples[name])
-        for name in sorted(set(extra_families)):
-            lines.append("# TYPE %s untyped" % name)
-            lines.extend(samples[name])
         for provider in self._metric_providers:
-            block = provider()
-            if block:
-                lines.append(block)
+            self._merge_provider_block(blocks, block, provider() or "")
+        lines: List[str] = []
+        for fam, b in blocks.items():
+            if not b["samples"]:
+                continue
+            if b["help"]:
+                lines.append("# HELP %s %s" % (fam, b["help"]))
+            lines.append("# TYPE %s %s" % (fam, b["type"] or "untyped"))
+            lines.extend(b["samples"])
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _merge_provider_block(blocks, block, text: str) -> None:
+        """Fold one provider's preformatted exposition lines into the
+        family map: first HELP/TYPE wins (duplicates dropped), samples
+        append to their family so grouping survives multiple providers
+        emitting the same family."""
+        current = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP "):
+                parts = line.split(" ", 3)
+                fam = parts[2] if len(parts) > 2 else ""
+                if fam:
+                    b = block(fam)
+                    if b["help"] is None:
+                        b["help"] = parts[3] if len(parts) > 3 else ""
+                    current = fam
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split(" ")
+                fam = parts[2] if len(parts) > 2 else ""
+                if fam:
+                    b = block(fam)
+                    if b["type"] is None and len(parts) > 3:
+                        b["type"] = parts[3]
+                    current = fam
+                continue
+            if line.startswith("#"):
+                continue
+            metric = line.split("{", 1)[0].split(" ", 1)[0]
+            fam = fold_suffix(
+                metric,
+                lambda n: ((blocks[n]["type"] or "untyped")
+                           if n in blocks else None))
+            if fam is None:
+                fam = current if current is not None else metric
+            block(fam)["samples"].append(line)

@@ -26,6 +26,7 @@ from .elastic.store import connect as kv_connect
 from .k8s.client import HttpKubeClient
 from .k8s.informer import CachedKubeClient, InformerCache, cached_kinds
 from .k8s.runtime import Manager
+from .obs import JobMetrics, http_respond
 
 
 def _serve(bind: str, handler_cls) -> ThreadingHTTPServer:
@@ -33,6 +34,83 @@ def _serve(bind: str, handler_cls) -> ThreadingHTTPServer:
     srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler_cls)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv
+
+
+def probes_handler(cache, mgr, leader_elect: bool = False,
+                   standby_ready: bool = False):
+    """Build the health-probe handler class.
+
+    ``/healthz`` is liveness-only: the process is up and serving — always
+    200 (a standby that reported itself dead would be restart-looped by
+    the kubelet).
+
+    ``/readyz`` reports REAL readiness: the informer cache has completed
+    its initial sync (a reconciler on an unsynced cache would recreate
+    every child it cannot see), and — under ``--leader-elect`` — this
+    replica holds the lease, unless ``--standby-ready`` marks hot
+    standbys routable (they serve read-only endpoints while waiting).
+    """
+
+    class Probes(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path == "/healthz":
+                code, body = 200, b"ok"
+            elif self.path == "/readyz":
+                if cache is not None and not cache.is_synced():
+                    code, body = 503, b"informer cache not synced\n"
+                elif (leader_elect and not standby_ready
+                      and not (mgr is not None and mgr.elector is not None
+                               and mgr.elector.is_leader)):
+                    code, body = 503, b"standby: leader lease not held\n"
+                else:
+                    code, body = 200, b"ok"
+            else:
+                code, body = 404, b"not found\n"
+            http_respond(self, code, body)
+
+        def log_message(self, *a):
+            pass
+
+    return Probes
+
+
+def metrics_handler(mgr, job_metrics):
+    """Build the metrics-port handler: Prometheus exposition at
+    ``/metrics``, and the flight recorder's production read path at
+    ``/debug/flightrecorder[/{namespace}/{name}]`` — the last N
+    transitions/events per job as JSON, available even when tracing was
+    off."""
+    import json
+
+    class Metrics(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path == "/metrics":
+                http_respond(self, 200, mgr.metrics_text().encode(),
+                             ctype="text/plain; version=0.0.4")
+                return
+            if self.path.startswith("/debug/flightrecorder"):
+                parts = [p for p in
+                         self.path[len("/debug/flightrecorder"):].split("/")
+                         if p]
+                if len(parts) == 2:
+                    entries = job_metrics.flight.dump(parts[0], parts[1])
+                elif not parts:
+                    entries = job_metrics.flight.dump()
+                else:
+                    # anything else 404s — a malformed filter must not
+                    # silently answer with the full cross-job dump
+                    http_respond(self, 404, b"not found\n")
+                    return
+                http_respond(self, 200,
+                             (json.dumps(entries, indent=1) + "\n").encode(),
+                             ctype="application/json")
+                return
+            http_respond(self, 404, b"not found\n")
+
+        def log_message(self, *a):
+            pass
+
+    return Metrics
 
 
 def main(argv=None):
@@ -45,6 +123,11 @@ def main(argv=None):
                     default="", help="elastic membership endpoint(s)")
     ap.add_argument("--port-range", default="35000,65000")
     ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--standby-ready", action="store_true",
+                    help="with --leader-elect: report /readyz 200 while "
+                         "standing by WITHOUT the lease (marks hot "
+                         "standbys routable; default: standbys are "
+                         "not-ready until they win the lease)")
     ap.add_argument("--metrics-bind-address", default=":8080")
     ap.add_argument("--health-probe-bind-address", default=":8081")
     ap.add_argument("--coordination-bind-address", default=":8082",
@@ -94,6 +177,11 @@ def main(argv=None):
     start, end = (int(p) for p in args.port_range.split(","))
     kv = kv_connect(args.membership) if args.membership else None
 
+    # One per-job observability collector shared by the reconciler (phase
+    # transitions, restarts, resizes) and the coordination server (barrier
+    # waits); exposed through the Manager's /metrics below.
+    job_metrics = JobMetrics()
+
     coord_srv = None
     coord_url = args.coordination_url
     if (not args.coordination_bind_address and args.init_image
@@ -105,7 +193,8 @@ def main(argv=None):
             "(ExecReleaseFailed events will say the same per job)")
     if args.coordination_bind_address:
         coord_srv = CoordinationServer(
-            cached_client, args.coordination_bind_address)
+            cached_client, args.coordination_bind_address,
+            job_metrics=job_metrics)
         coord_srv.start()
         if not coord_url:
             # In-cluster default: the operator's coordination Service FQDN
@@ -193,6 +282,7 @@ def main(argv=None):
         port_allocator=PortRangeAllocator(start, end),
         kv_store=kv,
         coordination_url=coord_url,
+        job_metrics=job_metrics,
     )
     stop = threading.Event()
     exit_code = [0]
@@ -220,36 +310,12 @@ def main(argv=None):
         owner_api_version=api.API_VERSION, owner_kind=api.KIND,
     )
     ctrl.backoff_provider = reconciler.current_backoff
+    mgr.add_metrics_provider(job_metrics.metrics_block)
 
-    class Probes(BaseHTTPRequestHandler):
-        def do_GET(self):
-            body = b"ok"
-            if self.path not in ("/healthz", "/readyz"):
-                self.send_response(404)
-            else:
-                self.send_response(200)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+    Probes = probes_handler(cache, mgr, leader_elect=args.leader_elect,
+                            standby_ready=args.standby_ready)
 
-        def log_message(self, *a):
-            pass
-
-    class Metrics(BaseHTTPRequestHandler):
-        def do_GET(self):
-            if self.path != "/metrics":
-                self.send_response(404)
-                self.end_headers()
-                return
-            body = mgr.metrics_text().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *a):
-            pass
+    Metrics = metrics_handler(mgr, job_metrics)
 
     _serve(args.health_probe_bind_address, Probes)
     _serve(args.metrics_bind_address, Metrics)

@@ -1,0 +1,626 @@
+"""Unified observability layer: per-job metrics, flight recorder, worker
+exposition, and the text-format tooling shared by both planes.
+
+The reference operator's only observability surface is zap logs and k8s
+Events (SURVEY.md §5.1); our runtime previously exposed workqueue-level
+counters only. This module owns everything above that:
+
+* :class:`JobMetrics` — the per-job collector the reconciler feeds at its
+  phase-transition / restart / resize sites. Registered on the Manager via
+  ``add_metrics_provider(job_metrics.metrics_block)``; exports phase state
+  gauges, time-in-phase histograms, cause-split restart counters
+  (preemption vs app-OOM vs app-error — the pod-sim distinction), elastic
+  resize counters, and coordination barrier wait time.
+* :class:`FlightRecorder` — a bounded ring of the last N phase transitions
+  and events per job, the in-memory half of what ``scripts/obs_report.py``
+  reconstructs from trace + events after the fact.
+* :class:`ObservedEventRecorder` — wraps a
+  :class:`~.k8s.client.EventRecorder` so every k8s Event the reconciler
+  emits also lands in the flight recorder and the process trace.
+* :func:`parse_exposition` — a strict Prometheus text-format parser; the
+  exposition-validity tests and ``scripts/metrics_lint.py`` run it against
+  ``Manager.metrics_text()`` so an undeclared or unescaped family can't
+  ship.
+* :class:`WorkerMetricsServer` — the training runner's zero-dependency
+  ``/metrics`` endpoint (steps/s, examples/s, loss, loader queue depth,
+  per-stage host timings, goodput).
+
+Everything here is stdlib-only and cheap when idle; nothing imports jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from .api.types import Phase
+from .k8s.runtime import escape_label_value, fold_suffix
+from .utils.trace import tracer
+
+log = logging.getLogger("tpujob.obs")
+
+RESTART_CAUSES = ("preemption", "oom", "error")
+
+# Time-in-phase buckets: harness transitions land in the sub-second
+# buckets, real clusters in the seconds-to-minutes ones.
+PHASE_BUCKETS = (0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0)
+
+
+def job_key(namespace: str, name: str) -> str:
+    return "%s/%s" % (namespace, name)
+
+
+def incident_cause(pods: List[dict]) -> str:
+    """Classify a whole-slice restart incident for the cause-split restart
+    counter. Mirrors the reconciler's budget logic (any eviction evidence
+    in the batch marks the incident a preemption), then splits the
+    all-app-crash case by the OOMKilled container reason the pod sim (and
+    the kubelet) records: ``"preemption"`` | ``"oom"`` | ``"error"``."""
+    from .controllers import helper
+
+    if any(helper.classify_pod_failure(p) != "app" for p in pods):
+        return "preemption"
+    for pod in pods:
+        for cs in (pod.get("status") or {}).get("containerStatuses") or []:
+            for state_key in ("state", "lastState"):
+                term = (cs.get(state_key) or {}).get("terminated")
+                if term and term.get("reason") == "OOMKilled":
+                    return "oom"
+    return "error"
+
+
+class FlightRecorder:
+    """Bounded per-job ring of the last N transitions/events.
+
+    Each entry: ``{"seq", "t" (wall clock), "kind", ...detail}`` — ``seq``
+    is a global monotonic counter so a merged dump across jobs preserves
+    order even when wall-clock resolution collapses ticks together.
+    """
+
+    def __init__(self, depth: int = 64, wall: Callable[[], float] = time.time):
+        self.depth = depth
+        self._wall = wall
+        self._lock = threading.Lock()
+        self._rings: Dict[str, Deque[dict]] = {}
+        self._seq = 0
+
+    def record(self, namespace: str, name: str, kind: str,
+               **detail: Any) -> None:
+        key = job_key(namespace, name)
+        with self._lock:
+            self._seq += 1
+            ring = self._rings.get(key)
+            if ring is None:
+                ring = self._rings[key] = deque(maxlen=self.depth)
+            entry = {"seq": self._seq, "t": round(self._wall(), 6),
+                     "kind": kind}
+            entry.update(detail)
+            ring.append(entry)
+
+    def dump(self, namespace: Optional[str] = None,
+             name: Optional[str] = None) -> List[dict]:
+        """Entries (dict copies) in global order; optionally one job's."""
+        with self._lock:
+            if namespace is not None and name is not None:
+                rings = [self._rings.get(job_key(namespace, name), ())]
+            else:
+                rings = list(self._rings.values())
+            out = [dict(e) for ring in rings for e in ring]
+        out.sort(key=lambda e: e["seq"])
+        return out
+
+    def forget(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._rings.pop(job_key(namespace, name), None)
+
+
+class JobMetrics:
+    """Per-job metrics collector + flight recorder, fed by the reconciler.
+
+    Thread-safe; clocks are injectable so tests drive deterministic
+    durations. ``metrics_block()`` returns complete text-exposition lines
+    (HELP/TYPE included) for ``Manager.add_metrics_provider``.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time,
+                 recorder_depth: int = 64):
+        self._clock = clock
+        self._lock = threading.Lock()
+        # job key -> (phase, entered-at monotonic)
+        self._phase: Dict[str, Tuple[str, float]] = {}
+        # phase -> [per-bucket counts..., +Inf count]; plus sum/count
+        self._hist: Dict[str, List[int]] = {}
+        self._hist_sum: Dict[str, float] = {}
+        self._hist_count: Dict[str, int] = {}
+        self._restarts: Dict[Tuple[str, str], int] = {}  # (job, cause)
+        self._resizes: Dict[str, int] = {}
+        self._barrier_wait: Dict[str, float] = {}
+        self._releases: Dict[str, int] = {}
+        self.flight = FlightRecorder(depth=recorder_depth, wall=wall)
+
+    # -- feeding hooks (reconciler / coordination server) ----------------
+
+    def observe_phase(self, namespace: str, name: str, phase: str) -> None:
+        """Track the job's current phase; on a transition, close the old
+        phase's duration into the time-in-phase histogram and record the
+        transition in the flight recorder + trace."""
+        if not phase:
+            return
+        key = job_key(namespace, name)
+        now = self._clock()
+        with self._lock:
+            prev = self._phase.get(key)
+            if prev is not None and prev[0] == phase:
+                return
+            self._phase[key] = (phase, now)
+            if prev is not None:
+                self._observe_hist(prev[0], now - prev[1])
+        old = prev[0] if prev else ""
+        self.flight.record(namespace, name, "phase",
+                           **{"from": old, "to": phase})
+        tracer().event("phase_transition", job=key,
+                       **{"from": old, "to": phase})
+
+    def observe_restart(self, namespace: str, name: str, cause: str) -> None:
+        if cause not in RESTART_CAUSES:
+            cause = "error"
+        key = job_key(namespace, name)
+        with self._lock:
+            self._restarts[(key, cause)] = \
+                self._restarts.get((key, cause), 0) + 1
+        self.flight.record(namespace, name, "restart", cause=cause)
+        tracer().event("restart", job=key, cause=cause)
+
+    def observe_resize(self, namespace: str, name: str,
+                       np: Optional[int] = None) -> None:
+        key = job_key(namespace, name)
+        with self._lock:
+            self._resizes[key] = self._resizes.get(key, 0) + 1
+        self.flight.record(namespace, name, "resize", np=np)
+        tracer().event("elastic_resize", job=key, np=np)
+
+    def observe_release(self, namespace: str, name: str, pod: str,
+                        waited_s: float) -> None:
+        """A pod's startup-coordination barrier released after waiting
+        ``waited_s`` seconds (0.0 = released on its first poll)."""
+        key = job_key(namespace, name)
+        with self._lock:
+            self._barrier_wait[key] = \
+                self._barrier_wait.get(key, 0.0) + max(0.0, waited_s)
+            self._releases[key] = self._releases.get(key, 0) + 1
+        tracer().event("coordination_release", job=key, pod=pod,
+                       waited_s=round(waited_s, 6))
+
+    def record_event(self, namespace: str, name: str, etype: str,
+                     reason: str, message: str) -> None:
+        key = job_key(namespace, name)
+        self.flight.record(namespace, name, "event", type=etype,
+                           reason=reason, message=message)
+        tracer().event("k8s_event", job=key, type=etype, reason=reason,
+                       message=message)
+
+    def forget_job(self, namespace: str, name: str) -> None:
+        """Drop a deleted job's series so cardinality stays bounded across
+        job churn (phase histograms are per-phase, not per-job: kept)."""
+        key = job_key(namespace, name)
+        with self._lock:
+            self._phase.pop(key, None)
+            self._resizes.pop(key, None)
+            self._barrier_wait.pop(key, None)
+            self._releases.pop(key, None)
+            for k in [k for k in self._restarts if k[0] == key]:
+                del self._restarts[k]
+        self.flight.forget(namespace, name)
+
+    def _observe_hist(self, phase: str, seconds: float) -> None:
+        counts = self._hist.get(phase)
+        if counts is None:
+            counts = self._hist[phase] = [0] * (len(PHASE_BUCKETS) + 1)
+        for i, le in enumerate(PHASE_BUCKETS):
+            if seconds <= le:
+                counts[i] += 1
+        counts[-1] += 1  # +Inf
+        self._hist_sum[phase] = self._hist_sum.get(phase, 0.0) + seconds
+        self._hist_count[phase] = self._hist_count.get(phase, 0) + 1
+
+    # -- exposition ------------------------------------------------------
+
+    def metrics_block(self) -> str:
+        """Complete text-exposition lines (no trailing newline) for
+        ``Manager.add_metrics_provider``."""
+        esc = escape_label_value
+        with self._lock:
+            phases = dict(self._phase)
+            hist = {p: list(c) for p, c in self._hist.items()}
+            hist_sum = dict(self._hist_sum)
+            hist_count = dict(self._hist_count)
+            restarts = dict(self._restarts)
+            resizes = dict(self._resizes)
+            barrier = dict(self._barrier_wait)
+            releases = dict(self._releases)
+        lines: List[str] = []
+        if phases:
+            lines.append("# HELP tpujob_job_phase Job phase state set "
+                         "(1 = the job is currently in this phase).")
+            lines.append("# TYPE tpujob_job_phase gauge")
+            for key in sorted(phases):
+                cur = phases[key][0]
+                for phase in Phase.ALL:
+                    lines.append(
+                        'tpujob_job_phase{job="%s",phase="%s"} %d'
+                        % (esc(key), phase, 1 if phase == cur else 0))
+        if hist:
+            lines.append("# HELP tpujob_phase_seconds Time jobs spent in "
+                         "a phase before leaving it.")
+            lines.append("# TYPE tpujob_phase_seconds histogram")
+            for phase in sorted(hist):
+                counts = hist[phase]
+                for i, le in enumerate(PHASE_BUCKETS):
+                    lines.append(
+                        'tpujob_phase_seconds_bucket{phase="%s",le="%s"} %d'
+                        % (phase, format_float(le), counts[i]))
+                lines.append(
+                    'tpujob_phase_seconds_bucket{phase="%s",le="+Inf"} %d'
+                    % (phase, counts[-1]))
+                lines.append('tpujob_phase_seconds_sum{phase="%s"} %.6f'
+                             % (phase, hist_sum[phase]))
+                lines.append('tpujob_phase_seconds_count{phase="%s"} %d'
+                             % (phase, hist_count[phase]))
+        if restarts:
+            lines.append("# HELP tpujob_job_restarts_total Whole-slice "
+                         "restarts, split by incident cause "
+                         "(preemption | oom | error).")
+            lines.append("# TYPE tpujob_job_restarts_total counter")
+            for (key, cause) in sorted(restarts):
+                lines.append(
+                    'tpujob_job_restarts_total{job="%s",cause="%s"} %d'
+                    % (esc(key), cause, restarts[(key, cause)]))
+        if resizes:
+            lines.append("# HELP tpujob_elastic_resizes_total Elastic "
+                         "world-size (np) changes applied.")
+            lines.append("# TYPE tpujob_elastic_resizes_total counter")
+            for key in sorted(resizes):
+                lines.append('tpujob_elastic_resizes_total{job="%s"} %d'
+                             % (esc(key), resizes[key]))
+        if releases:
+            lines.append("# HELP tpujob_coordination_releases_total Pods "
+                         "released through the startup barrier.")
+            lines.append("# TYPE tpujob_coordination_releases_total counter")
+            for key in sorted(releases):
+                lines.append(
+                    'tpujob_coordination_releases_total{job="%s"} %d'
+                    % (esc(key), releases[key]))
+            lines.append("# HELP tpujob_coordination_barrier_wait_seconds_"
+                         "total Seconds pods waited at the startup "
+                         "coordination barrier before release.")
+            lines.append("# TYPE tpujob_coordination_barrier_wait_seconds_"
+                         "total counter")
+            for key in sorted(releases):
+                lines.append(
+                    'tpujob_coordination_barrier_wait_seconds_total'
+                    '{job="%s"} %.6f' % (esc(key), barrier.get(key, 0.0)))
+        return "\n".join(lines)
+
+
+def format_float(v: float) -> str:
+    """Bucket bound formatting: integral bounds render bare (``1`` not
+    ``1.0``), matching common Prometheus client output."""
+    return str(int(v)) if float(v) == int(v) else repr(float(v))
+
+
+def format_value(v: float) -> str:
+    """Sample-value formatting, safe for the non-finite values a diverged
+    run produces (``int(nan)`` raises — a NaN loss must not take the
+    whole /metrics scrape down with it)."""
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return "%d" % v if v == int(v) else "%.6f" % v
+
+
+def http_respond(req, code: int, body: bytes,
+                 ctype: str = "text/plain") -> None:
+    """The one response-writer for this package's stdlib HTTP handlers
+    (probes, metrics, worker exposition): headers + body with the
+    client-went-away errors swallowed."""
+    req.send_response(code)
+    req.send_header("Content-Type", ctype)
+    req.send_header("Content-Length", str(len(body)))
+    req.end_headers()
+    try:
+        req.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+        pass
+
+
+class ObservedEventRecorder:
+    """EventRecorder wrapper: every event also feeds the flight recorder
+    and the process trace, so the k8s Event stream and the JSONL timeline
+    can never diverge."""
+
+    def __init__(self, inner, job_metrics: "JobMetrics"):
+        self._inner = inner
+        self._obs = job_metrics
+
+    def event(self, obj: dict, etype: str, reason: str, message: str) -> None:
+        meta = obj.get("metadata", {})
+        self._obs.record_event(meta.get("namespace", "default"),
+                               meta.get("name", ""), etype, reason, message)
+        self._inner.event(obj, etype, reason, message)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format validation (tests + scripts/metrics_lint.py)
+# ---------------------------------------------------------------------------
+
+def _valid_name(name: str) -> bool:
+    if not name:
+        return False
+    ok_first = name[0].isalpha() or name[0] in "_:"
+    return ok_first and all(c.isalnum() or c in "_:" for c in name)
+
+
+def _parse_labels(raw: str) -> Tuple[Optional[Dict[str, str]], Optional[str]]:
+    """Parse the inside of ``{...}``. Returns (labels, error)."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(raw)
+    while i < n:
+        j = i
+        while j < n and (raw[j].isalnum() or raw[j] == "_"):
+            j += 1
+        name = raw[i:j]
+        if not name or not (name[0].isalpha() or name[0] == "_"):
+            return None, "bad label name at %r" % raw[i:i + 12]
+        if j >= n or raw[j] != "=":
+            return None, "expected '=' after label %r" % name
+        j += 1
+        if j >= n or raw[j] != '"':
+            return None, "label %r value not quoted" % name
+        j += 1
+        value = []
+        while j < n:
+            c = raw[j]
+            if c == "\\":
+                if j + 1 >= n or raw[j + 1] not in ('\\', '"', 'n'):
+                    return None, "bad escape in label %r" % name
+                value.append({"\\": "\\", '"': '"', "n": "\n"}[raw[j + 1]])
+                j += 2
+                continue
+            if c == '"':
+                break
+            if c == "\n":
+                return None, "raw newline in label %r" % name
+            value.append(c)
+            j += 1
+        else:
+            return None, "unterminated value for label %r" % name
+        labels[name] = "".join(value)
+        j += 1  # closing quote
+        if j < n and raw[j] == ",":
+            j += 1
+        elif j < n:
+            return None, "expected ',' between labels at %r" % raw[j:j + 12]
+        i = j
+    return labels, None
+
+
+def parse_exposition(text: str) -> List[str]:
+    """Strictly validate Prometheus text exposition; returns a list of
+    error strings (empty = valid). Checks:
+
+    * every sample belongs to a declared (``# TYPE``-ed) family —
+      ``_bucket``/``_sum``/``_count`` suffixes allowed for histogram and
+      summary families;
+    * each family is declared exactly once, HELP/TYPE before its samples,
+      and a family's samples are contiguous (no interleaving);
+    * label blocks parse strictly (escaped ``\\``/``"``/newlines only);
+    * sample values parse as floats.
+    """
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helped: set = set()
+    closed: set = set()   # families whose sample run has ended
+    current: Optional[str] = None
+
+    def family_of(metric: str) -> Optional[str]:
+        # the suffix rules live in ONE place (k8s.runtime.fold_suffix),
+        # shared with the Manager's provider-block merger
+        return fold_suffix(metric, types.get)
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3:
+                errors.append("line %d: malformed HELP" % lineno)
+                continue
+            fam = parts[2]
+            if fam in helped:
+                errors.append("line %d: duplicate HELP for %s" % (lineno, fam))
+            helped.add(fam)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4:
+                errors.append("line %d: malformed TYPE" % lineno)
+                continue
+            fam, mtype = parts[2], parts[3]
+            if fam in types:
+                errors.append("line %d: duplicate TYPE for %s" % (lineno, fam))
+                continue
+            if mtype not in ("counter", "gauge", "histogram", "summary",
+                             "untyped"):
+                errors.append("line %d: unknown type %r" % (lineno, mtype))
+            if not _valid_name(fam):
+                errors.append("line %d: bad family name %r" % (lineno, fam))
+            types[fam] = mtype
+            if current is not None and current != fam:
+                closed.add(current)
+            current = fam
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        # sample line: name[{labels}] value [timestamp]
+        brace = line.find("{")
+        if brace >= 0:
+            metric = line[:brace]
+            close = line.rfind("}")
+            if close < brace:
+                errors.append("line %d: unbalanced label braces" % lineno)
+                continue
+            labels_raw = line[brace + 1:close]
+            rest = line[close + 1:].strip()
+            _labels, err = _parse_labels(labels_raw)
+            if err:
+                errors.append("line %d: %s" % (lineno, err))
+        else:
+            metric, _, rest = line.partition(" ")
+            rest = rest.strip()
+        if not _valid_name(metric):
+            errors.append("line %d: bad metric name %r" % (lineno, metric))
+            continue
+        fam = family_of(metric)
+        if fam is None:
+            errors.append("line %d: sample %r has no declared family"
+                          % (lineno, metric))
+            continue
+        if fam != current:
+            if fam in closed:
+                errors.append(
+                    "line %d: samples for %s are not contiguous"
+                    % (lineno, fam))
+            if current is not None:
+                closed.add(current)
+            current = fam
+        try:
+            float(rest.split(" ")[0])
+        except (ValueError, IndexError):
+            errors.append("line %d: unparseable value %r" % (lineno, rest))
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# worker-side exposition (the training runner's /metrics)
+# ---------------------------------------------------------------------------
+
+_WORKER_GAUGES = [
+    ("tpujob_worker_steps_total",
+     "Optimizer steps completed this run.", "counter"),
+    ("tpujob_worker_steps_per_second",
+     "Training throughput at the last log boundary.", "gauge"),
+    ("tpujob_worker_examples_per_second",
+     "Example throughput at the last log boundary.", "gauge"),
+    ("tpujob_worker_loss",
+     "Loss at the last resolved log boundary.", "gauge"),
+    ("tpujob_worker_loader_queue_depth",
+     "Prestaged batches/windows waiting in the input pipeline.", "gauge"),
+    ("tpujob_worker_goodput_ratio",
+     "Productive step-dispatch time over wall time.", "gauge"),
+]
+
+
+class WorkerMetricsServer:
+    """Zero-dependency ``/metrics`` endpoint for the training runner.
+
+    The runner pushes values with :meth:`update` /
+    :meth:`set_stage_summary`; scrapes render them in the same text
+    exposition format the operator serves. ``bind=":0"`` picks a free
+    port (tests); production sets ``TPUJOB_WORKER_METRICS_PORT``.
+    """
+
+    def __init__(self, bind: str = ":0"):
+        host, _, port = bind.rpartition(":")
+        outer = self
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {}
+        self._stages: Dict[str, Dict[str, float]] = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path != "/metrics":
+                    http_respond(self, 404, b"")
+                    return
+                http_respond(self, 200, outer.metrics_text().encode(),
+                             ctype="text/plain; version=0.0.4")
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "WorkerMetricsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="worker-metrics")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return "http://127.0.0.1:%d" % self.port
+
+    # -- updates (runner) ------------------------------------------------
+
+    def update(self, **values: float) -> None:
+        """Merge gauge/counter values by short name (``steps_total``,
+        ``steps_per_second``, ``examples_per_second``, ``loss``,
+        ``loader_queue_depth``, ``goodput_ratio``)."""
+        with self._lock:
+            for k, v in values.items():
+                if v is not None:
+                    self._values[k] = float(v)
+
+    def set_stage_summary(self, summary: Dict[str, Dict[str, float]]) -> None:
+        """Publish a :meth:`~.utils.trace.StageTimes.summary` breakdown."""
+        with self._lock:
+            self._stages = {k: dict(v) for k, v in summary.items()}
+
+    # -- exposition ------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        with self._lock:
+            values = dict(self._values)
+            stages = {k: dict(v) for k, v in self._stages.items()}
+        lines: List[str] = []
+        for name, help_text, mtype in _WORKER_GAUGES:
+            short = name[len("tpujob_worker_"):]
+            if short not in values:
+                continue
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, mtype))
+            lines.append("%s %s" % (name, format_value(values[short])))
+        if stages:
+            lines.append("# HELP tpujob_worker_stage_seconds_total Host "
+                         "wall-clock accumulated per pipeline stage.")
+            lines.append("# TYPE tpujob_worker_stage_seconds_total counter")
+            for stage in sorted(stages):
+                lines.append(
+                    'tpujob_worker_stage_seconds_total{stage="%s"} %.6f'
+                    % (escape_label_value(stage),
+                       stages[stage].get("ms", 0.0) / 1e3))
+            lines.append("# HELP tpujob_worker_stage_calls_total Times "
+                         "each pipeline stage was entered.")
+            lines.append("# TYPE tpujob_worker_stage_calls_total counter")
+            for stage in sorted(stages):
+                lines.append(
+                    'tpujob_worker_stage_calls_total{stage="%s"} %d'
+                    % (escape_label_value(stage),
+                       int(stages[stage].get("count", 0))))
+        return "\n".join(lines) + "\n"

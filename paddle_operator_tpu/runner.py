@@ -12,6 +12,7 @@ import functools
 import inspect
 import logging
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -100,6 +101,10 @@ class TrainJob:
     # batch build + H2D overlap compute. 0 = inline, no producer thread.
     # make_batch runs on the producer thread (sequentially, one caller).
     prefetch: int = 2
+    # worker-side /metrics endpoint (obs.WorkerMetricsServer): None =
+    # disabled unless TPUJOB_WORKER_METRICS_PORT is set; 0 = any free
+    # port (the bound URL lands in result["worker_metrics_url"])
+    metrics_port: Optional[int] = None
     seed: int = 0
 
 
@@ -116,6 +121,38 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
 
     result: Dict[str, Any] = {"cycles": 0}
     ckpt_writer = AsyncCheckpointer() if job.async_checkpoint else None
+
+    # -- worker-side observability --------------------------------------
+    metrics_srv = None
+    metrics_port = job.metrics_port
+    if metrics_port is None:
+        env_port = os.environ.get("TPUJOB_WORKER_METRICS_PORT", "")
+        if env_port:
+            try:
+                metrics_port = int(env_port)
+            except ValueError:
+                log.warning("ignoring unparseable "
+                            "TPUJOB_WORKER_METRICS_PORT=%r", env_port)
+    if metrics_port is not None:
+        from .obs import WorkerMetricsServer
+
+        try:
+            metrics_srv = WorkerMetricsServer(":%d" % metrics_port).start()
+        except (OSError, OverflowError) as e:
+            # OverflowError: CPython raises it (not OSError) for a port
+            # outside 0-65535
+            # the observability add-on must never kill the training run —
+            # a taken port (hostNetwork neighbor, TIME_WAIT from the
+            # previous incarnation) degrades to metrics-less training
+            log.warning("worker metrics endpoint disabled: bind :%d "
+                        "failed (%s)", metrics_port, e)
+        else:
+            result["worker_metrics_url"] = metrics_srv.url
+            log.info("worker metrics at %s/metrics", metrics_srv.url)
+    # goodput accumulator across cycles: productive (step-dispatch) host
+    # time over cycle wall time — the headline "is this job actually
+    # training" number (EasyScale-style regression triage needs it)
+    goodput_acc = {"wall": 0.0, "step": 0.0}
 
     def save(step: int, state, epoch: int) -> None:
         """Multi-host: every process writes its own shards (a full gather of
@@ -165,6 +202,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         return agreed
 
     def train_cycle(world: int, epoch: int, should_stop: Callable[[], bool]) -> bool:
+        cycle_t0 = time.perf_counter()
         should_stop = agreed_stop(should_stop)
         axes = job.mesh_axes(world) if callable(job.mesh_axes) else job.mesh_axes
         mesh = _cycle_mesh(axes, elastic=callable(job.mesh_axes)) if (
@@ -184,6 +222,13 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             pass
         K = max(1, job.steps_per_call)
         sample = job.make_batch(rng, 0)
+        # examples/step for the worker throughput gauge: leading batch dim
+        # (x accum microbatches when the batch is [accum, mb, ...])
+        leaf0 = jax.tree_util.tree_leaves(sample)[0]
+        shape = getattr(leaf0, "shape", ())
+        examples_per_step = int(shape[0]) if len(shape) else 0
+        if job.accum_steps > 1 and len(shape) > 1:
+            examples_per_step = int(shape[0]) * int(shape[1])
         # one builder for the fused fn and the tail fallback, so the two can
         # never train with different semantics
         build = functools.partial(
@@ -244,6 +289,14 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             rate = (pstep - start_step) / max(t_submit - t0, 1e-9)
             log.info("step %d loss=%.4f steps/s=%.2f",
                      pstep, float(host["loss"]), rate)
+            if metrics_srv is not None:
+                metrics_srv.update(
+                    steps_total=pstep,
+                    steps_per_second=rate,
+                    examples_per_second=rate * examples_per_step,
+                    loss=float(host["loss"]),
+                    loader_queue_depth=loader.queue_depth(),
+                )
 
         # Input pipeline: batches/windows are built by a background
         # producer (and, single-process, prestaged on device with the
@@ -282,7 +335,8 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             nonlocal t_dispatched
             if t_dispatched is not None:
                 times.add("dispatch_gap", time.perf_counter() - t_dispatched)
-            out = fn(state, batch)
+            with times.timed("step_dispatch"):
+                out = fn(state, batch)
             t_dispatched = time.perf_counter()
             return out
 
@@ -343,6 +397,17 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             prof.close()
             loader.close()
             result["host_stages"] = times.summary()
+            # goodput accounting: productive step-dispatch time over this
+            # cycle's wall (compile, restore, data waits and logging are
+            # the non-productive remainder)
+            goodput_acc["wall"] += time.perf_counter() - cycle_t0
+            goodput_acc["step"] += result["host_stages"].get(
+                "step_dispatch", {}).get("ms", 0.0) / 1e3
+            if metrics_srv is not None:
+                metrics_srv.set_stage_summary(result["host_stages"])
+                if goodput_acc["wall"] > 0:
+                    metrics_srv.update(goodput_ratio=min(
+                        1.0, goodput_acc["step"] / goodput_acc["wall"]))
         log_resolved(deferred.resolve())  # flush the last pending boundary
         if metrics:
             result["loss"] = float(metrics["loss"])
@@ -365,4 +430,9 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             drain_saves()
         except BaseException:
             log.exception("async checkpoint write failed during teardown")
+        if metrics_srv is not None:
+            metrics_srv.stop()
+    if goodput_acc["wall"] > 0:
+        result["goodput"] = round(
+            min(1.0, goodput_acc["step"] / goodput_acc["wall"]), 4)
     return result

@@ -18,6 +18,7 @@ from .k8s.fake import FakeKubeClient
 from .k8s.informer import CachedKubeClient, InformerCache, cached_kinds
 from .k8s.podsim import PodSimulator
 from .k8s.runtime import Manager
+from .obs import JobMetrics
 from .controllers import helper
 
 
@@ -55,6 +56,10 @@ class OperatorHarness:
         # injection here; test introspection (self.client) stays unwrapped
         if client_middleware is not None:
             self.cached_client = client_middleware(self.cached_client)
+        # per-job observability: shared by the reconciler and (when HTTP
+        # coordination is on) the barrier-wait tracking, exposed through
+        # Manager.metrics_text like production manager.py wires it
+        self.job_metrics = JobMetrics()
         # Production release channel: a real CoordinationServer on localhost;
         # the pod simulator polls it over real HTTP like the init container.
         self.coord_server = None
@@ -63,7 +68,8 @@ class OperatorHarness:
             from .controllers.coordination import CoordinationServer
 
             self.coord_server = CoordinationServer(
-                self.cached_client, ":0").start()
+                self.cached_client, ":0",
+                job_metrics=self.job_metrics).start()
             coord_url = self.coord_server.url
         self.reconciler = TpuJobReconciler(
             self.cached_client,
@@ -72,9 +78,11 @@ class OperatorHarness:
             port_allocator=PortRangeAllocator(*port_range),
             kv_store=self.kv,
             coordination_url=coord_url,
+            job_metrics=self.job_metrics,
         )
         self.manager = Manager(self.cached_client, namespace=namespace,
                                cache=self.cache)
+        self.manager.add_metrics_provider(self.job_metrics.metrics_block)
         self.controller = self.manager.add_controller(
             "tpujob",
             self.reconciler.reconcile,

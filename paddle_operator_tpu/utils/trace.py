@@ -30,6 +30,32 @@ from typing import Any, Dict, Optional
 _local = threading.local()
 
 
+class _Span:
+    """Mutable attribute bag yielded by :meth:`Tracer.span` so callers can
+    attach outcome attributes discovered mid-span (reconcile result,
+    requeue reason) before the span record is emitted."""
+
+    __slots__ = ("attrs",)
+
+    def __init__(self, attrs: Dict[str, Any]):
+        self.attrs = attrs
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+
+class _NullSpan:
+    """No-op span for the disabled fast path — ``set`` costs nothing."""
+
+    __slots__ = ()
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
 class Tracer:
     """Structured span recorder, JSONL sink, thread-safe, cheap when off."""
 
@@ -43,14 +69,15 @@ class Tracer:
     @contextmanager
     def span(self, name: str, **attrs: Any):
         if not self.enabled:
-            yield self
+            yield _NULL_SPAN
             return
         depth = getattr(_local, "depth", 0)
         _local.depth = depth + 1
+        sp = _Span(dict(attrs))
         t0 = time.time()
         p0 = time.perf_counter()
         try:
-            yield self
+            yield sp
         finally:
             _local.depth = depth
             self._emit({
@@ -58,7 +85,7 @@ class Tracer:
                 "t0": round(t0, 6),
                 "dur_ms": round((time.perf_counter() - p0) * 1e3, 3),
                 "depth": depth,
-                "attrs": attrs,
+                "attrs": sp.attrs,
             })
 
     def event(self, name: str, **attrs: Any) -> None:

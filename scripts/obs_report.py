@@ -1,0 +1,332 @@
+"""obs_report — merge the operator trace, job events, and metrics into one
+human-readable failure timeline.
+
+The flight-recorder's offline counterpart: given the JSONL trace
+(``TPUJOB_TRACE_FILE``) and a dump of the job's corev1 Events, reconstruct
+what happened to a job — every phase transition, restart (with cause),
+resize, coordination release, watch restart — in one ordered timeline, so
+"why did job X wedge/restart at 03:12" is one command, not four terminals.
+
+    # offline: trace file + events dump (JSON list of corev1 Events)
+    python scripts/obs_report.py --trace trace.jsonl --events events.json \
+        [--metrics metrics.txt] [--job ns/name] [-v]
+
+    # against a chaos-harness run: execute the scenario with tracing on,
+    # then report from its trace + events (the `make obs` lane)
+    python scripts/obs_report.py --chaos preemption_burst --seed 1
+
+``--job`` filters to one job (``namespace/name``). ``-v`` includes every
+reconcile span (default: only state-changing entries). Exit code is 0 when
+a timeline was produced, 2 when the inputs contain nothing reportable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def load_trace(path: str) -> List[dict]:
+    """Read a Tracer JSONL file; unparseable lines are skipped (a crash
+    mid-write must not take the post-mortem tool down with it)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+def parse_iso(ts: str) -> Optional[float]:
+    """ISO-8601 → epoch seconds (k8s timestamps are ...Z)."""
+    if not ts:
+        return None
+    try:
+        return datetime.datetime.fromisoformat(
+            ts.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return None
+
+
+def _job_of_trace(rec: dict) -> Optional[str]:
+    attrs = rec.get("attrs") or {}
+    if attrs.get("job"):
+        job = str(attrs["job"])
+        # span attrs carry bare names (create/delete/coordination spans);
+        # events carry "ns/name" keys — normalize bare names with the
+        # namespace when present
+        if "/" not in job and attrs.get("namespace"):
+            return "%s/%s" % (attrs["namespace"], job)
+        return job
+    if attrs.get("obj") and rec.get("name") == "reconcile":
+        return "%s/%s" % (attrs.get("namespace", "default"), attrs["obj"])
+    return None
+
+
+def _matches(job_key: Optional[str], wanted: Optional[str]) -> bool:
+    if wanted is None:
+        return True
+    if job_key is None:
+        return False
+    if job_key == wanted:
+        return True
+    # bare-name trace attrs (no namespace available) match on name
+    return "/" not in job_key and wanted.split("/", 1)[-1] == job_key
+
+
+# ---------------------------------------------------------------------------
+# timeline assembly
+# ---------------------------------------------------------------------------
+
+def trace_entries(records: List[dict], job: Optional[str] = None,
+                  verbose: bool = False,
+                  include_k8s_events: bool = True) -> List[dict]:
+    out = []
+    # the exec-channel release is PUSHED on every reconcile pass while
+    # the gang is Starting (unlike the HTTP channel's once-per-grant
+    # event) — render only the first push per pod or a slow gang buries
+    # the timeline in repeats
+    exec_released = set()
+    for rec in records:
+        name = rec.get("name", "")
+        attrs = rec.get("attrs") or {}
+        jkey = _job_of_trace(rec)
+        if not _matches(jkey, job):
+            continue
+        text = None
+        if name == "phase_transition":
+            text = "phase: %s -> %s" % (attrs.get("from") or "(new)",
+                                        attrs.get("to"))
+        elif name == "restart":
+            text = "whole-slice restart (cause=%s)" % attrs.get("cause")
+        elif name == "elastic_resize":
+            text = "elastic resize (np=%s)" % attrs.get("np")
+        elif name == "coordination_release":
+            if attrs.get("channel") == "exec":
+                dedup = (jkey, attrs.get("pod"))
+                if dedup in exec_released:
+                    continue
+                exec_released.add(dedup)
+                text = ("released pod %s through startup barrier "
+                        "(exec push)" % attrs.get("pod"))
+            else:
+                waited = attrs.get("waited_s")
+                text = "released pod %s through startup barrier%s" % (
+                    attrs.get("pod"),
+                    " after %.3fs" % waited if waited else "")
+        elif name == "coordination_deny":
+            text = "pod %s held at barrier: %s" % (attrs.get("pod"),
+                                                   attrs.get("reason"))
+        elif name == "k8s_event" and include_k8s_events:
+            text = "%s %s: %s" % (attrs.get("type"), attrs.get("reason"),
+                                  attrs.get("message"))
+        elif name in ("create", "delete"):
+            text = "%s %s %s" % (name, attrs.get("kind"), attrs.get("obj"))
+        elif name == "watch_restart":
+            text = "watch %s restarted (%s)" % (attrs.get("kind"),
+                                                attrs.get("reason"))
+        elif name == "informer_resync":
+            text = "informer %s resynced" % attrs.get("kind")
+        elif name == "reconcile" and verbose:
+            text = "reconcile %s/%s -> %s (%.1fms)" % (
+                attrs.get("namespace"), attrs.get("obj"),
+                attrs.get("outcome", "?"), rec.get("dur_ms", 0.0))
+        if text is None:
+            continue
+        out.append({"t": rec.get("t0", 0.0), "source": "trace",
+                    "job": jkey, "text": text})
+    return out
+
+
+def event_entries(events: List[dict], job: Optional[str] = None) -> List[dict]:
+    out = []
+    for ev in events or []:
+        if ev.get("kind") and ev.get("kind") != "Event":
+            continue
+        inv = ev.get("involvedObject") or {}
+        jkey = "%s/%s" % (inv.get("namespace", "default"),
+                          inv.get("name", ""))
+        if not _matches(jkey, job):
+            continue
+        t = parse_iso(ev.get("firstTimestamp") or ev.get("lastTimestamp"))
+        out.append({
+            "t": t if t is not None else 0.0,
+            "source": "event",
+            "job": jkey,
+            "text": "%s %s: %s" % (ev.get("type"), ev.get("reason"),
+                                   ev.get("message")),
+        })
+    return out
+
+
+def build_timeline(trace_records: List[dict], events: List[dict],
+                   job: Optional[str] = None,
+                   verbose: bool = False) -> List[dict]:
+    """Merge trace + events into one time-ordered timeline. The trace
+    mirrors every operator-emitted Event (ObservedEventRecorder) with
+    sub-second timestamps, while corev1 Event timestamps have 1s
+    resolution — so an Event object whose exact (job, text) is already
+    mirrored in the trace is dropped in favor of the trace copy, but
+    Events the trace does NOT cover (pre-restart history, another
+    replica's jobs, traces recorded without the mirror) are kept."""
+    entries = trace_entries(trace_records, job=job, verbose=verbose,
+                            include_k8s_events=True)
+    mirrored = {(e["job"], e["text"]) for e in entries}
+    entries += [e for e in event_entries(events, job=job)
+                if (e["job"], e["text"]) not in mirrored]
+    entries.sort(key=lambda e: e["t"])
+    return entries
+
+
+def phases_of(timeline: List[dict]) -> List[str]:
+    """The phase sequence a timeline reconstructs (lifecycle check)."""
+    out = []
+    for e in timeline:
+        if e["source"] == "trace" and e["text"].startswith("phase: "):
+            out.append(e["text"].rsplit("-> ", 1)[1])
+    return out
+
+
+def render_report(timeline: List[dict], metrics_text: str = "",
+                  job: Optional[str] = None) -> str:
+    lines = []
+    title = "Job timeline" + (" for %s" % job if job else "")
+    lines.append(title)
+    lines.append("=" * len(title))
+    if not timeline:
+        lines.append("(no reportable entries)")
+    else:
+        t0 = timeline[0]["t"]
+        for e in timeline:
+            tag = "" if job else " %-24s" % (e.get("job") or "-")
+            lines.append("%+9.3fs  [%-5s]%s %s"
+                         % (e["t"] - t0, e["source"], tag, e["text"]))
+    if metrics_text:
+        lines.append("")
+        lines.append("Metrics (job-scoped families)")
+        lines.append("-----------------------------")
+        # match the QUOTED label value, not a substring — job "train"
+        # must not swallow "train-b"'s lines in its triage output — and
+        # escape it the way the exposition does, or adversarial names
+        # would never match their own (escaped) metric lines
+        if job:
+            from paddle_operator_tpu.k8s.runtime import escape_label_value
+
+            label = 'job="%s"' % escape_label_value(job)
+        else:
+            label = None
+        for line in metrics_text.splitlines():
+            if line.startswith("#"):
+                continue
+            if ("tpujob_job_" in line or "tpujob_elastic_" in line
+                    or "tpujob_coordination_" in line
+                    or "tpujob_phase_seconds" in line):
+                if label is None or ('job="' not in line) or (label in line):
+                    # drop zero-valued phase-gauge lines: 13 zeros per job
+                    # bury the one phase the reader wants
+                    if line.startswith("tpujob_job_phase") and \
+                            line.endswith(" 0"):
+                        continue
+                    lines.append("  " + line)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# chaos mode
+# ---------------------------------------------------------------------------
+
+def run_chaos(scenario: str, seed: int, verbose: bool) -> int:
+    """Run one chaos-harness scenario with tracing enabled, then report
+    each job's timeline from the trace + recorded events."""
+    import paddle_operator_tpu.utils.trace as trace_mod
+    from paddle_operator_tpu.chaos.harness import ChaosHarness
+    from paddle_operator_tpu.chaos.plan import CONTROL_SCENARIOS, build_plan
+
+    if scenario not in CONTROL_SCENARIOS:
+        print("scenario %r is not a control-plane scenario (one of %s)"
+              % (scenario, ", ".join(sorted(CONTROL_SCENARIOS))))
+        return 2
+    fd, trace_path = tempfile.mkstemp(prefix="obs-trace-", suffix=".jsonl")
+    os.close(fd)
+    prev = trace_mod._global
+    trace_mod._global = trace_mod.Tracer(path=trace_path)
+    try:
+        harness = ChaosHarness(build_plan(scenario, seed, quick=True))
+        report = harness.run()
+        events = harness.h.client.all_objects("Event")
+        metrics = harness.h.manager.metrics_text()
+    finally:
+        trace_mod.tracer().close()
+        trace_mod._global = prev
+        records = load_trace(trace_path)
+        os.unlink(trace_path)  # even on a raising run: no /tmp litter
+    print(report.summary_line())
+    print()
+    rc = 2
+    for name in sorted(report.jobs):
+        jkey = "default/%s" % name
+        timeline = build_timeline(records, events, job=jkey, verbose=verbose)
+        if timeline:
+            rc = 0
+        print(render_report(timeline, metrics_text=metrics, job=jkey))
+        print()
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge trace + events (+ metrics) into a job timeline")
+    ap.add_argument("--trace", help="Tracer JSONL file (TPUJOB_TRACE_FILE)")
+    ap.add_argument("--events",
+                    help="JSON file holding a list of corev1 Events")
+    ap.add_argument("--metrics", help="text-exposition snapshot to append")
+    ap.add_argument("--job", help="restrict to one job: namespace/name")
+    ap.add_argument("--chaos", metavar="SCENARIO",
+                    help="run this chaos scenario (with tracing) and "
+                         "report from its output")
+    ap.add_argument("--seed", type=int, default=0, help="chaos seed")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="include every reconcile span")
+    args = ap.parse_args(argv)
+
+    if args.chaos:
+        return run_chaos(args.chaos, args.seed, args.verbose)
+    if not args.trace and not args.events:
+        ap.error("need --trace and/or --events (or --chaos)")
+    records = load_trace(args.trace) if args.trace else []
+    events: List[dict] = []
+    if args.events:
+        with open(args.events) as f:
+            loaded = json.load(f)
+        events = loaded.get("items", loaded) if isinstance(loaded, dict) \
+            else loaded
+    metrics = ""
+    if args.metrics:
+        with open(args.metrics) as f:
+            metrics = f.read()
+    timeline = build_timeline(records, events, job=args.job,
+                              verbose=args.verbose)
+    print(render_report(timeline, metrics_text=metrics, job=args.job))
+    return 0 if timeline else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
