@@ -4,9 +4,13 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all test test-fast chaos obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
+
+# the default pre-merge gate: project lint + the fast suite + the fast
+# suite again under the runtime race detector (docs/static-analysis.md)
+verify: analyze test-fast race
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -16,6 +20,41 @@ test:
 # tests (tests/test_chaos.py); CI/judge runs `test` (everything)
 test-fast:
 	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# static analysis (docs/static-analysis.md): opslint's project-specific
+# passes (lock discipline, thread hygiene, reconcile purity, metrics
+# conventions) fail on any non-baselined finding; mypy (strict on api/ +
+# analysis/) and ruff (critical rules) run when installed — the image
+# does not bake them in, so they gate only where available
+analyze:
+	$(PY) scripts/opslint.py
+	@if $(PY) -c "import mypy" 2>/dev/null; then \
+	  $(PY) -m mypy paddle_operator_tpu/api paddle_operator_tpu/analysis; \
+	else \
+	  echo "analyze: mypy not installed; skipping (config in pyproject.toml)"; \
+	fi
+	@if $(PY) -c "import ruff" 2>/dev/null; then \
+	  $(PY) -m ruff check paddle_operator_tpu; \
+	else \
+	  echo "analyze: ruff not installed; skipping (config in pyproject.toml)"; \
+	fi
+
+# the control-plane + data-plane fast tests re-run under the
+# instrumented-lock race/deadlock detector (TPUJOB_RACE_DETECT=1): any
+# lock-order inversion or guarded-field violation fails the session.
+# Scoped to the concurrency-relevant suites (the jax numeric tests
+# create no project locks, and several fail at the seed for unrelated
+# jax-version reasons — they would mask this gate's signal).
+race:
+	env TPUJOB_RACE_DETECT=1 $(PY) -m pytest -x -q -m "not slow" \
+	  tests/test_analysis.py tests/test_chaos.py tests/test_coordination.py \
+	  tests/test_data.py tests/test_elastic_e2e.py tests/test_fake_client.py \
+	  tests/test_helper.py tests/test_hostport_elastic_server.py \
+	  tests/test_http_client.py tests/test_informer.py \
+	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
+	  tests/test_observability.py tests/test_reconciler.py \
+	  tests/test_runtime_edge.py tests/test_scale_stress.py \
+	  tests/test_trace.py tests/test_websocket.py
 
 # deterministic fault-injection sweep: every chaos scenario under seeded
 # faults, invariants audited, each seed replayed to prove determinism

@@ -1,0 +1,37 @@
+"""Project-specific static analysis + runtime race/deadlock detection.
+
+Two halves (see docs/static-analysis.md for the rule catalog):
+
+* :mod:`.opslint` — AST lint passes encoding the operator's own
+  concurrency and reconcile contracts: lock discipline (OPS1xx), thread
+  hygiene (OPS2xx), reconcile purity (OPS3xx), and metrics conventions
+  (OPS4xx). Run via ``scripts/opslint.py`` / ``make analyze``.
+* :mod:`.racedetect` — instrumented ``threading`` lock wrappers that
+  record the lock-acquisition-order graph across threads, detect
+  order-inversion cycles (potential deadlocks) and long-hold outliers,
+  plus a happens-before checker for declared shared fields. Switched on
+  over the whole test suite with ``TPUJOB_RACE_DETECT=1`` (``make race``).
+
+Both are stdlib-only; nothing here imports jax or the k8s stack, so the
+tooling lints the operator without executing it.
+"""
+
+from .opslint import (  # noqa: F401
+    Finding,
+    RULES,
+    apply_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+from .racedetect import (  # noqa: F401
+    InstrumentedLock,
+    InstrumentedRLock,
+    Registry,
+    enabled,
+    guard_fields,
+    install,
+    race_report,
+    uninstall,
+)

@@ -1,0 +1,506 @@
+"""Runtime race/deadlock detection: instrumented locks + happens-before.
+
+:func:`install` swaps ``threading.Lock`` / ``threading.RLock`` /
+``threading.Condition`` for instrumented wrappers (only for locks
+*created from this project's source tree* — stdlib-internal locks stay
+raw, keeping noise and overhead near zero). Every successful acquisition
+records, per thread, the set of locks already held, building a global
+**lock-order graph** over lock *creation sites*. At report time:
+
+* a cycle in that graph is a **lock-order inversion** — two threads that
+  ever interleave those paths can deadlock (the AB/BA pattern);
+* holds longer than ``TPUJOB_RACE_LONG_HOLD`` seconds (default 1.0) and
+  acquisitions that waited longer than ``TPUJOB_RACE_CONTENTION``
+  (default 0.5) are reported as outliers — warnings, not failures.
+
+:func:`guard_fields` adds a happens-before check for declared shared
+fields: the object's class is swapped for a subclass whose attribute
+access asserts the owning (instrumented) lock is held by the current
+thread; violations are recorded, not raised, so one race does not mask
+the rest of a run.
+
+The whole tier-1 suite runs under this via ``TPUJOB_RACE_DETECT=1``
+(tests/conftest.py installs at import, fails the session on inversions
+or guarded-field violations) — ``make race``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_condition = threading.Condition
+
+_PROJECT_MARKERS = ("paddle_operator_tpu", "tests")
+
+
+def _creation_site(depth: int = 2) -> Tuple[str, int]:
+    frame = sys._getframe(depth)
+    return (frame.f_code.co_filename, frame.f_lineno)
+
+
+def _site_label(site: Tuple[str, int]) -> str:
+    path, line = site
+    for marker in _PROJECT_MARKERS:
+        idx = path.find(marker)
+        if idx >= 0:
+            path = path[idx:]
+            break
+    return "%s:%d" % (path, line)
+
+
+def _is_project_frame(depth: int) -> bool:
+    try:
+        fname = sys._getframe(depth).f_code.co_filename
+    except ValueError:  # pragma: no cover - shallow stack
+        return False
+    return any(m in fname for m in _PROJECT_MARKERS)
+
+
+@dataclass
+class RaceReport:
+    inversions: List[str] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+    long_holds: List[str] = field(default_factory=list)
+    contended: List[str] = field(default_factory=list)
+    locks_tracked: int = 0
+    edges: int = 0
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.inversions or self.violations)
+
+    def render(self) -> str:
+        lines = ["race detector: %d locks tracked, %d order edges"
+                 % (self.locks_tracked, self.edges)]
+        for title, entries in (("LOCK-ORDER INVERSIONS", self.inversions),
+                               ("GUARDED-FIELD VIOLATIONS",
+                                self.violations),
+                               ("long holds (warning)", self.long_holds),
+                               ("contended acquires (warning)",
+                                self.contended)):
+            if entries:
+                lines.append("%s (%d):" % (title, len(entries)))
+                lines.extend("  " + e for e in entries)
+        return "\n".join(lines)
+
+
+class Registry:
+    """Shared state for a set of instrumented locks.
+
+    One process-global instance backs :func:`install`; unit tests build
+    private registries so planted inversions never leak into the
+    session-level report that ``make race`` gates on.
+    """
+
+    def __init__(self,
+                 long_hold_s: Optional[float] = None,
+                 contention_s: Optional[float] = None) -> None:
+        self._mu = _real_lock()
+        self._local = threading.local()
+        # site -> set of successor sites (edge = held site, then
+        # acquired site), plus one example per edge for the report
+        self._graph: Dict[Tuple[str, int], Set[Tuple[str, int]]] = {}
+        self._edge_example: Dict[Tuple[Tuple[str, int], Tuple[str, int]],
+                                 str] = {}
+        self._violations: Dict[Tuple[str, str, str], str] = {}
+        self._long_holds: Dict[Tuple[str, int], Tuple[int, float]] = {}
+        self._contended: Dict[Tuple[str, int], Tuple[int, float]] = {}
+        self.locks_created = 0
+        if long_hold_s is None:
+            long_hold_s = float(
+                os.environ.get("TPUJOB_RACE_LONG_HOLD", "1.0"))
+        if contention_s is None:
+            contention_s = float(
+                os.environ.get("TPUJOB_RACE_CONTENTION", "0.5"))
+        self.long_hold_s = long_hold_s
+        self.contention_s = contention_s
+
+    # -- per-thread held stack -----------------------------------------
+
+    def _held(self) -> List[List[Any]]:
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = self._local.held = []
+        return held
+
+    def on_created(self) -> None:
+        with self._mu:
+            self.locks_created += 1
+
+    def on_acquired(self, lock: "_InstrumentedBase",
+                    waited: float) -> None:
+        held = self._held()
+        if waited > self.contention_s:
+            with self._mu:
+                n, tot = self._contended.get(lock.site, (0, 0.0))
+                self._contended[lock.site] = (n + 1, tot + waited)
+        if held:
+            new_edges = []
+            for entry in held:
+                prior: "_InstrumentedBase" = entry[0]
+                if prior is lock or prior.site == lock.site:
+                    # reentrancy and same-site pairs (two instances from
+                    # one constructor line) are not an ordering signal
+                    continue
+                new_edges.append(prior.site)
+            if new_edges:
+                with self._mu:
+                    for src in new_edges:
+                        succ = self._graph.setdefault(src, set())
+                        if lock.site not in succ:
+                            succ.add(lock.site)
+                            self._edge_example[(src, lock.site)] = (
+                                "thread %r held %s then took %s"
+                                % (threading.current_thread().name,
+                                   _site_label(src),
+                                   _site_label(lock.site)))
+        held.append([lock, time.perf_counter()])
+
+    def on_released(self, lock: "_InstrumentedBase") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                _, t0 = held.pop(i)
+                hold = time.perf_counter() - t0
+                if hold > self.long_hold_s:
+                    with self._mu:
+                        n, mx = self._long_holds.get(lock.site, (0, 0.0))
+                        self._long_holds[lock.site] = (n + 1,
+                                                       max(mx, hold))
+                return
+
+    def held_by_current(self, lock: "_InstrumentedBase") -> bool:
+        return any(entry[0] is lock for entry in self._held())
+
+    # -- happens-before violations -------------------------------------
+
+    def record_violation(self, owner: str, fieldname: str,
+                         kind: str) -> None:
+        site = "?"
+        for fs in traceback.extract_stack()[-8:-2][::-1]:
+            if any(m in fs.filename for m in _PROJECT_MARKERS) \
+                    and "racedetect" not in fs.filename:
+                site = "%s:%d" % (_site_label((fs.filename, fs.lineno or 0))
+                                  .rsplit(":", 1)[0], fs.lineno or 0)
+                break
+        key = (owner, fieldname, site)
+        with self._mu:
+            if key not in self._violations:
+                self._violations[key] = (
+                    "%s.%s %s at %s without holding its declared lock "
+                    "(thread %r)" % (owner, fieldname, kind, site,
+                                     threading.current_thread().name))
+
+    # -- reporting ------------------------------------------------------
+
+    def _cycles(self) -> List[List[Tuple[str, int]]]:
+        """Tarjan SCCs over the site graph; any SCC with >1 node (or a
+        self-edge) is a potential-deadlock cycle."""
+        index: Dict[Tuple[str, int], int] = {}
+        low: Dict[Tuple[str, int], int] = {}
+        onstack: Set[Tuple[str, int]] = set()
+        stack: List[Tuple[str, int]] = []
+        out: List[List[Tuple[str, int]]] = []
+        counter = [0]
+
+        with self._mu:
+            graph = {k: set(v) for k, v in self._graph.items()}
+
+        def strongconnect(v: Tuple[str, int]) -> None:
+            # iterative DFS (the graph is tiny, but recursion limits are
+            # not worth the risk in a session-end hook)
+            work = [(v, iter(sorted(graph.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            onstack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        onstack.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in onstack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        onstack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        out.append(sorted(scc))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+    def report(self) -> RaceReport:
+        rep = RaceReport()
+        cycles = self._cycles()
+        with self._mu:
+            rep.locks_tracked = self.locks_created
+            rep.edges = sum(len(v) for v in self._graph.values())
+            for cyc in cycles:
+                detail = []
+                for i, site in enumerate(cyc):
+                    nxt = cyc[(i + 1) % len(cyc)]
+                    ex = self._edge_example.get((site, nxt))
+                    if ex is None:  # edge direction inside the SCC
+                        for other in cyc:
+                            ex = self._edge_example.get((site, other))
+                            if ex:
+                                break
+                    if ex:
+                        detail.append(ex)
+                rep.inversions.append(
+                    "cycle over %s — %s"
+                    % (" -> ".join(_site_label(s) for s in cyc),
+                       "; ".join(detail) or "interleaved orders"))
+            rep.violations = sorted(self._violations.values())
+            rep.long_holds = [
+                "%s held >%0.2fs %d time(s), max %.3fs"
+                % (_site_label(site), self.long_hold_s, n, mx)
+                for site, (n, mx) in sorted(self._long_holds.items())]
+            rep.contended = [
+                "%s waited >%0.2fs %d time(s), %.3fs total"
+                % (_site_label(site), self.contention_s, n, tot)
+                for site, (n, tot) in sorted(self._contended.items())]
+        return rep
+
+
+_registry = Registry()
+
+
+class _InstrumentedBase:
+    """Common shell: ``site`` identifies the creation line; ``_inner``
+    is the real primitive."""
+
+    __slots__ = ("_inner", "site", "_registry")
+
+    def __init__(self, site: Optional[Tuple[str, int]],
+                 registry: Optional[Registry]) -> None:
+        self.site = site if site is not None else _creation_site(3)
+        self._registry = registry if registry is not None else _registry
+        self._registry.on_created()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<%s %s %r>" % (type(self).__name__, _site_label(self.site),
+                               self._inner)
+
+
+class InstrumentedLock(_InstrumentedBase):
+    """``threading.Lock`` wrapper feeding the lock-order registry."""
+
+    __slots__ = ()
+
+    def __init__(self, site: Optional[Tuple[str, int]] = None,
+                 registry: Optional[Registry] = None) -> None:
+        super().__init__(site, registry)
+        self._inner = _real_lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._registry.on_acquired(self, time.perf_counter() - t0)
+        return ok
+
+    def release(self) -> None:
+        self._registry.on_released(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover - fork path
+        self._inner._at_fork_reinit()
+
+
+class InstrumentedRLock(_InstrumentedBase):
+    """``threading.RLock`` wrapper: reentrant acquires collapse to one
+    registry entry, and the ``_release_save``/``_acquire_restore``/
+    ``_is_owned`` trio is forwarded so ``threading.Condition`` can wrap
+    one (``cv.wait`` fully releases — the registry sees that too,
+    otherwise every lock taken while *waiting* would fake an edge)."""
+
+    __slots__ = ("_count",)
+
+    def __init__(self, site: Optional[Tuple[str, int]] = None,
+                 registry: Optional[Registry] = None) -> None:
+        super().__init__(site, registry)
+        self._inner = _real_rlock()
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._count += 1  # safe: we hold the inner lock
+            if self._count == 1:
+                self._registry.on_acquired(self,
+                                           time.perf_counter() - t0)
+        return ok
+
+    def release(self) -> None:
+        if self._count == 1:
+            self._registry.on_released(self)
+        if self._count > 0:
+            self._count -= 1
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    # Condition protocol --------------------------------------------------
+
+    def _release_save(self) -> Tuple[Any, int]:
+        saved = self._count
+        self._count = 0
+        self._registry.on_released(self)
+        return (self._inner._release_save(), saved)
+
+    def _acquire_restore(self, state: Tuple[Any, int]) -> None:
+        inner_state, saved = state
+        self._inner._acquire_restore(inner_state)
+        self._count = saved
+        self._registry.on_acquired(self, 0.0)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover - fork path
+        self._inner._at_fork_reinit()
+        self._count = 0
+
+
+# ---------------------------------------------------------------------------
+# installation (threading.* factory patching)
+# ---------------------------------------------------------------------------
+
+_installed = False
+
+
+def _lock_factory() -> Any:
+    if _is_project_frame(2):
+        return InstrumentedLock(_creation_site(2))
+    return _real_lock()
+
+
+def _rlock_factory() -> Any:
+    if _is_project_frame(2):
+        return InstrumentedRLock(_creation_site(2))
+    return _real_rlock()
+
+
+def _condition_factory(lock: Any = None) -> Any:
+    if lock is None and _is_project_frame(2):
+        # bare Condition() in project code: give it an instrumented
+        # RLock so waits/holds on it are tracked like explicit locks
+        lock = InstrumentedRLock(_creation_site(2))
+    return _real_condition(lock)
+
+
+def install() -> None:
+    """Patch ``threading.Lock/RLock/Condition``. Locks created from
+    stdlib or third-party frames keep the real primitives."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _lock_factory  # type: ignore[assignment]
+    threading.RLock = _rlock_factory  # type: ignore[assignment]
+    threading.Condition = _condition_factory  # type: ignore[assignment]
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _real_lock  # type: ignore[assignment]
+    threading.RLock = _real_rlock  # type: ignore[assignment]
+    threading.Condition = _real_condition  # type: ignore[assignment]
+    _installed = False
+
+
+def enabled() -> bool:
+    return _installed
+
+
+def race_report() -> RaceReport:
+    """Session-level report over the global registry."""
+    return _registry.report()
+
+
+# ---------------------------------------------------------------------------
+# happens-before checker for declared shared fields
+# ---------------------------------------------------------------------------
+
+def guard_fields(obj: Any, lock_attr: str, fields: Iterable[str],
+                 registry: Optional[Registry] = None) -> Any:
+    """Declare that ``fields`` of ``obj`` are shared state guarded by
+    ``getattr(obj, lock_attr)``. Every later read/write of those fields
+    without the current thread holding that lock records a violation.
+
+    No-op (returns ``obj`` unchanged) when the lock is not an
+    instrumented one — i.e. outside ``TPUJOB_RACE_DETECT`` runs — so
+    production code paths can call this unconditionally.
+    """
+    lock = getattr(obj, lock_attr)
+    if isinstance(lock, _real_condition):
+        lock = lock._lock  # guard on the underlying lock object
+    if not isinstance(lock, (InstrumentedLock, InstrumentedRLock)):
+        return obj
+    reg = registry if registry is not None else lock._registry
+    guarded: FrozenSet[str] = frozenset(fields)
+    cls = obj.__class__
+    owner_name = cls.__name__
+
+    def __getattribute__(self: Any, name: str) -> Any:
+        if name in guarded and not reg.held_by_current(lock):
+            reg.record_violation(owner_name, name, "read")
+        return cls.__getattribute__(self, name)
+
+    def __setattr__(self: Any, name: str, value: Any) -> None:
+        if name in guarded and not reg.held_by_current(lock):
+            reg.record_violation(owner_name, name, "write")
+        cls.__setattr__(self, name, value)
+
+    sub = type("Guarded" + owner_name, (cls,), {
+        "__getattribute__": __getattribute__,
+        "__setattr__": __setattr__,
+    })
+    obj.__class__ = sub
+    return obj

@@ -195,6 +195,12 @@ class CoordinationServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        # bounded join (thread-hygiene contract, opslint OPS202): the
+        # serve loop exits on shutdown(); a wedge here must not hang
+        # operator shutdown forever
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
     @property
     def url(self) -> str:

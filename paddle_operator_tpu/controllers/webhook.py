@@ -139,7 +139,7 @@ class AdmissionWebhookServer:
 
     def start(self) -> "AdmissionWebhookServer":
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever, daemon=True, name="webhook")
         self._thread.start()
         log.info("admission webhook serving on %s (tls=%s)",
                  self.url, self.tls)

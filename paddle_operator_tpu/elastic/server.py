@@ -86,7 +86,8 @@ class MembershipServer:
         return "http://%s:%d" % (host, port)
 
     def start(self) -> "MembershipServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="elastic-kv")
         self._thread.start()
         return self
 

@@ -63,7 +63,10 @@ class MemoryKVStore(KVStore):
 
     @property
     def revision(self) -> int:
-        return self._revision
+        # under the lock like every other _revision access (opslint
+        # OPS101: a torn read here could skip an elastic resync epoch)
+        with self._lock:
+            return self._revision
 
 
 class HttpKVStore(KVStore):

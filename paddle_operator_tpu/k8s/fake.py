@@ -183,7 +183,7 @@ class FakeKubeClient(KubeClient):
             if merged["metadata"].get("deletionTimestamp") and not merged[
                 "metadata"
             ].get("finalizers"):
-                self._remove(key)
+                self._remove_locked(key)
             else:
                 self._notify("MODIFIED", merged)
             return deep_copy(merged)
@@ -213,9 +213,11 @@ class FakeKubeClient(KubeClient):
                     cur["metadata"]["resourceVersion"] = self._next_rv()
                     self._notify("MODIFIED", cur)
                 return
-            self._remove(key)
+            self._remove_locked(key)
 
-    def _remove(self, key: Tuple[str, str, str]) -> None:
+    def _remove_locked(self, key: Tuple[str, str, str]) -> None:
+        # caller holds self._lock (the _locked contract opslint OPS101
+        # enforces: _store is only ever touched under the lock)
         gone = self._store.pop(key, None)
         if gone is None:
             return
@@ -236,7 +238,7 @@ class FakeKubeClient(KubeClient):
                 child["metadata"].setdefault("deletionTimestamp", now_iso())
                 self._notify("MODIFIED", child)
             else:
-                self._remove(child_key)
+                self._remove_locked(child_key)
 
     # -- exec --------------------------------------------------------------
 

@@ -29,10 +29,11 @@ from .k8s.runtime import Manager
 from .obs import JobMetrics, http_respond
 
 
-def _serve(bind: str, handler_cls) -> ThreadingHTTPServer:
+def _serve(bind: str, handler_cls, name: str) -> ThreadingHTTPServer:
     host, _, port = bind.rpartition(":")
     srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler_cls)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name=name).start()
     return srv
 
 
@@ -317,8 +318,8 @@ def main(argv=None):
 
     Metrics = metrics_handler(mgr, job_metrics)
 
-    _serve(args.health_probe_bind_address, Probes)
-    _serve(args.metrics_bind_address, Metrics)
+    _serve(args.health_probe_bind_address, Probes, "health-probes")
+    _serve(args.metrics_bind_address, Metrics, "metrics")
 
     log.info("starting manager (scheduling=%r, membership=%r)",
              args.scheduling, args.membership)

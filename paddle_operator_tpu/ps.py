@@ -197,6 +197,12 @@ class SnapshotStore:
         # BACKGROUND compaction thread — never held together with the
         # ParamServer condition lock, so no deadlock is possible
         self._lock = threading.Lock()
+        # single-flight compaction (opslint OPS201/OPS202: the thread is
+        # named, tracked, and joined in close(); previously every 50th
+        # delta spawned an anonymous unjoined thread, so a slow disk
+        # could stack concurrent compactions racing each other's
+        # delta-removal pass)
+        self._compact_thread: Optional[threading.Thread] = None
         os.makedirs(path, exist_ok=True)
 
     def _write(self, name: str, **arrays) -> None:
@@ -227,8 +233,24 @@ class SnapshotStore:
         if self.compact_every and version % self.compact_every == 0:
             # off the caller's (server-lock-holding) thread: compaction
             # re-reads and rewrites O(table) files — pulls/pushes must
-            # not stall behind that disk I/O
-            threading.Thread(target=self.compact, daemon=True).start()
+            # not stall behind that disk I/O. Single-flight: a still-
+            # running compaction covers this round's deltas on its next
+            # trigger (versions only grow).
+            with self._lock:
+                if (self._compact_thread is None
+                        or not self._compact_thread.is_alive()):
+                    self._compact_thread = threading.Thread(
+                        target=self.compact, daemon=True,
+                        name="ps-snapshot-compact")
+                    self._compact_thread.start()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Bounded drain of the in-flight compaction (ParamServer.stop)."""
+        with self._lock:
+            t = self._compact_thread
+            self._compact_thread = None
+        if t is not None:
+            t.join(timeout=timeout)
 
     def _delta_files(self):
         return sorted(
@@ -392,7 +414,8 @@ class ParamServer:
 
     def start(self) -> "ParamServer":
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever, daemon=True,
+            name="ps-serve-%s" % self.endpoint)
         self._thread.start()
         return self
 
@@ -401,6 +424,8 @@ class ParamServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self.snap is not None:
+            self.snap.close()
 
     def serve_forever(self) -> None:
         """Blocking entry for a dedicated pserver process/thread."""
@@ -575,12 +600,14 @@ class ParamServer:
                         all_done = len(s._done) >= s.n_trainers
                     if all_done:
                         threading.Thread(target=s._httpd.shutdown,
-                                         daemon=True).start()
+                                         daemon=True,
+                                         name="ps-shutdown").start()
                     return
                 if self.path.startswith("/shutdown"):
                     self._send(200)
                     threading.Thread(target=s._httpd.shutdown,
-                                     daemon=True).start()
+                                     daemon=True,
+                                     name="ps-shutdown").start()
                     return
                 self._send(404)
 

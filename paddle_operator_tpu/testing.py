@@ -92,6 +92,21 @@ class OperatorHarness:
             owner_kind=api.KIND,
         )
         self.controller.backoff_provider = self.reconciler.current_backoff
+        # Under TPUJOB_RACE_DETECT (make race) declare the shared fields
+        # the PR 2/3 incidents were about: every access must hold the
+        # owning lock or the session fails (happens-before checker —
+        # no-op when the detector is off, see analysis/racedetect.py).
+        from .analysis import racedetect
+
+        if racedetect.enabled():
+            racedetect.guard_fields(self.job_metrics, "_lock", [
+                "_phase", "_hist", "_hist_sum", "_hist_count",
+                "_restarts", "_resizes", "_barrier_wait", "_releases"])
+            racedetect.guard_fields(self.reconciler, "_err_lock",
+                                    ["_err_streak", "_err_hit"])
+            if self.coord_server is not None:
+                racedetect.guard_fields(self.coord_server, "_barrier_lock",
+                                        ["_first_denied", "_released_pods"])
 
     def close(self) -> None:
         if self.coord_server is not None:

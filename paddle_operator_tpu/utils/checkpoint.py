@@ -144,14 +144,30 @@ class AsyncCheckpointer:
             target=write, name="ckpt-write-%d" % step, daemon=True)
         self._thread.start()
 
-    def wait(self) -> None:
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Drain the pending write; re-raise a failed write's exception
+        (it must not die silently — a checkpoint that failed to persist
+        must not look saved). With ``timeout``, raise ``TimeoutError``
+        if the write is still in flight when it expires; the write
+        thread keeps running and a later wait() can still drain it."""
         if self._thread is not None:
-            self._thread.join()
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    "checkpoint write %r still in flight after %.1fs"
+                    % (self._thread.name, timeout))
             self._thread = None
         with self._lock:
             if self._error is not None:
                 err, self._error = self._error, None
                 raise err
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Bounded join-on-close (thread-hygiene contract, opslint
+        OPS202): drains the in-flight write for up to ``timeout``
+        seconds and surfaces its exception, instead of the process
+        exiting with a silently-unfinished (or silently-failed) write."""
+        self.wait(timeout=timeout)
 
 
 def all_steps(ckpt_dir: str):

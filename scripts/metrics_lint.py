@@ -12,6 +12,12 @@ contiguous, labels escaped, values parse) against:
                                                # `make metrics-lint` lane)
 
 Exit code 0 = clean, 1 = violations (each printed with its line number).
+
+This is the RUNTIME half of the metrics gate: it validates what a live
+process actually serves. The SOURCE half is opslint's OPS401-403 passes
+(scripts/opslint.py, `make analyze`), which catch an undeclared family,
+a missing tpujob_ prefix, or label-set drift before any process runs —
+see docs/static-analysis.md.
 """
 
 from __future__ import annotations

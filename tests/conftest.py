@@ -27,6 +27,34 @@ except ImportError:  # control-plane tests run fine without jax
 
 import pytest
 
+# Runtime race/deadlock detection (make race): TPUJOB_RACE_DETECT=1
+# swaps threading.Lock/RLock/Condition for instrumented wrappers BEFORE
+# any test module imports the package, so every project lock created
+# during the session feeds the lock-order graph. The session fails on
+# lock-order inversions or guarded-field violations (see
+# docs/static-analysis.md).
+_RACE_MODE = bool(os.environ.get("TPUJOB_RACE_DETECT"))
+if _RACE_MODE:
+    from paddle_operator_tpu.analysis import racedetect as _racedetect
+
+    _racedetect.install()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _RACE_MODE:
+        return
+    rep = _racedetect.race_report()
+    terminalreporter.section("race detector (TPUJOB_RACE_DETECT)")
+    terminalreporter.write_line(rep.render())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _RACE_MODE:
+        return
+    if _racedetect.race_report().failed:
+        session.exitstatus = max(int(exitstatus) or 0, 1)
+
+
 # The compile-heavy tail (>10s each on the 1-core box, `pytest
 # --durations=30` round-4): ~6 of the ~21 suite minutes. Marked centrally
 # so the fast lane (`make test-fast`, -m "not slow") stays current from a

@@ -1,0 +1,475 @@
+"""The analyzer analyzed: every opslint pass must catch its planted bug
+and stay quiet on the clean twin; the runtime detector must catch an
+AB/BA lock-order inversion and a guarded-field race.
+
+Fixture modules are inline source strings (nothing here imports them),
+each pair differing only in the planted defect — so a pass that goes
+quiet on the plant, or noisy on the clean twin, fails loudly.
+"""
+
+import threading
+import time
+
+import pytest
+
+from paddle_operator_tpu.analysis import opslint, racedetect
+from paddle_operator_tpu.analysis.racedetect import (
+    InstrumentedLock, InstrumentedRLock, Registry, guard_fields)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# OPS101 lock discipline
+# ---------------------------------------------------------------------------
+
+UNLOCKED_WRITE = '''
+import threading
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self.hits = 0
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+            self.hits += 1
+
+    def size(self):
+        return len(self._rows)      # planted: read outside the lock
+
+    def reset(self):
+        self.hits = 0               # planted: write outside the lock
+'''
+
+LOCKED_CLEAN = '''
+import threading
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self.hits = 0
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+            self.hits += 1
+
+    def size(self):
+        with self._lock:
+            return len(self._rows)
+
+    def _evict_locked(self):
+        self._rows.clear()          # _locked suffix: assumed under lock
+'''
+
+CONDITION_ALIAS = '''
+import threading
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._items = []
+
+    def put(self, x):
+        with self._cv:
+            self._items.append(x)
+            self._items = list(self._items)
+            self._cv.notify()
+
+    def __len__(self):
+        with self._lock:            # same lock via the Condition alias
+            return len(self._items)
+'''
+
+
+def test_ops101_catches_unlocked_read_and_write():
+    findings = opslint.lint_source(UNLOCKED_WRITE, "fixture_unlocked.py")
+    assert rules_of(findings) == {"OPS101"}
+    flagged = {f.symbol for f in findings}
+    assert "Table.size._rows" in flagged
+    assert "Table.reset.hits" in flagged
+
+
+def test_ops101_quiet_on_clean_and_locked_convention():
+    assert opslint.lint_source(LOCKED_CLEAN, "fixture_clean.py") == []
+
+
+def test_ops101_condition_aliases_its_wrapped_lock():
+    assert opslint.lint_source(CONDITION_ALIAS, "fixture_alias.py") == []
+
+
+def test_ops101_suppression_comment():
+    patched = UNLOCKED_WRITE.replace(
+        "return len(self._rows)      # planted: read outside the lock",
+        "return len(self._rows)  # opslint: disable=OPS101")
+    findings = opslint.lint_source(patched, "fixture_suppressed.py")
+    assert {f.symbol for f in findings} == {"Table.reset.hits"}
+
+
+# ---------------------------------------------------------------------------
+# OPS201 / OPS202 thread hygiene
+# ---------------------------------------------------------------------------
+
+BAD_THREADS = '''
+import threading
+
+def serve(fn):
+    t = threading.Thread(target=fn)   # planted: unnamed AND leaked
+    t.start()
+    return t
+'''
+
+GOOD_THREADS = '''
+import threading
+
+class Server:
+    def start(self, fn):
+        self._t = threading.Thread(target=fn, name="server", daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._t.join(timeout=5)
+'''
+
+
+def test_ops2xx_catch_unnamed_and_leaked_thread():
+    findings = opslint.lint_source(BAD_THREADS, "fixture_threads.py")
+    assert rules_of(findings) == {"OPS201", "OPS202"}
+
+
+def test_ops2xx_quiet_on_named_daemon_joined():
+    assert opslint.lint_source(GOOD_THREADS, "fixture_threads_ok.py") == []
+
+
+def test_ops202_not_satisfied_by_path_or_string_join():
+    # os.path.join / sep.join are not thread joins: the leak must still
+    # be flagged (regression: review found any `.join` silenced OPS202)
+    leaky = BAD_THREADS + '''
+import os
+
+def unrelated(p, parts):
+    return os.path.join(p, "-".join(parts))
+'''
+    findings = opslint.lint_source(leaky, "fixture_path_join.py")
+    assert "OPS202" in rules_of(findings)
+
+
+def test_ops101_one_finding_per_unlocked_write():
+    findings = opslint.lint_source(UNLOCKED_WRITE, "fixture_unlocked.py")
+    # regression: assignment targets were double-recorded (target walk +
+    # expression walk), duplicating findings
+    assert len([f for f in findings
+                if f.symbol == "Table.reset.hits"]) == 1
+    assert "written" in [f for f in findings
+                         if f.symbol == "Table.reset.hits"][0].message
+
+
+# ---------------------------------------------------------------------------
+# OPS301 / OPS302 reconcile purity
+# ---------------------------------------------------------------------------
+
+SLEEPY_RECONCILER = '''
+import time
+
+class FooReconciler:
+    def reconcile(self, namespace, name):
+        time.sleep(1.0)               # planted: blocking the workqueue
+        return None
+'''
+
+RAW_HTTP_RECONCILER = '''
+import urllib.request
+
+class BarReconciler:
+    def _poke(self, url):
+        return urllib.request.urlopen(url)   # planted: bypasses client
+'''
+
+PURE_RECONCILER = '''
+class BazReconciler:
+    def reconcile(self, namespace, name):
+        self.client.update_status({"kind": "TpuJob"})
+        return None
+'''
+
+
+def test_ops301_catches_sleep_in_reconciler():
+    findings = opslint.lint_source(SLEEPY_RECONCILER, "fixture_sleep.py")
+    assert rules_of(findings) == {"OPS301"}
+
+
+def test_ops302_catches_raw_http_in_reconciler():
+    findings = opslint.lint_source(RAW_HTTP_RECONCILER, "fixture_http.py")
+    assert rules_of(findings) == {"OPS302"}
+
+
+def test_ops302_bans_http_imports_in_reconcile_modules():
+    findings = opslint.lint_source(
+        "import urllib.request\n", "controllers/reconciler.py")
+    assert rules_of(findings) == {"OPS302"}
+
+
+def test_ops3xx_quiet_on_pure_reconciler():
+    assert opslint.lint_source(PURE_RECONCILER, "fixture_pure.py") == []
+
+
+# ---------------------------------------------------------------------------
+# OPS401-403 metrics conventions
+# ---------------------------------------------------------------------------
+
+UNDECLARED_METRIC = '''
+def block():
+    return 'tpujob_mystery_total{job="%s"} %d' % ("j", 1)
+'''
+
+DECLARED_METRIC = '''
+def block():
+    lines = ["# HELP tpujob_known_total Things.",
+             "# TYPE tpujob_known_total counter",
+             'tpujob_known_total{job="%s"} %d' % ("j", 1)]
+    return lines
+'''
+
+REGISTRY_DECLARED = '''
+FAMILIES = [("tpujob_reg_total", "Help text.", "counter")]
+
+def block():
+    return 'tpujob_reg_total{job="%s"} %d' % ("j", 1)
+'''
+
+BAD_PREFIX = '''
+FAMILIES = [("paddle_oops_total", "Wrong prefix.", "counter")]
+'''
+
+INCONSISTENT_LABELS = '''
+def block():
+    return ["# TYPE tpujob_twins_total counter",
+            'tpujob_twins_total{job="%s"} %d' % ("j", 1),
+            'tpujob_twins_total{job="%s",cause="%s"} %d' % ("j", "x", 1)]
+'''
+
+HISTOGRAM_SUFFIXES = '''
+def block():
+    return ["# TYPE tpujob_lat_seconds histogram",
+            'tpujob_lat_seconds_bucket{le="1"} %d' % 1,
+            'tpujob_lat_seconds_sum %f' % 0.5,
+            'tpujob_lat_seconds_count %d' % 1]
+'''
+
+
+def test_ops401_catches_undeclared_family():
+    findings = opslint.lint_source(UNDECLARED_METRIC, "fixture_metric.py")
+    assert rules_of(findings) == {"OPS401"}
+    assert findings[0].symbol == "tpujob_mystery_total"
+
+
+def test_ops401_quiet_on_declared_and_registry_families():
+    assert opslint.lint_source(DECLARED_METRIC, "fixture_m_ok.py") == []
+    assert opslint.lint_source(REGISTRY_DECLARED, "fixture_m_reg.py") == []
+
+
+def test_ops402_catches_wrong_prefix():
+    findings = opslint.lint_source(BAD_PREFIX, "fixture_prefix.py")
+    assert rules_of(findings) == {"OPS402"}
+
+
+def test_ops403_catches_inconsistent_label_sets():
+    findings = opslint.lint_source(INCONSISTENT_LABELS, "fixture_labels.py")
+    assert rules_of(findings) == {"OPS403"}
+
+
+def test_ops4xx_histogram_suffixes_fold_to_base():
+    assert opslint.lint_source(HISTOGRAM_SUFFIXES, "fixture_hist.py") == []
+
+
+# ---------------------------------------------------------------------------
+# the package itself must lint clean (the `make analyze` gate, in-suite)
+# ---------------------------------------------------------------------------
+
+def test_package_lints_clean_against_baseline():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = opslint.lint_paths(
+        [os.path.join(repo, "paddle_operator_tpu")], root=repo)
+    baseline = opslint.load_baseline(
+        os.path.join(repo, "opslint_baseline.json"))
+    new, _accepted = opslint.apply_baseline(findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = opslint.lint_source(UNLOCKED_WRITE, "fixture_unlocked.py")
+    path = str(tmp_path / "baseline.json")
+    opslint.write_baseline(findings, path)
+    new, accepted = opslint.apply_baseline(
+        opslint.lint_source(UNLOCKED_WRITE, "fixture_unlocked.py"),
+        opslint.load_baseline(path))
+    assert new == [] and len(accepted) == len(findings)
+    # fingerprints are line-free: shifting the module down two lines
+    # must not churn the baseline
+    shifted = "\n\n" + UNLOCKED_WRITE
+    new, _ = opslint.apply_baseline(
+        opslint.lint_source(shifted, "fixture_unlocked.py"),
+        opslint.load_baseline(path))
+    assert new == []
+
+
+# ---------------------------------------------------------------------------
+# runtime detector: lock-order inversion (AB/BA), long holds, guards
+# ---------------------------------------------------------------------------
+
+def test_deadlock_detector_flags_ab_ba_inversion():
+    reg = Registry()
+    a = InstrumentedLock(site=("fixture.py", 1), registry=reg)
+    b = InstrumentedLock(site=("fixture.py", 2), registry=reg)
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    # two threads, opposite nesting orders, run disjointly: the edges
+    # a->b and b->a land in the graph without the test itself ever being
+    # able to deadlock — exactly the latent AB/BA bug class, which only
+    # deadlocks in production when the interleaving finally lines up
+    t1 = threading.Thread(target=ab, name="ab")
+    t1.start()
+    t1.join(timeout=10)
+    t2 = threading.Thread(target=ba, name="ba")
+    t2.start()
+    t2.join(timeout=10)
+    rep = reg.report()
+    assert rep.inversions, rep.render()
+    assert "fixture.py:1" in rep.inversions[0]
+    assert "fixture.py:2" in rep.inversions[0]
+    assert rep.failed
+
+
+def test_detector_quiet_on_consistent_order():
+    reg = Registry()
+    a = InstrumentedLock(site=("fixture.py", 10), registry=reg)
+    b = InstrumentedLock(site=("fixture.py", 11), registry=reg)
+
+    def nested():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=nested, name="nested")
+    t.start()
+    t.join(timeout=10)
+    nested()
+    rep = reg.report()
+    assert rep.inversions == []
+    assert rep.edges == 1
+
+
+def test_detector_reports_long_hold():
+    reg = Registry(long_hold_s=0.01)
+    lock = InstrumentedLock(site=("fixture.py", 20), registry=reg)
+    with lock:
+        time.sleep(0.03)
+    rep = reg.report()
+    assert rep.long_holds and "fixture.py:20" in rep.long_holds[0]
+    assert not rep.failed  # long holds warn, they do not fail
+
+
+def test_rlock_reentrancy_and_condition_protocol():
+    reg = Registry()
+    rl = InstrumentedRLock(site=("fixture.py", 30), registry=reg)
+    with rl:
+        with rl:  # reentrant: one registry entry, no self-edge
+            assert reg.held_by_current(rl)
+    assert not reg.held_by_current(rl)
+
+    cv = threading.Condition(rl)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append(reg.held_by_current(rl))
+
+    t = threading.Thread(target=waiter, name="waiter")
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify()
+    t.join(timeout=10)
+    assert hits == [True]  # re-acquired after wait, registry agrees
+    assert not reg.held_by_current(rl)
+    assert reg.report().inversions == []
+
+
+class _Counter:
+    def __init__(self, lock):
+        self._lock = lock
+        self.count = 0
+
+    def bump_locked_path(self):
+        with self._lock:
+            self.count += 1
+
+    def bump_racy(self):
+        self.count += 1
+
+
+def test_guard_fields_catches_unlocked_access():
+    reg = Registry()
+    lock = InstrumentedLock(site=("fixture.py", 40), registry=reg)
+    c = guard_fields(_Counter(lock), "_lock", ["count"], registry=reg)
+    c.bump_locked_path()
+    assert reg.report().violations == []
+    c.bump_racy()
+    rep = reg.report()
+    assert rep.violations, rep.render()
+    assert "_Counter.count" in rep.violations[0]
+    assert rep.failed
+
+
+def test_guard_fields_noop_on_raw_lock():
+    c = _Counter(threading.Lock() if not racedetect.enabled()
+                 else __import__("_thread").allocate_lock())
+    assert guard_fields(c, "_lock", ["count"]) is c
+    c.bump_racy()  # no instrumentation, no recording, no crash
+
+
+# ---------------------------------------------------------------------------
+# checkpoint writer hygiene (satellite: bounded join-on-close)
+# ---------------------------------------------------------------------------
+
+def test_async_checkpointer_close_is_bounded(tmp_path, monkeypatch):
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from paddle_operator_tpu.utils.checkpoint import AsyncCheckpointer
+    import paddle_operator_tpu.utils.checkpoint as ckpt_mod
+
+    gate = threading.Event()
+    real_save = ckpt_mod.save_checkpoint
+
+    def slow_save(*a, **kw):
+        gate.wait(timeout=30)
+        return real_save(*a, **kw)
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", slow_save)
+    ck = AsyncCheckpointer()
+    ck.save(str(tmp_path), 1, {"w": [1.0, 2.0]})
+    with pytest.raises(TimeoutError):
+        ck.close(timeout=0.05)   # bounded: returns, loudly
+    gate.set()
+    ck.close(timeout=30)         # write drains and publishes
+    from paddle_operator_tpu.utils.checkpoint import latest_step
+
+    assert latest_step(str(tmp_path)) == 1
