@@ -4,13 +4,14 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
 # the default pre-merge gate: project lint + the fast suite + the fast
 # suite again under the runtime race detector (docs/static-analysis.md)
-verify: analyze test-fast race
+# + one seed of each durable-recovery chaos scenario
+verify: analyze test-fast race recovery
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -53,14 +54,23 @@ race:
 	  tests/test_http_client.py tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
 	  tests/test_observability.py tests/test_reconciler.py \
-	  tests/test_runtime_edge.py tests/test_scale_stress.py \
-	  tests/test_trace.py tests/test_websocket.py
+	  tests/test_recovery.py tests/test_runtime_edge.py \
+	  tests/test_scale_stress.py tests/test_trace.py \
+	  tests/test_websocket.py
 
 # deterministic fault-injection sweep: every chaos scenario under seeded
 # faults, invariants audited, each seed replayed to prove determinism
 # (see docs/design.md "Fault model & chaos harness")
 chaos:
 	$(PY) scripts/chaos_stress.py --seeds 20 --quick
+
+# durable-recovery fast lane (docs/design.md "Recovery & durability"):
+# one seed each of operator_crash (manager torn down and rebuilt
+# mid-incident) and graceful_drain (grace-window eviction + a real tiny
+# training job drained, checkpoint-corrupted, and resumed bit-identically)
+recovery:
+	$(PY) scripts/chaos_stress.py --scenario operator_crash \
+	  --scenario graceful_drain --seeds 1 --quick
 
 # observability lanes (see docs/observability.md):
 #   obs          — rebuild a failure timeline from a recorded chaos run

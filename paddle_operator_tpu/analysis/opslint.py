@@ -195,6 +195,11 @@ class _ClassScanner:
         for fn in self._methods(cls):
             self._scan_func(fn, fn.name, ())
 
+    def _match(self, node: ast.AST) -> Optional[str]:
+        """The guarded-state matcher: ``self.<attr>`` here; overridden by
+        the module-scope scanner to match global names instead."""
+        return _is_self_attr(node)
+
     @staticmethod
     def _methods(cls: ast.ClassDef) -> List[ast.FunctionDef]:
         return [n for n in cls.body
@@ -212,13 +217,13 @@ class _ClassScanner:
                 if short not in _LOCK_FACTORIES:
                     continue
                 for tgt in node.targets:
-                    attr = _is_self_attr(tgt)
+                    attr = self._match(tgt)
                     if attr is None:
                         continue
                     self.locks.add(attr)
                     # Condition(self._lock): either name guards the state
                     for arg in node.value.args:
-                        wrapped = _is_self_attr(arg)
+                        wrapped = self._match(arg)
                         if wrapped is not None:
                             self.locks.union(attr, wrapped)
 
@@ -228,7 +233,7 @@ class _ClassScanner:
         out = []
         for item in node.items:
             expr = item.context_expr
-            attr = _is_self_attr(expr)
+            attr = self._match(expr)
             if attr is not None and self.locks.known(attr):
                 out.append(self.locks.find(attr))
         return out
@@ -287,12 +292,12 @@ class _ClassScanner:
             targets = [node.target]
         for tgt in targets:
             for sub in ast.walk(tgt):
-                attr = _is_self_attr(sub)
+                attr = self._match(sub)
                 if attr is not None:
                     self.accesses.append(_Access(
                         attr, sub.lineno, func_name, groups, True))
                 elif (isinstance(sub, ast.Subscript)):
-                    base = _is_self_attr(sub.value)
+                    base = self._match(sub.value)
                     if base is not None:
                         self.accesses.append(_Access(
                             base, sub.lineno, func_name, groups, True))
@@ -303,7 +308,7 @@ class _ClassScanner:
             if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
                                 ast.Lambda, ast.ClassDef)):
                 continue
-            attr = _is_self_attr(sub)
+            attr = self._match(sub)
             if attr is None:
                 continue
             is_write = isinstance(getattr(sub, "ctx", None),
@@ -323,12 +328,67 @@ class _Pass:
         raise NotImplementedError
 
 
+class _ModuleScanner(_ClassScanner):
+    """Module-scope twin of :class:`_ClassScanner`: module-level locks
+    (``_gc_lock = threading.Lock()``) guarding module GLOBALS — names a
+    module function declares ``global`` and writes under ``with <lock>:``
+    (the checkpoint-layer observer/GC pattern). Per function, a global
+    shadowed by a plain local assignment (no ``global`` decl) is not
+    tracked there."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.cls = None
+        self.locks = _Union()
+        self.accesses: List[_Access] = []
+        self._tracked: Set[str] = set()
+        for node in tree.body:
+            if (not isinstance(node, ast.Assign)
+                    or not isinstance(node.value, ast.Call)):
+                continue
+            callee = _call_name(node.value)
+            if callee.rsplit(".", 1)[-1] not in _LOCK_FACTORIES:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.locks.add(tgt.id)
+                    for arg in node.value.args:
+                        if isinstance(arg, ast.Name):
+                            self.locks.union(tgt.id, arg.id)
+        funcs = [n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        module_globals: Set[str] = set()
+        for fn in funcs:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    module_globals.update(node.names)
+        for fn in funcs:
+            decls: Set[str] = set()
+            shadowed: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    decls.update(node.names)
+                elif isinstance(node, ast.Name) and isinstance(
+                        node.ctx, (ast.Store, ast.Del)):
+                    shadowed.add(node.id)
+            self._tracked = module_globals - (shadowed - decls)
+            self._scan_func(fn, fn.name, ())
+
+    def _match(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name) and (
+                node.id in self._tracked or self.locks.known(node.id)):
+            return node.id
+        return None
+
+
 class LockDisciplinePass(_Pass):
-    """OPS101: an attribute ever *written* under ``with self.<lock>`` in
-    non-init methods is lock-owned; any later read or write of it outside
-    a holder of that lock (or an alias — ``Condition(self._lock)``) is a
-    race. Helper methods named ``*_locked`` are assumed to run under the
-    lock (the ``_prune_locked`` convention) and are exempt."""
+    """OPS101: state ever *written* under ``with <lock>`` in non-init
+    code is lock-owned; any later read or write of it outside a holder of
+    that lock (or an alias — ``Condition(self._lock)``) is a race. Two
+    scopes share one audit: class attributes guarded by ``self.<lock>``
+    (:class:`_ClassScanner`) and module globals guarded by a module-level
+    lock (:class:`_ModuleScanner` — the checkpoint GC/observer pattern).
+    Helper methods named ``*_locked`` are assumed to run under the lock
+    (the ``_prune_locked`` convention) and are exempt."""
 
     rule_ids = ("OPS101",)
 
@@ -337,43 +397,50 @@ class LockDisciplinePass(_Pass):
         findings: List[Finding] = []
         for cls in [n for n in ast.walk(tree)
                     if isinstance(n, ast.ClassDef)]:
-            scan = _ClassScanner(cls)
-            owner: Dict[str, Optional[str]] = {}
-            for acc in scan.accesses:
-                if not acc.is_write or not acc.groups:
-                    continue
-                if acc.func in _EXEMPT_FUNCS or acc.func.endswith("_locked"):
-                    continue
-                if scan.locks.known(acc.attr):
-                    continue  # the lock itself
-                prev = owner.get(acc.attr, acc.groups[-1])
-                # written under two different locks: ambiguous, skip
-                owner[acc.attr] = (acc.groups[-1]
-                                   if prev == acc.groups[-1] else None)
-            # one finding per (attr, line, method) — an assignment target
-            # is visited both as a target and as an expression, and a
-            # write subsumes the read half of the same access
-            flagged: Dict[Tuple[str, int, str], _Access] = {}
-            for acc in scan.accesses:
-                grp = owner.get(acc.attr)
-                if grp is None:
-                    continue
-                if acc.func in _EXEMPT_FUNCS or acc.func.endswith("_locked"):
-                    continue
-                if grp in acc.groups:
-                    continue
-                key = (acc.attr, acc.line, acc.func)
-                prev = flagged.get(key)
-                if prev is None or (acc.is_write and not prev.is_write):
-                    flagged[key] = acc
-            for acc in flagged.values():
-                findings.append(Finding(
-                    "OPS101", path, acc.line,
-                    "%s.%s is lock-owned (guarded writes exist) but is "
-                    "%s here without holding the lock" % (
-                        cls.name, acc.attr,
-                        "written" if acc.is_write else "read"),
-                    symbol="%s.%s.%s" % (cls.name, acc.func, acc.attr)))
+            findings.extend(self._audit(_ClassScanner(cls), cls.name, path))
+        findings.extend(self._audit(_ModuleScanner(tree), "<module>", path))
+        return findings
+
+    @staticmethod
+    def _audit(scan: _ClassScanner, label: str,
+               path: str) -> List[Finding]:
+        findings: List[Finding] = []
+        owner: Dict[str, Optional[str]] = {}
+        for acc in scan.accesses:
+            if not acc.is_write or not acc.groups:
+                continue
+            if acc.func in _EXEMPT_FUNCS or acc.func.endswith("_locked"):
+                continue
+            if scan.locks.known(acc.attr):
+                continue  # the lock itself
+            prev = owner.get(acc.attr, acc.groups[-1])
+            # written under two different locks: ambiguous, skip
+            owner[acc.attr] = (acc.groups[-1]
+                               if prev == acc.groups[-1] else None)
+        # one finding per (attr, line, method) — an assignment target
+        # is visited both as a target and as an expression, and a
+        # write subsumes the read half of the same access
+        flagged: Dict[Tuple[str, int, str], _Access] = {}
+        for acc in scan.accesses:
+            grp = owner.get(acc.attr)
+            if grp is None:
+                continue
+            if acc.func in _EXEMPT_FUNCS or acc.func.endswith("_locked"):
+                continue
+            if grp in acc.groups:
+                continue
+            key = (acc.attr, acc.line, acc.func)
+            prev = flagged.get(key)
+            if prev is None or (acc.is_write and not prev.is_write):
+                flagged[key] = acc
+        for acc in flagged.values():
+            findings.append(Finding(
+                "OPS101", path, acc.line,
+                "%s.%s is lock-owned (guarded writes exist) but is "
+                "%s here without holding the lock" % (
+                    label, acc.attr,
+                    "written" if acc.is_write else "read"),
+                symbol="%s.%s.%s" % (label, acc.func, acc.attr)))
         return findings
 
 

@@ -21,10 +21,11 @@ from .harness import ChaosHarness, ChaosReport, run_scenario
 from .plan import CONTROL_SCENARIOS, SCENARIOS, ChaosPlan, FaultEvent, \
     build_plan
 from .pod_faults import PodChaos
+from .recovery import run_recovery_scenario
 
 __all__ = [
     "ChaosHarness", "ChaosKubeClient", "ChaosPlan", "ChaosReport",
     "ChaosSourceError", "CONTROL_SCENARIOS", "FaultEvent", "FaultInjector",
     "FaultySource", "PodChaos", "SCENARIOS", "build_plan",
-    "run_loader_scenario", "run_scenario",
+    "run_loader_scenario", "run_recovery_scenario", "run_scenario",
 ]

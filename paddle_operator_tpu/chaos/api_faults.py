@@ -44,7 +44,8 @@ class FaultInjector:
     def kill_count(self) -> int:
         """Total pod kills injected — the budget-consistency bound."""
         return (self.counts.get("pod_preempt", 0)
-                + self.counts.get("pod_oom", 0))
+                + self.counts.get("pod_oom", 0)
+                + self.counts.get("graceful_drain", 0))
 
     def metrics_block(self) -> str:
         """``tpujob_chaos_faults_injected_total`` exposition family, for

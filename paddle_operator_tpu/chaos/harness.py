@@ -46,7 +46,8 @@ from .pod_faults import PodChaos
 class ChaosReport:
     def __init__(self, scenario: str, seed: int, converged: bool, ticks: int,
                  faults: Dict[str, int], jobs: Dict[str, dict],
-                 violations: List[str], wall_s: float):
+                 violations: List[str], wall_s: float,
+                 extra: Optional[dict] = None):
         self.scenario = scenario
         self.seed = seed
         self.converged = converged
@@ -55,11 +56,15 @@ class ChaosReport:
         self.jobs = jobs
         self.violations = violations
         self.wall_s = wall_s
+        # scenario-specific replayable facts (e.g. the graceful_drain
+        # recovery leg's resume step + loss bits) — part of the
+        # determinism fingerprint, not of the job table
+        self.extra = extra or {}
 
     def fingerprint(self) -> dict:
         """Everything that must be identical on a same-seed re-run
         (wall time excluded)."""
-        return {
+        fp = {
             "scenario": self.scenario,
             "seed": self.seed,
             "converged": self.converged,
@@ -68,6 +73,9 @@ class ChaosReport:
             "jobs": self.jobs,
             "violations": list(self.violations),
         }
+        if self.extra:
+            fp["extra"] = self.extra
+        return fp
 
     def summary_line(self) -> str:
         faults = " ".join("%s=%d" % kv for kv in sorted(self.faults.items()))
@@ -76,12 +84,16 @@ class ChaosReport:
                                     st["preemptionRestarts"],
                                     st["appFailureRestarts"])
             for name, st in sorted(self.jobs.items()))
+        extra = ""
+        if self.extra:
+            extra = "  " + " ".join(
+                "%s=%s" % kv for kv in sorted(self.extra.items()))
         return ("[%s seed=%d] %s ticks=%d %.2fs  faults: %s  jobs: %s  "
-                "violations=%d"
+                "violations=%d%s"
                 % (self.scenario, self.seed,
                    "converged" if self.converged else "DID NOT CONVERGE",
                    self.ticks, self.wall_s, faults or "-", jobs or "-",
-                   len(self.violations)))
+                   len(self.violations), extra))
 
 
 class ChaosHarness:
@@ -102,6 +114,10 @@ class ChaosHarness:
         self._rng = random.Random("chaos-run:%s:%d"
                                   % (plan.scenario, plan.seed))
         self._jobs: List[str] = []
+        # operator_crash bookkeeping: restart-budget floors + job set
+        # captured at the instant of the crash — the rebuilt operator must
+        # never lose a job or reset a budget below these
+        self._crash_floor: Dict[str, Dict[str, int]] = {}
         self._create_workload()
 
     # -- workload -------------------------------------------------------
@@ -125,6 +141,18 @@ class ChaosHarness:
             }))
         elif s == "slice_drain_resize":
             self._add_job(api.new_tpujob("drainy", spec={
+                "device": "tpu",
+                "tpu": {"accelerator": "v5e", "topology": "4x8"},
+                "worker": self._role(4), "elastic": 1,
+            }))
+        elif s == "graceful_drain":
+            self._add_job(api.new_tpujob("drainful", spec={
+                "device": "tpu",
+                "tpu": {"accelerator": "v5e", "topology": "4x8"},
+                "worker": self._role(4), "elastic": 1,
+            }))
+        elif s == "operator_crash":
+            self._add_job(api.new_tpujob("crashy", spec={
                 "device": "tpu",
                 "tpu": {"accelerator": "v5e", "topology": "4x8"},
                 "worker": self._role(4), "elastic": 1,
@@ -177,6 +205,21 @@ class ChaosHarness:
                     not in ("Failed", "Succeeded")]
             if pods:
                 self.pod_chaos.drain_slice(pods)
+        elif ev.kind == "graceful_drain":
+            pods = [pod for pod in self._job_pods(p["job"])
+                    if (pod.get("status") or {}).get("phase")
+                    not in ("Failed", "Succeeded")
+                    and not pod["metadata"].get("deletionTimestamp")]
+            if not pods:
+                return
+            grace = int(p.get("grace", 3))
+            if p.get("all"):
+                self.pod_chaos.drain_slice(pods, grace_seconds=grace)
+            else:
+                pod = pods[self._rng.randrange(len(pods))]
+                self.pod_chaos.preempt(pod, grace_seconds=grace)
+        elif ev.kind == "operator_crash":
+            self._crash_operator()
         elif ev.kind == "elastic_resize":
             self.injector.record("elastic_resize")
 
@@ -189,6 +232,29 @@ class ChaosHarness:
                 pass
         else:
             raise ValueError("unknown fault kind %r" % ev.kind)
+
+    def _crash_operator(self) -> None:
+        """Tear the Manager/Reconciler/cache down mid-incident and build a
+        replacement against the surviving FakeKubeClient + KV + kubelet
+        state (OperatorHarness.restart_operator). Budget floors and the
+        live job set are snapshotted first so check_invariants can prove
+        nothing was lost or reset through the restart."""
+        for name in self._jobs:
+            try:
+                job = self.h.get_job(name)
+            except NotFoundError:
+                continue
+            self._crash_floor[name] = {
+                "preemptionRestarts": int(
+                    job.status.get("preemptionRestarts") or 0),
+                "appFailureRestarts": int(
+                    job.status.get("appFailureRestarts") or 0),
+            }
+        self.injector.record("operator_crash")
+        self.h.restart_operator()
+        # the replacement process re-registers its metric providers like
+        # production main() would
+        self.h.manager.add_metrics_provider(self.injector.metrics_block)
 
     # -- the run ----------------------------------------------------------
 
@@ -281,7 +347,20 @@ class ChaosHarness:
             try:
                 job = api.TpuJob(store.get(api.KIND, "default", name))
             except NotFoundError:
+                if name in self._crash_floor:
+                    # nothing in these scenarios deletes jobs: a job that
+                    # existed when the operator crashed MUST still exist
+                    v.append("job %s lost across the operator restart"
+                             % name)
                 continue
+            # restart budgets must ride the STATUS subresource through an
+            # operator restart — a rebuilt process that forgot them would
+            # grant a crashing container unbounded whole-slice restarts
+            for field, floor in (self._crash_floor.get(name) or {}).items():
+                got = int(job.status.get(field) or 0)
+                if got < floor:
+                    v.append("job %s %s reset across operator restart: "
+                             "%d < pre-crash %d" % (name, field, got, floor))
             phase = job.phase
             if phase not in (api.Phase.RUNNING, api.Phase.COMPLETED,
                              api.Phase.FAILED):
@@ -351,4 +430,18 @@ def run_scenario(scenario: str, seed: int, quick: bool = True) -> ChaosReport:
             ticks=summary["batches"], faults=dict(injector.counts),
             jobs={}, violations=violations,
             wall_s=time.perf_counter() - t0)
-    return ChaosHarness(plan).run()
+    harness = ChaosHarness(plan)
+    report = harness.run()
+    if scenario == "graceful_drain":
+        # the training-plane leg: a REAL runner drained mid-run, its
+        # checkpoint sometimes corrupted, resumed — loss must be
+        # bit-identical to the reference replay (see chaos.recovery)
+        from .recovery import run_recovery_scenario
+
+        t0 = time.perf_counter()
+        facts, violations = run_recovery_scenario(plan, harness.injector)
+        report.extra.update(facts)
+        report.violations.extend(violations)
+        report.faults = dict(harness.injector.counts)
+        report.wall_s += time.perf_counter() - t0
+    return report

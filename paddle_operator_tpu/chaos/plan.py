@@ -24,9 +24,20 @@ Fault taxonomy (``FaultEvent.kind``):
 ``slice_drain``           preempt every pod of a job at once (the physical
                           TPU slice goes down for maintenance)
 ``elastic_resize``        mutate worker replicas + topology mid-run
+``graceful_drain``        evict one pod (or the whole slice) WITH a grace
+                          window: Terminating first, exit-137 only when the
+                          grace clock runs out — the drain-notice path
+``operator_crash``        kill the operator process mid-incident and start a
+                          replacement against the surviving cluster state
 ``loader_error``          transient source error inside the input pipeline
 ``loader_stall``          producer-side stall inside the input pipeline
 ========================  ====================================================
+
+``graceful_drain`` runs a second, training-plane leg after the control-plane
+run: a real (tiny) jax training job is drained mid-run via the runner's
+drain hook, its checkpoint optionally corrupted, and resumed — the resumed
+loss must be bit-identical to an unfaulted reference replay from the same
+seed (see :mod:`.recovery`).
 """
 
 from __future__ import annotations
@@ -36,9 +47,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
 #: control-plane scenarios run the operator harness; ``loader_faults`` runs
-#: the data plane only (ShardedLoader + FaultySource).
+#: the data plane only (ShardedLoader + FaultySource); ``graceful_drain``
+#: additionally runs the training-plane recovery leg (chaos.recovery).
 CONTROL_SCENARIOS = (
     "preemption_burst", "apiserver_flake", "slice_drain_resize",
+    "graceful_drain", "operator_crash",
 )
 SCENARIOS = CONTROL_SCENARIOS + ("loader_faults",)
 
@@ -85,6 +98,8 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "preemption_burst": _preemption_burst,
         "apiserver_flake": _apiserver_flake,
         "slice_drain_resize": _slice_drain_resize,
+        "graceful_drain": _graceful_drain,
+        "operator_crash": _operator_crash,
         "loader_faults": _loader_faults,
     }[scenario]
     events, horizon = builder(rng, quick)
@@ -154,6 +169,60 @@ def _slice_drain_resize(rng: random.Random, quick: bool
             rng.randint(drain_at, drain_at + 3), "api_error",
             {"code": 500, "count": rng.randint(1, 2)}))
     return events, 60 if quick else 120
+
+
+def _graceful_drain(rng: random.Random, quick: bool
+                    ) -> Tuple[List[FaultEvent], int]:
+    """Announced maintenance: pods are evicted WITH a grace window —
+    Terminating (drain notice, final checkpoints) before exit-137. Half
+    the runs drain the whole slice at once, the rest pick off single
+    pods; sometimes an apiserver error lands inside the drain window.
+    run_scenario then runs the training-plane recovery leg (drain hook +
+    optional checkpoint corruption + bit-identical resume) from the same
+    seed."""
+    events = []
+    t0 = rng.randint(3, 8)
+    if rng.random() < 0.5:
+        events.append(FaultEvent(t0, "graceful_drain",
+                                 {"job": "drainful", "all": True,
+                                  "grace": rng.randint(2, 4)}))
+    else:
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(rng.randint(3, 10), "graceful_drain",
+                                     {"job": "drainful",
+                                      "grace": rng.randint(2, 4)}))
+    if rng.random() < 0.4:
+        events.append(FaultEvent(
+            t0 + rng.randint(0, 2), "api_error",
+            {"code": rng.choice([409, 500]), "count": rng.randint(1, 2)}))
+    return events, 60 if quick else 120
+
+
+def _operator_crash(rng: random.Random, quick: bool
+                    ) -> Tuple[List[FaultEvent], int]:
+    """The operator process dies MID-INCIDENT: a preemption (sometimes a
+    graceful drain) is still being handled when the manager/reconciler
+    are torn down and rebuilt against the surviving apiserver state. The
+    replacement must converge without duplicating pods, losing the job,
+    or resetting restart budgets; often another kill lands after the
+    restart to prove the rebuilt operator still handles incidents."""
+    events = []
+    k1 = rng.randint(4, 9)
+    events.append(FaultEvent(k1, "pod_preempt", {"job": "crashy"}))
+    if rng.random() < 0.5:
+        events.append(FaultEvent(rng.randint(4, 9), "graceful_drain",
+                                 {"job": "crashy",
+                                  "grace": rng.randint(2, 4)}))
+    crash_at = k1 + rng.randint(0, 2)  # mid-incident, give or take a tick
+    events.append(FaultEvent(crash_at, "operator_crash", {}))
+    if rng.random() < 0.7:
+        events.append(FaultEvent(crash_at + rng.randint(2, 6),
+                                 "pod_preempt", {"job": "crashy"}))
+    if rng.random() < 0.3:
+        events.append(FaultEvent(
+            rng.randint(1, crash_at), "api_error",
+            {"code": rng.choice([500, 503]), "count": rng.randint(1, 2)}))
+    return events, 72 if quick else 144
 
 
 def _loader_faults(rng: random.Random, quick: bool

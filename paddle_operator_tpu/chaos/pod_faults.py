@@ -25,11 +25,16 @@ class PodChaos:
 
     # -- kills ----------------------------------------------------------
 
-    def preempt(self, pod: dict, reason: str = "Terminated") -> None:
-        """TPU maintenance event / spot reclaim on the pod's host."""
+    def preempt(self, pod: dict, reason: str = "Terminated",
+                grace_seconds: int = 0) -> None:
+        """TPU maintenance event / spot reclaim on the pod's host.
+        ``grace_seconds > 0`` models the announced-maintenance variant:
+        the pod turns Terminating first (the runner's drain window) and
+        only exits 137 when the grace clock runs out."""
         name = pod["metadata"]["name"]
-        self.sim.preempt(name, reason=reason)
-        self.injector.record("pod_preempt")
+        self.sim.preempt(name, reason=reason, grace_seconds=grace_seconds)
+        self.injector.record("graceful_drain" if grace_seconds > 0
+                            else "pod_preempt")
         self._pending.add((pod["metadata"].get("namespace", "default"), name))
 
     def oom_kill(self, pod: dict) -> None:
@@ -39,12 +44,14 @@ class PodChaos:
         self.injector.record("pod_oom")
         self._pending.add((pod["metadata"].get("namespace", "default"), name))
 
-    def drain_slice(self, pods: List[dict], reason: str = "Terminated") -> None:
+    def drain_slice(self, pods: List[dict], reason: str = "Terminated",
+                    grace_seconds: int = 0) -> None:
         """The whole physical slice goes down at once: every pod of the job
-        gets the maintenance-event kill in the same tick."""
+        gets the maintenance-event kill in the same tick (gracefully, when
+        the maintenance was announced with a grace window)."""
         self.injector.record("slice_drain")
         for pod in pods:
-            self.preempt(pod, reason=reason)
+            self.preempt(pod, reason=reason, grace_seconds=grace_seconds)
 
     # -- per-tick upkeep -------------------------------------------------
 

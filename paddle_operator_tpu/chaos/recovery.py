@@ -1,0 +1,219 @@
+"""Training-plane recovery leg of the ``graceful_drain`` scenario.
+
+A real (tiny) jax training job runs through the REAL runner machinery —
+:func:`~paddle_operator_tpu.runner.run_training`, the async checkpoint
+writer, the drain monitor — under a seeded incident:
+
+1. **reference**: train ``TOTAL_STEPS`` straight through in a fresh dir;
+2. **faulted**: train with a drain request landing at a seeded step — the
+   runner cuts an immediate checkpoint at the next boundary and exits
+   clean; then (half the seeds) the newest checkpoint is CORRUPTED the way
+   real storage fails (flipped payload bytes, or a torn manifest); then a
+   resumed run restores — falling back past the corrupt step, which gets
+   quarantined — and trains to completion.
+
+The invariant is EasyScale's restart consistency made bit-exact: the
+faulted run's final loss must equal the reference replay's final loss
+bit-for-bit, whatever got drained or corrupted in between. Everything is
+derived from the plan seed, so the leg replays byte-identically and its
+facts (resume step, loss bits, corrupt count) join the chaos fingerprint.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+from typing import Dict, List, Tuple
+
+from .api_faults import FaultInjector
+
+TOTAL_STEPS = 12
+CHECKPOINT_EVERY = 4
+
+
+def tiny_linear_job(checkpoint_dir: str, make_batch, drain_monitor=None,
+                    async_checkpoint: bool = False,
+                    total_steps: int = TOTAL_STEPS,
+                    checkpoint_every: int = CHECKPOINT_EVERY, **kw):
+    """A linear-regression TrainJob small enough to compile in tens of
+    milliseconds but exercising the full runner path (loader, deferred
+    metrics, checkpoint writer, drain monitor). Shared with the tier-1
+    recovery tests so what they exercise cannot drift from what
+    ``make recovery``/``make chaos`` run."""
+    import jax.numpy as jnp
+
+    from ..ops import optim
+    from ..runner import TrainJob
+
+    def init_params(rng):
+        return {"w": jnp.zeros((4,)), "b": jnp.zeros(())}
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    return TrainJob(
+        init_params=init_params,
+        loss_fn=loss_fn,
+        optimizer=optim.sgd(0.05),
+        make_batch=make_batch,
+        total_steps=total_steps,
+        checkpoint_every=checkpoint_every,
+        checkpoint_dir=checkpoint_dir,
+        log_every=0,
+        # prefetch=0 keeps make_batch synchronous with the consuming
+        # step, so a drain armed from inside make_batch fires at a
+        # DETERMINISTIC boundary (a prefetching producer races the loop)
+        prefetch=0,
+        async_checkpoint=async_checkpoint,
+        drain_monitor=drain_monitor,
+        **kw,
+    )
+
+
+def linear_batch_source():
+    import jax
+    import jax.numpy as jnp
+
+    def make_batch(rng, step):
+        x = jax.random.normal(rng, (8, 4))
+        y = x @ jnp.arange(4, dtype=jnp.float32) + 1.0
+        return {"x": x, "y": y}
+
+    return make_batch
+
+
+def flip_leaf_bytes(ckpt_dir: str, step: int) -> None:
+    """Bit rot inside a step's biggest leaf payload: the zip stays valid
+    but the manifest checksums go stale — the per-leaf CRC32 check's
+    canonical case."""
+    import numpy as np
+
+    path = os.path.join(ckpt_dir, "step_%012d" % step, "state.npz")
+    with np.load(path) as npz:
+        arrays = {k: np.array(npz[k]) for k in npz.files}
+    victim = arrays[max(sorted(arrays), key=lambda k: arrays[k].size)]
+    victim.reshape(-1).view(np.uint8)[0] ^= 0xFF
+    np.savez(path, **arrays)
+
+
+def _corrupt_newest(ckpt_dir: str, mode: str) -> int:
+    """Damage the newest checkpoint the way real storage does: flip bytes
+    in a leaf payload, or tear the manifest. Returns the corrupted step."""
+    from ..utils import checkpoint as ckpt
+
+    step = ckpt.latest_step(ckpt_dir)
+    assert step is not None
+    if mode == "torn_manifest":
+        path = os.path.join(ckpt_dir, "step_%012d" % step, "manifest.json")
+        with open(path) as f:
+            text = f.read()
+        with open(path, "w") as f:
+            f.write(text[: len(text) // 2])  # torn mid-write
+    else:
+        flip_leaf_bytes(ckpt_dir, step)
+    return step
+
+
+def run_recovery_scenario(plan, injector: FaultInjector
+                          ) -> Tuple[Dict[str, object], List[str]]:
+    """Run the drain/corrupt/resume incident for ``plan.seed``. Returns
+    (facts-for-the-fingerprint, violations)."""
+    from ..runner import DrainMonitor, run_training
+    from ..launch import LaunchConfig
+    from ..utils import checkpoint as ckpt
+
+    rng = random.Random("chaos-recovery:%d" % plan.seed)
+    drain_at = rng.randint(3, TOTAL_STEPS - 3)
+    corrupt_mode = rng.choice([None, "flip_bytes", "torn_manifest"])
+
+    violations: List[str] = []
+    facts: Dict[str, object] = {"drain_at": drain_at,
+                                "corrupt": corrupt_mode or "none"}
+    cfg = LaunchConfig(worker_id=0, num_workers=1)
+    make_batch = linear_batch_source()
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaos-ref-") as ref_dir, \
+                tempfile.TemporaryDirectory(prefix="chaos-rec-") as rec_dir:
+            ref = run_training(tiny_linear_job(ref_dir, make_batch), cfg=cfg,
+                               init_distributed=False)
+
+            # checkpoint-lifecycle events of the FAULTED runs feed the
+            # shared chaos ledger (the same counts a production runner
+            # feeds JobMetrics via the observer). Installed only now: the
+            # clean reference replay's saves are not incident bookkeeping
+            # and must not read as injected faults.
+            ckpt.set_checkpoint_observer(
+                lambda event, detail: injector.record("ckpt_%s" % event))
+
+            monitor = DrainMonitor()
+
+            def draining_make_batch(batch_rng, step):
+                if step == drain_at:
+                    monitor.request()  # the kubelet's SIGTERM, in effect
+                return make_batch(batch_rng, step)
+
+            # recorded under its own kind: the control-plane
+            # "graceful_drain" kind feeds FaultInjector.kill_count (the
+            # budget-consistency bound) and this training-plane drain
+            # kills no pod
+            injector.record("runner_drain")
+            drained = run_training(
+                tiny_linear_job(rec_dir, draining_make_batch,
+                          drain_monitor=monitor, async_checkpoint=True),
+                cfg=cfg, init_distributed=False)
+            if not drained.get("drained"):
+                violations.append("runner ignored the drain request")
+            drain_step = int(drained.get("drain_step") or 0)
+            facts["drain_step"] = drain_step
+            if drain_step and ckpt.latest_step(rec_dir) != drain_step:
+                violations.append(
+                    "drain did not cut a checkpoint at its exit step %d "
+                    "(latest=%s)" % (drain_step, ckpt.latest_step(rec_dir)))
+
+            expect_resume = ckpt.latest_step(rec_dir)
+            if corrupt_mode is not None:
+                valid = ckpt.all_steps(rec_dir)
+                corrupted = _corrupt_newest(rec_dir, corrupt_mode)
+                facts["corrupt_step"] = corrupted
+                # the newest SURVIVING step is where resume must land
+                expect_resume = max(
+                    [s for s in valid if s != corrupted], default=None)
+
+            resumed = run_training(tiny_linear_job(rec_dir, make_batch), cfg=cfg,
+                                   init_distributed=False)
+            resume_steps = resumed.get("resume_steps") or []
+            facts["resume_step"] = resume_steps[0] if resume_steps else None
+            if expect_resume is None:
+                if resume_steps:
+                    violations.append(
+                        "resumed from %s with no valid step expected"
+                        % resume_steps)
+            elif facts["resume_step"] != expect_resume:
+                violations.append(
+                    "resumed from step %s, expected newest valid step %s"
+                    % (facts["resume_step"], expect_resume))
+            if corrupt_mode is not None:
+                corpses = [n for n in os.listdir(rec_dir)
+                           if ".corrupt" in n]
+                if not corpses:
+                    violations.append(
+                        "corrupt step %s was not quarantined"
+                        % facts.get("corrupt_step"))
+
+            # the headline invariant: restart consistency, bit-exact
+            ref_loss, rec_loss = float(ref["loss"]), float(resumed["loss"])
+            facts["loss"] = float.hex(rec_loss)
+            if float.hex(ref_loss) != float.hex(rec_loss):
+                violations.append(
+                    "resumed loss %s != reference replay %s (restart "
+                    "consistency broken)"
+                    % (float.hex(rec_loss), float.hex(ref_loss)))
+            if int(resumed.get("steps") or 0) != TOTAL_STEPS:
+                violations.append("resumed run stopped at step %s"
+                                  % resumed.get("steps"))
+    finally:
+        ckpt.set_checkpoint_observer(None)
+    return facts, violations

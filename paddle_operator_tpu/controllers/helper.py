@@ -167,6 +167,12 @@ def is_all_coord_containers_running(child_pods: List[dict]) -> bool:
 MAX_PREEMPTION_RESTARTS = 10
 ANNOT_MAX_RESTARTS = "batch.tpujob.dev/max-preemption-restarts"
 
+# Pod annotation the reconciler stamps on a Terminating pod once its
+# graceful-drain incident has been handled (epoch bumped + restart
+# counted): the dedup must survive an operator restart mid-drain, so it
+# lives on the object, not in reconciler memory.
+ANNOT_DRAIN_ACK = "batch.tpujob.dev/drain-acked"
+
 
 def preemption_budget(job: api.TpuJob) -> int:
     ann = (job.metadata.get("annotations") or {}).get(ANNOT_MAX_RESTARTS)

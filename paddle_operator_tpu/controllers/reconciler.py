@@ -213,6 +213,12 @@ class TpuJobReconciler:
         for pod in child_pods:
             res_type, idx = helper.extract_name_index(pod["metadata"]["name"])
             if specs.get(res_type) is not None and idx >= specs[res_type]["replicas"]:
+                # stamp the drain ack BEFORE deleting: on a real apiserver
+                # the pod lingers Terminating through its grace period, and
+                # if replicas rise again meanwhile the index filter in
+                # _graceful_drain no longer excludes it — the controller's
+                # own delete must never read as a preemption drain
+                self._ack_drain(pod)
                 self._delete_resource(job, pod)
                 return Result(requeue=True)
 
@@ -337,6 +343,9 @@ class TpuJobReconciler:
         but slower; the epoch bump is the fast path for the
         kubelet-reported failure this branch handles.
         """
+        gate = self._graceful_drain(job, child_pods)
+        if gate is not None:
+            return gate
         failed = [p for p in child_pods if k8s.pod_phase(p) == "Failed"]
         if not failed:
             return None
@@ -378,11 +387,32 @@ class TpuJobReconciler:
         field = "appFailureRestarts" if incident_app else "preemptionRestarts"
         budget = (helper.app_failure_budget(job) if incident_app
                   else helper.preemption_budget(job))
-        # Bounded retry with a fresh GET per attempt: a lost increment
-        # under persistent status-update conflicts would let a
-        # deterministically-crashing container restart the slice past the
-        # intended budget (every pass re-reading the stale persisted
-        # count) — the budget must count durably, not best-effort.
+        self._count_restart_durably(job, field)
+        # cause-split restart counter: preemption vs app-OOM vs app-error
+        # (the same evidence the budget split keys on, one level finer)
+        self.obs.observe_restart(job.namespace, job.name,
+                                 incident_cause(fresh))
+        self.recorder.event(
+            job.obj, "Warning", "PreemptionRestart",
+            "%d pod(s) failed (%s, %s); deleted for recreate%s (%s %d/%d)"
+            % (len(fresh),
+               ", ".join(p["metadata"]["name"] for p in fresh),
+               "app crash" if incident_app else "preemption/eviction",
+               "; membership epoch bumped to %s for whole-slice restart "
+               "from checkpoint" % epoch if epoch else "",
+               field, int(job.status[field]), budget))
+        return Result(requeue=True)
+
+    def _count_restart_durably(self, job: api.TpuJob, field: str) -> None:
+        """Increment a restart counter with bounded retry and a fresh GET
+        per attempt: a lost increment under persistent status-update
+        conflicts would let a deterministically-crashing container restart
+        the slice past the intended budget (every pass re-reading the
+        stale persisted count) — the budget must count durably, not
+        best-effort. The fresh GET also carries over whatever the OTHER
+        counter says in the live status, so a preemption incident racing
+        an app-failure incident through a 409 retry can never wipe the
+        sibling's count."""
         persisted = False
         for _attempt in range(4):
             try:
@@ -400,23 +430,107 @@ class TpuJobReconciler:
         if not persisted:
             # still conflicting after retries: count in-memory so THIS
             # pass's event/budget math is right, and requeue — the next
-            # pass re-reads the persisted value and the epoch-bump dedup
-            # (pods already deleting) prevents a double restart
+            # pass re-reads the persisted value and the incident dedup
+            # (pods already deleting / drain-acked) prevents a double
+            # restart
             job.status[field] = int(job.status.get(field) or 0) + 1
-        # cause-split restart counter: preemption vs app-OOM vs app-error
-        # (the same evidence the budget split keys on, one level finer)
-        self.obs.observe_restart(job.namespace, job.name,
-                                 incident_cause(fresh))
+
+    def _graceful_drain(self, job: api.TpuJob,
+                        child_pods: List[dict]) -> Optional[Result]:
+        """Graceful-preemption drain notice: pods turned Terminating with
+        a grace window (eviction API / announced TPU maintenance — the
+        kubelet has delivered SIGTERM and the runner's drain hook is
+        cutting a final checkpoint). Handle the incident NOW, while the
+        pods are still draining: bump the membership epoch so every
+        surviving worker also checkpoints and exits at its next step
+        boundary, and count one preemption restart — the drained slice
+        then restores from its final step instead of losing up to
+        checkpoint_every steps.
+
+        Dedup is durable: handled pods are stamped with
+        helper.ANNOT_DRAIN_ACK, so neither later passes nor a restarted
+        operator re-bump the epoch for the same incident. Pods Terminating
+        because of a scale-down (index >= replicas) or because the
+        clean-pod policy is tearing down a TERMINAL job are the
+        controller's own doing and never count as drains."""
+        if job.phase in (api.Phase.COMPLETED, api.Phase.FAILED):
+            # _clean_one's deletions on a finished job linger Terminating
+            # on a real apiserver — they are cleanup, not preemption
+            return None
+        specs = job.get_specs()
+
+        def is_drain(pod: dict) -> bool:
+            meta = pod["metadata"]
+            if not meta.get("deletionTimestamp"):
+                return False
+            if k8s.pod_phase(pod) not in ("Pending", "Running"):
+                return False
+            res_type, idx = helper.extract_name_index(meta["name"])
+            spec = specs.get(res_type)
+            # a role absent from the spec (removed/renamed) is controller
+            # cleanup, the same class as an index beyond replicas
+            return spec is not None and idx < spec["replicas"]
+
+        fresh = [p for p in child_pods if is_drain(p)
+                 and helper.ANNOT_DRAIN_ACK
+                 not in (p["metadata"].get("annotations") or {})]
+        if not fresh:
+            return None
+        if helper.restart_budget_exhausted(job):
+            return None
+        # Bump BEFORE acking (mirror of the hard-preemption ordering): an
+        # acked-but-unbumped incident could never retry its restart
+        # signal, silently losing the survivors' checkpoint cue.
+        epoch = None
+        if self.kv is not None:
+            try:
+                epoch = bump_epoch(self.kv, job)
+            except Exception as e:  # store unreachable — surface and retry
+                log.error("elastic epoch bump failed: %s", e)
+                return self._requeue_error((job.namespace, job.name))
+        if not all(self._ack_drain(pod) for pod in fresh):
+            # an ack that would not persist means the NEXT pass sees the
+            # incident as fresh again: don't count yet, or the retry
+            # would double-spend the budget — the epoch re-bump on that
+            # retry is harmless (workers restart once per poll, however
+            # many bumps landed in between)
+            return self._requeue_error((job.namespace, job.name))
+        self._count_restart_durably(job, "preemptionRestarts")
+        self.obs.observe_drain(job.namespace, job.name, pods=len(fresh))
+        self.obs.observe_restart(job.namespace, job.name, "preemption")
         self.recorder.event(
-            job.obj, "Warning", "PreemptionRestart",
-            "%d pod(s) failed (%s, %s); deleted for recreate%s (%s %d/%d)"
+            job.obj, "Normal", "GracefulDrain",
+            "%d pod(s) draining with grace (%s)%s; final checkpoints cut "
+            "at the next step boundary (preemptionRestarts %d/%d)"
             % (len(fresh),
                ", ".join(p["metadata"]["name"] for p in fresh),
-               "app crash" if incident_app else "preemption/eviction",
-               "; membership epoch bumped to %s for whole-slice restart "
-               "from checkpoint" % epoch if epoch else "",
-               field, int(job.status[field]), budget))
+               "; membership epoch bumped to %s" % epoch if epoch else "",
+               int(job.status.get("preemptionRestarts") or 0),
+               helper.preemption_budget(job)))
         return Result(requeue=True)
+
+    def _ack_drain(self, pod: dict) -> bool:
+        """Stamp ANNOT_DRAIN_ACK on a draining pod (bounded conflict
+        retry, fresh GET per attempt; a vanished pod needs no ack).
+        False when the ack could not be persisted — the caller must not
+        count the incident yet, or the next pass (which will see the
+        pod as fresh again) would double-spend the budget."""
+        ns = pod["metadata"].get("namespace", "default")
+        name = pod["metadata"]["name"]
+        for _attempt in range(4):
+            try:
+                cur = self.client.get("Pod", ns, name)
+                annots = cur["metadata"].setdefault("annotations", {})
+                if annots.get(helper.ANNOT_DRAIN_ACK):
+                    return True
+                annots[helper.ANNOT_DRAIN_ACK] = "true"
+                self.client.update(cur)
+                return True
+            except ConflictError:
+                continue
+            except NotFoundError:
+                return True
+        return False
 
     def _sync_current_status(self, job: api.TpuJob, child_pods: List[dict]) -> None:
         """reference: syncCurrentStatus (paddlejob_controller.go:335-381)."""

@@ -74,6 +74,13 @@ class FakeKubeClient(KubeClient):
         with self._lock:
             self._watchers.append((kind, namespace, callback))
 
+    def clear_watch_callbacks(self) -> None:
+        """Drop every push-watch subscriber at once — the fake side of all
+        watch connections dying with a crashed operator process (the
+        restart_operator model in testing.OperatorHarness)."""
+        with self._lock:
+            self._watchers.clear()
+
     # -- watch fault injection (chaos harness) -----------------------------
 
     def suspend_watch(self, kind: Optional[str] = None) -> None:

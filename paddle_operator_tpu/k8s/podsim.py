@@ -23,6 +23,7 @@ from typing import Dict, Optional
 
 from .errors import ConflictError, NotFoundError
 from .fake import FakeKubeClient
+from .objects import now_iso
 
 
 class PodSimulator:
@@ -44,6 +45,11 @@ class PodSimulator:
         self._desired: Dict[str, str] = {}    # pod name -> Succeeded/Failed
         self._fail_reasons: Dict[str, str] = {}  # pod name -> status.reason
         self._oom: set = set()  # pods whose container dies OOMKilled
+        # graceful-drain state: pod name -> [remaining grace ticks, reason].
+        # remaining == _DRAIN_DONE means the terminal Failed status has
+        # been written and the object is removed on the next step (the
+        # kubelet finishing an eviction-with-grace).
+        self._draining: Dict[str, list] = {}
         self._ip_seq = 0
         if isinstance(client, FakeKubeClient):
             client.exec_handler = self._handle_exec
@@ -70,12 +76,60 @@ class PodSimulator:
         if reason:
             self._fail_reasons[pod_name] = reason
 
-    def preempt(self, pod_name: str, reason: str = "Terminated") -> None:
-        """TPU maintenance-event / spot-preemption kill: the node manager
-        SIGKILLs the pod and the kubelet records an eviction-family
+    def preempt(self, pod_name: str, reason: str = "Terminated",
+                grace_seconds: int = 0) -> None:
+        """TPU maintenance-event / spot-preemption kill.
+
+        ``grace_seconds == 0`` (default): the node manager SIGKILLs the
+        pod instantly and the kubelet records an eviction-family
         status.reason — classify_pod_failure must answer "preemption",
-        never "app", so the incident spends the (large) preemption budget."""
-        self.finish(pod_name, succeeded=False, reason=reason)
+        never "app", so the incident spends the (large) preemption budget.
+
+        ``grace_seconds > 0``: the eviction-with-grace model real spot
+        reclaim uses — the pod turns Terminating immediately
+        (deletionTimestamp set, containers still Running; the kubelet has
+        delivered SIGTERM), survives ``grace_seconds`` lifecycle steps
+        (the sim's clock: one step = one "second"), then exits 137 with
+        the eviction reason and the object is removed. The drain window
+        is when a well-behaved runner cuts its final checkpoint
+        (TrainJob.drain_file / SIGTERM hook) and the operator emits its
+        drain notice."""
+        if grace_seconds > 0:
+            self._begin_drain(pod_name, reason, int(grace_seconds))
+        else:
+            self.finish(pod_name, succeeded=False, reason=reason)
+
+    #: finalizer pinning a draining pod: the fake apiserver removes any
+    #: finalizer-less object the instant a deletionTimestamp lands, so the
+    #: kubelet's grace window is modeled as "kubelet holds a finalizer
+    #: until the containers are down" (what real pod lifecycle amounts to)
+    DRAIN_FINALIZER = "podsim.tpujob.dev/draining"
+    _DRAIN_DONE = -1
+
+    def _begin_drain(self, pod_name: str, reason: str, grace: int) -> None:
+        if pod_name in self._draining:
+            return  # one eviction per pod; the first grace clock rules
+        self._draining[pod_name] = [grace, reason]
+        for pod in self._all("Pod"):
+            if pod["metadata"]["name"] == pod_name:
+                self._mark_terminating(pod)
+                break
+
+    def _mark_terminating(self, pod: dict) -> None:
+        """Stamp the Terminating state (drain finalizer + deletionTimestamp)
+        on a pod; a lost write race is retried from _step_drain while the
+        grace clock runs."""
+        meta = pod["metadata"]
+        if meta.get("deletionTimestamp"):
+            return
+        fins = meta.setdefault("finalizers", [])
+        if self.DRAIN_FINALIZER not in fins:
+            fins.append(self.DRAIN_FINALIZER)
+        meta["deletionTimestamp"] = now_iso()
+        try:
+            self.client.update(pod)
+        except (NotFoundError, ConflictError):
+            pass  # _step_drain re-attempts on the next tick
 
     def oom_kill(self, pod_name: str) -> None:
         """Container killed by the kernel OOM killer: exit 137 like an
@@ -88,7 +142,10 @@ class PodSimulator:
     def clear(self, pod_name: str) -> None:
         """Forget a `finish` request: a RECREATED pod with the same name is
         driven back up instead of being re-killed — one `finish` + `clear`
-        models a single preemption event against a healthy replacement."""
+        models a single preemption event against a healthy replacement.
+        A drain in progress is NOT cleared: the eviction must still run to
+        completion (terminal status + object removal) or the Terminating
+        object would wedge forever."""
         self._desired.pop(pod_name, None)
         self._fail_reasons.pop(pod_name, None)
         self._oom.discard(pod_name)
@@ -129,9 +186,15 @@ class PodSimulator:
                     except (NotFoundError, ConflictError):
                         continue  # deleted/written concurrently; next step
                     changed = True
+        live = set()
         for pod in self._all("Pod"):
+            live.add(pod["metadata"]["name"])
             if self._step_pod(pod):
                 changed = True
+        # drain clocks for pods deleted out from under the eviction
+        # (cascade GC when the job went away): drop the stale entries
+        for name in [n for n in self._draining if n not in live]:
+            del self._draining[name]
         return changed
 
     def _step_pod(self, pod: dict) -> bool:
@@ -140,6 +203,10 @@ class PodSimulator:
         status = pod.get("status") or {}
         phase = status.get("phase", "")
         desired = self._desired.get(name)
+
+        drain = self._draining.get(name)
+        if drain is not None:
+            return self._step_drain(pod, ns, name, phase, drain)
 
         if phase in ("Succeeded", "Failed"):
             return False
@@ -240,6 +307,51 @@ class PodSimulator:
             return True
 
         return False
+
+    def _step_drain(self, pod: dict, ns: str, name: str, phase: str,
+                    drain: list) -> bool:
+        """One tick of an eviction-with-grace: countdown → terminal Failed
+        (exit 137 + eviction reason) → finalizer release, which completes
+        the delete and removes the object."""
+        remaining, reason = drain
+        if remaining == self._DRAIN_DONE or phase in ("Succeeded", "Failed"):
+            # terminal status visible: the kubelet is done — release the
+            # drain finalizer so the pending delete completes
+            del self._draining[name]
+            try:
+                cur = self.client.get("Pod", ns, name)
+            except NotFoundError:
+                return True
+            fins = [f for f in cur["metadata"].get("finalizers", [])
+                    if f != self.DRAIN_FINALIZER]
+            cur["metadata"]["finalizers"] = fins
+            try:
+                self.client.update(cur)
+            except (NotFoundError, ConflictError):
+                self._draining[name] = [self._DRAIN_DONE, reason]  # retry
+            return True
+        if remaining > 0:
+            # the grace window: Terminating, containers still Running —
+            # counting down is progress (a run must not quiesce mid-drain).
+            # A Terminating write lost to a conflict at drain start is
+            # re-attempted here, so the pod never fails hard without its
+            # observable drain window.
+            if not pod["metadata"].get("deletionTimestamp"):
+                self._mark_terminating(pod)
+            drain[0] = remaining - 1
+            return True
+        # grace expired: SIGKILL with the eviction signature
+        new_status = dict(pod.get("status") or {})
+        new_status["phase"] = "Failed"
+        new_status["reason"] = reason
+        new_status["containerStatuses"] = [
+            {"name": c.get("name", "main"), "ready": False,
+             "state": {"terminated": {"exitCode": 137}}}
+            for c in pod["spec"].get("containers", [])
+        ]
+        self._write(ns, name, new_status)
+        drain[0] = self._DRAIN_DONE
+        return True
 
     def _config_env_ready(self, pod: dict) -> bool:
         """The ConfigMap barrier: envFrom references must all resolve."""

@@ -142,6 +142,12 @@ class JobMetrics:
         self._resizes: Dict[str, int] = {}
         self._barrier_wait: Dict[str, float] = {}
         self._releases: Dict[str, int] = {}
+        # durable-recovery plane (PR 5): graceful-drain notices, and the
+        # checkpoint lifecycle fed through wire_checkpoint_observer
+        self._drains: Dict[str, int] = {}
+        self._ckpt_saves: Dict[str, int] = {}
+        self._ckpt_corrupt: Dict[str, int] = {}
+        self._ckpt_restore_step: Dict[str, int] = {}
         self.flight = FlightRecorder(depth=recorder_depth, wall=wall)
 
     # -- feeding hooks (reconciler / coordination server) ----------------
@@ -197,6 +203,39 @@ class JobMetrics:
         tracer().event("coordination_release", job=key, pod=pod,
                        waited_s=round(waited_s, 6))
 
+    def observe_drain(self, namespace: str, name: str, pods: int = 1) -> None:
+        """A graceful-preemption drain notice: the reconciler saw pods turn
+        Terminating with a grace window and told the slice to cut final
+        checkpoints (epoch bump) instead of dying mid-step."""
+        key = job_key(namespace, name)
+        with self._lock:
+            self._drains[key] = self._drains.get(key, 0) + 1
+        self.flight.record(namespace, name, "drain", pods=pods)
+        tracer().event("drain_notice", job=key, pods=pods)
+
+    def observe_checkpoint_save(self, namespace: str, name: str,
+                                step: int) -> None:
+        key = job_key(namespace, name)
+        with self._lock:
+            self._ckpt_saves[key] = self._ckpt_saves.get(key, 0) + 1
+        self.flight.record(namespace, name, "checkpoint_save", step=step)
+
+    def observe_checkpoint_corrupt(self, namespace: str, name: str,
+                                   step: int) -> None:
+        """A checkpoint step failed validation at restore time and was
+        quarantined — resume fell back to the previous valid step."""
+        key = job_key(namespace, name)
+        with self._lock:
+            self._ckpt_corrupt[key] = self._ckpt_corrupt.get(key, 0) + 1
+        self.flight.record(namespace, name, "checkpoint_corrupt", step=step)
+
+    def observe_checkpoint_restore(self, namespace: str, name: str,
+                                   step: int) -> None:
+        key = job_key(namespace, name)
+        with self._lock:
+            self._ckpt_restore_step[key] = int(step)
+        self.flight.record(namespace, name, "checkpoint_restore", step=step)
+
     def record_event(self, namespace: str, name: str, etype: str,
                      reason: str, message: str) -> None:
         key = job_key(namespace, name)
@@ -214,6 +253,10 @@ class JobMetrics:
             self._resizes.pop(key, None)
             self._barrier_wait.pop(key, None)
             self._releases.pop(key, None)
+            self._drains.pop(key, None)
+            self._ckpt_saves.pop(key, None)
+            self._ckpt_corrupt.pop(key, None)
+            self._ckpt_restore_step.pop(key, None)
             for k in [k for k in self._restarts if k[0] == key]:
                 del self._restarts[k]
         self.flight.forget(namespace, name)
@@ -244,6 +287,10 @@ class JobMetrics:
             resizes = dict(self._resizes)
             barrier = dict(self._barrier_wait)
             releases = dict(self._releases)
+            drains = dict(self._drains)
+            ckpt_saves = dict(self._ckpt_saves)
+            ckpt_corrupt = dict(self._ckpt_corrupt)
+            ckpt_restore = dict(self._ckpt_restore_step)
         lines: List[str] = []
         if phases:
             lines.append("# HELP tpujob_job_phase Job phase state set "
@@ -305,7 +352,60 @@ class JobMetrics:
                 lines.append(
                     'tpujob_coordination_barrier_wait_seconds_total'
                     '{job="%s"} %.6f' % (esc(key), barrier.get(key, 0.0)))
+        if drains:
+            lines.append("# HELP tpujob_drain_notices_total Graceful-"
+                         "preemption drain notices emitted (pods turned "
+                         "Terminating with a grace window).")
+            lines.append("# TYPE tpujob_drain_notices_total counter")
+            for key in sorted(drains):
+                lines.append('tpujob_drain_notices_total{job="%s"} %d'
+                             % (esc(key), drains[key]))
+        if ckpt_saves:
+            lines.append("# HELP tpujob_checkpoint_saves_total Committed "
+                         "checkpoint saves observed.")
+            lines.append("# TYPE tpujob_checkpoint_saves_total counter")
+            for key in sorted(ckpt_saves):
+                lines.append('tpujob_checkpoint_saves_total{job="%s"} %d'
+                             % (esc(key), ckpt_saves[key]))
+        if ckpt_corrupt:
+            lines.append("# HELP tpujob_checkpoint_corrupt_skipped_total "
+                         "Checkpoint steps that failed validation at "
+                         "restore time and were quarantined.")
+            lines.append("# TYPE tpujob_checkpoint_corrupt_skipped_total "
+                         "counter")
+            for key in sorted(ckpt_corrupt):
+                lines.append(
+                    'tpujob_checkpoint_corrupt_skipped_total{job="%s"} %d'
+                    % (esc(key), ckpt_corrupt[key]))
+        if ckpt_restore:
+            lines.append("# HELP tpujob_checkpoint_restore_step Step the "
+                         "job last restored from.")
+            lines.append("# TYPE tpujob_checkpoint_restore_step gauge")
+            for key in sorted(ckpt_restore):
+                lines.append('tpujob_checkpoint_restore_step{job="%s"} %d'
+                             % (esc(key), ckpt_restore[key]))
         return "\n".join(lines)
+
+
+def wire_checkpoint_observer(job_metrics: "JobMetrics", namespace: str,
+                             name: str) -> Callable[[str, dict], None]:
+    """Bridge the checkpoint layer's process-wide recovery events
+    (:func:`~.utils.checkpoint.set_checkpoint_observer`) into one job's
+    :class:`JobMetrics` series — how an embedding runner (or the chaos
+    harness) attributes worker-side saves/corrupt-skips/restores to the
+    job the operator knows. Returns the observer fn; install it with
+    ``set_checkpoint_observer`` and uninstall with ``None`` when done."""
+
+    def observer(event: str, detail: dict) -> None:
+        step = int(detail.get("step") or 0)
+        if event == "save":
+            job_metrics.observe_checkpoint_save(namespace, name, step)
+        elif event == "corrupt_skipped":
+            job_metrics.observe_checkpoint_corrupt(namespace, name, step)
+        elif event == "restore":
+            job_metrics.observe_checkpoint_restore(namespace, name, step)
+
+    return observer
 
 
 def format_float(v: float) -> str:

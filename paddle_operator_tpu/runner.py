@@ -13,9 +13,10 @@ import inspect
 import logging
 import math
 import os
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -26,12 +27,70 @@ from .ops.optim import Optimizer
 from .parallel import batch_shardings, build_train_step, make_mesh
 from .parallel.sharding import Rules
 from .utils.checkpoint import (
-    AsyncCheckpointer, latest_step, read_manifest, restore_checkpoint,
-    restore_checkpoint_sharded, save_checkpoint, save_checkpoint_sharded,
+    AsyncCheckpointer, restore_latest, save_checkpoint,
+    save_checkpoint_sharded,
 )
 from .utils.trace import StageTimes, profile_steps, tracer
 
 log = logging.getLogger("tpujob.runner")
+
+# boundary-poll outcomes (broadcast as ints on multi-host: the decision
+# must be identical on every process at the same step)
+_POLL_NONE, _POLL_RESTART, _POLL_DRAIN = 0, 1, 2
+
+
+class DrainMonitor:
+    """Watches for a graceful-preemption drain request.
+
+    Three channels, any of which arms it: a drain file appearing
+    (``TrainJob.drain_file`` / ``TPUJOB_DRAIN_FILE`` — what a preStop hook
+    or node agent touches), a POSIX signal (``TrainJob.drain_signals``,
+    typically SIGTERM — what the kubelet sends when the pod turns
+    Terminating), or a programmatic :meth:`request` (tests, embedding
+    runners). The training loop polls :meth:`requested` at every step
+    boundary; on drain it cuts an immediate checkpoint and exits clean —
+    losing zero steps instead of up to ``checkpoint_every``.
+    """
+
+    def __init__(self, drain_file: str = "", signals: Tuple = ()):
+        self._file = drain_file
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._installed: list = []
+
+    def request(self) -> None:
+        self._event.set()
+
+    def requested(self) -> bool:
+        return self._event.is_set() or bool(
+            self._file and os.path.exists(self._file))
+
+    def install(self) -> "DrainMonitor":
+        """Install signal handlers (main thread only — CPython restricts
+        signal.signal to it; off-main callers keep file/event channels)."""
+        if not self._signals:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            log.warning("drain signals ignored: run_training is not on "
+                        "the main thread")
+            return self
+        import signal as _signal
+
+        for sig in self._signals:
+            prev = _signal.signal(
+                sig, lambda signum, frame: self._event.set())
+            self._installed.append((sig, prev))
+        return self
+
+    def uninstall(self) -> None:
+        import signal as _signal
+
+        while self._installed:
+            sig, prev = self._installed.pop()
+            try:
+                _signal.signal(sig, prev)
+            except (ValueError, TypeError):  # interpreter shutting down
+                pass
 
 
 def _cycle_mesh(axes, elastic=False):
@@ -105,6 +164,19 @@ class TrainJob:
     # disabled unless TPUJOB_WORKER_METRICS_PORT is set; 0 = any free
     # port (the bound URL lands in result["worker_metrics_url"])
     metrics_port: Optional[int] = None
+    # graceful-preemption drain: when this file appears (or a
+    # drain_signals signal lands), the loop cuts an immediate checkpoint
+    # at the next step boundary and returns clean with
+    # result["drained"]=True — the runner half of the operator's
+    # Terminating-pod drain notice. "" falls back to $TPUJOB_DRAIN_FILE.
+    drain_file: str = ""
+    # e.g. (signal.SIGTERM,): installed for the duration of the run
+    # (main thread only); the kubelet's Terminating SIGTERM becomes a
+    # drain request instead of an abrupt death
+    drain_signals: Tuple = ()
+    # programmatic drain channel (tests / embedding runners call
+    # monitor.request()); built automatically when None
+    drain_monitor: Optional[DrainMonitor] = None
     seed: int = 0
 
 
@@ -121,6 +193,13 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
 
     result: Dict[str, Any] = {"cycles": 0}
     ckpt_writer = AsyncCheckpointer() if job.async_checkpoint else None
+
+    # -- graceful-preemption drain --------------------------------------
+    drain = job.drain_monitor
+    if drain is None:
+        drain_file = job.drain_file or os.environ.get(
+            "TPUJOB_DRAIN_FILE", "")
+        drain = DrainMonitor(drain_file, job.drain_signals)
 
     # -- worker-side observability --------------------------------------
     metrics_srv = None
@@ -182,28 +261,44 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         if ckpt_writer is not None:
             ckpt_writer.wait()
 
-    def agreed_stop(should_stop: Callable[[], bool]) -> Callable[[], bool]:
-        """Multi-host: the stop decision must be identical on every process
-        at the same step — a divergent view deadlocks (one process enters the
-        checkpoint barrier while another enters the next step's collectives).
-        Process 0's poll is broadcast; all processes call this every step, so
-        the broadcast itself is an aligned collective."""
+    def boundary_poll(should_stop: Callable[[], bool]) -> Callable[[], int]:
+        """One per-boundary decision combining the elastic stop poll and
+        the drain monitor: _POLL_DRAIN wins (the pod is going away — cut
+        the final checkpoint and exit clean), then _POLL_RESTART.
+
+        Multi-host: the decision must be identical on every process at
+        the same step — a divergent view deadlocks (one process enters
+        the checkpoint barrier while another enters the next step's
+        collectives). The elastic stop poll is KV-backed and identical
+        everywhere, so only process 0 pays it; drain signals, however,
+        are inherently PER-HOST (the kubelet SIGTERMs one pod, the drain
+        file appears on one node) — every process contributes its own
+        monitor and the max is allgathered, so a drain landing anywhere
+        in the slice drains everyone. All processes call this every
+        step, so the gather itself is an aligned collective."""
+
+        def poll() -> int:
+            if drain.requested():
+                return _POLL_DRAIN
+            return _POLL_RESTART if should_stop() else _POLL_NONE
+
         if jax.process_count() == 1:
-            return should_stop
+            return poll
 
         from jax.experimental import multihost_utils
 
-        def agreed() -> bool:  # covered by tests/test_multihost_ckpt.py
+        def agreed() -> int:  # covered by tests/test_multihost_ckpt.py
             # (2 real processes), which pytest-cov cannot see
-            local = should_stop() if jax.process_index() == 0 else False
-            return bool(multihost_utils.broadcast_one_to_all(
-                np.asarray(local)))
+            local = poll() if jax.process_index() == 0 else (
+                _POLL_DRAIN if drain.requested() else _POLL_NONE)
+            return int(np.max(multihost_utils.process_allgather(
+                np.asarray(local))))
 
         return agreed
 
     def train_cycle(world: int, epoch: int, should_stop: Callable[[], bool]) -> bool:
         cycle_t0 = time.perf_counter()
-        should_stop = agreed_stop(should_stop)
+        poll_boundary = boundary_poll(should_stop)
         axes = job.mesh_axes(world) if callable(job.mesh_axes) else job.mesh_axes
         mesh = _cycle_mesh(axes, elastic=callable(job.mesh_axes)) if (
             axes or len(jax.devices()) > 1
@@ -249,19 +344,24 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             return fn
 
         start_step = 0
-        # resolve the step ONCE: a checkpoint published between two
-        # latest_step() calls must not mix one step's manifest with another's
-        resume_step = (latest_step(job.checkpoint_dir)
-                       if job.checkpoint_dir else None)
-        if resume_step is not None:
-            if read_manifest(job.checkpoint_dir,
-                             resume_step).get("format") == "sharded":
-                # shard-wise: each process reads only its devices' blocks
-                state, manifest = restore_checkpoint_sharded(
-                    job.checkpoint_dir, state, step=resume_step)
+        # crash-safe resume: restore_latest walks newest -> oldest,
+        # verifying checksums and quarantining torn/corrupt steps, so one
+        # bad write costs checkpoint_every steps, never the whole run. It
+        # also resolves each step's manifest + data together — a
+        # checkpoint published mid-restore can't mix two steps' files.
+        manifest = None
+        if job.checkpoint_dir:
+            try:
+                # sharded manifests restore shard-wise into the live
+                # state's shardings (each process reads only its blocks)
+                restored, manifest = restore_latest(
+                    job.checkpoint_dir, target_state=state)
+            except FileNotFoundError:
+                manifest = None  # fresh run (or nothing valid survived)
+        if manifest is not None:
+            if manifest.get("format") == "sharded":
+                state = restored  # already placed onto the live mesh
             else:
-                restored, manifest = restore_checkpoint(
-                    job.checkpoint_dir, step=resume_step)
                 state = jax.device_put(
                     restored,
                     jax.tree_util.tree_map(lambda leaf: leaf.sharding, state),
@@ -270,6 +370,11 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             result.setdefault("resume_steps", []).append(start_step)
             log.info("restored checkpoint step=%d (epoch %s)",
                      start_step, manifest["meta"].get("epoch"))
+        if ckpt_writer is not None and job.checkpoint_dir:
+            # a restore that fell back below the writer's last accepted
+            # step (quarantined corrupt) invalidates its duplicate-save
+            # dedup — the re-reached boundary must really save again
+            ckpt_writer.sync_dedup(job.checkpoint_dir, start_step)
 
         t0 = time.perf_counter()
         metrics = {}
@@ -373,12 +478,17 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                         step % job.checkpoint_every < k_here):
                     save(step, state, epoch)
                     last_saved = step
-                if should_stop():
-                    log.info("membership epoch moved at step %d; restarting",
-                             step)
-                    # the elastic interrupt must not swallow the pending
-                    # deferred log boundary — it is the loss line closest
-                    # to the restart an operator will want to see
+                outcome = poll_boundary()
+                if outcome != _POLL_NONE:
+                    drained = outcome == _POLL_DRAIN
+                    log.info(
+                        "%s at step %d",
+                        "drain requested; cutting final checkpoint"
+                        if drained else
+                        "membership epoch moved; restarting", step)
+                    # the interrupt must not swallow the pending deferred
+                    # log boundary — it is the loss line closest to the
+                    # restart/drain an operator will want to see
                     log_resolved(deferred.resolve())
                     if job.checkpoint_dir:
                         # skip the rewrite when the periodic save just
@@ -386,7 +496,24 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                         # needs the write durable, not duplicated
                         if last_saved != step:
                             save(step, state, epoch)
-                        drain_saves()  # next cycle restores this write
+                        drain_saves()  # the restart restores this write
+                    if drained:
+                        # exit CLEAN: the drained pod's replacement (or
+                        # the next incarnation after the operator's
+                        # whole-slice restart) resumes from this exact
+                        # step instead of losing up to checkpoint_every
+                        trc.event("drain_exit", step=step, epoch=epoch)
+                        result["drained"] = True
+                        result["drain_step"] = step
+                        result["state"] = state
+                        result["steps"] = step
+                        if metrics:
+                            # the documented return contract promises a
+                            # loss; the drained cut's is sitting right
+                            # here (and the run is over — the forced
+                            # readback stalls nothing)
+                            result["loss"] = float(metrics["loss"])
+                        return True
                     return False
                 result["state"] = state
                 result["steps"] = step
@@ -413,7 +540,10 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             result["loss"] = float(metrics["loss"])
         return True
 
+    # installed HERE, immediately inside the try whose finally uninstalls:
+    # process-global signal handlers must never outlive a setup failure
     try:
+        drain.install()
         if cfg.is_elastic:
             agent = ElasticAgent(cfg, poll_interval=poll_interval)
             result["cycles"] = agent.run(train_cycle)
@@ -430,6 +560,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             drain_saves()
         except BaseException:
             log.exception("async checkpoint write failed during teardown")
+        drain.uninstall()
         if metrics_srv is not None:
             metrics_srv.stop()
     if goodput_acc["wall"] > 0:

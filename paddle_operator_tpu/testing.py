@@ -42,11 +42,26 @@ class OperatorHarness:
             coord_container_name=helper.COORD_CONTAINER_NAME,
         )
         self.kv = kv_store if kv_store is not None else MemoryKVStore()
+        # everything _build_operator needs again on restart_operator()
+        self._scheduling = scheduling
+        self._init_image = init_image
+        self._port_range = port_range
+        self._namespace = namespace
+        self._http_coordination = http_coordination
+        self._client_middleware = client_middleware
+        self.coord_server = None
+        self._build_operator()
+
+    def _build_operator(self) -> None:
+        """Construct the operator half — everything that lives in the
+        operator PROCESS and dies with it. The apiserver store
+        (self.client), kubelet sim, and elastic KV are built once in
+        __init__ and survive restarts."""
         # The production read path: reconciler + coordination server read
         # from the informer cache (fed synchronously by the fake's watch
         # callbacks), writes pass through to the apiserver.
-        self.cache = InformerCache(self.client, namespace=namespace)
-        kinds = cached_kinds(api.KIND, scheduling)
+        self.cache = InformerCache(self.client, namespace=self._namespace)
+        kinds = cached_kinds(api.KIND, self._scheduling)
         for kind in kinds:
             self.cache.informer(kind)
         self.cached_client = CachedKubeClient(self.client, self.cache)
@@ -54,17 +69,16 @@ class OperatorHarness:
         # middleware wraps the client the CONTROL PLANE sees (reconciler,
         # coordination, manager) — the chaos harness interposes fault
         # injection here; test introspection (self.client) stays unwrapped
-        if client_middleware is not None:
-            self.cached_client = client_middleware(self.cached_client)
+        if self._client_middleware is not None:
+            self.cached_client = self._client_middleware(self.cached_client)
         # per-job observability: shared by the reconciler and (when HTTP
         # coordination is on) the barrier-wait tracking, exposed through
         # Manager.metrics_text like production manager.py wires it
         self.job_metrics = JobMetrics()
         # Production release channel: a real CoordinationServer on localhost;
         # the pod simulator polls it over real HTTP like the init container.
-        self.coord_server = None
         coord_url = ""
-        if http_coordination:
+        if self._http_coordination:
             from .controllers.coordination import CoordinationServer
 
             self.coord_server = CoordinationServer(
@@ -73,14 +87,16 @@ class OperatorHarness:
             coord_url = self.coord_server.url
         self.reconciler = TpuJobReconciler(
             self.cached_client,
-            scheduling=scheduling,
-            init_image=init_image,
-            port_allocator=PortRangeAllocator(*port_range),
+            scheduling=self._scheduling,
+            init_image=self._init_image,
+            # a fresh allocator on purpose: a restarted operator re-learns
+            # host-port allocations from job annotations (_alloc_host_port)
+            port_allocator=PortRangeAllocator(*self._port_range),
             kv_store=self.kv,
             coordination_url=coord_url,
             job_metrics=self.job_metrics,
         )
-        self.manager = Manager(self.cached_client, namespace=namespace,
+        self.manager = Manager(self.cached_client, namespace=self._namespace,
                                cache=self.cache)
         self.manager.add_metrics_provider(self.job_metrics.metrics_block)
         self.controller = self.manager.add_controller(
@@ -101,12 +117,32 @@ class OperatorHarness:
         if racedetect.enabled():
             racedetect.guard_fields(self.job_metrics, "_lock", [
                 "_phase", "_hist", "_hist_sum", "_hist_count",
-                "_restarts", "_resizes", "_barrier_wait", "_releases"])
+                "_restarts", "_resizes", "_barrier_wait", "_releases",
+                "_drains", "_ckpt_saves", "_ckpt_corrupt",
+                "_ckpt_restore_step"])
             racedetect.guard_fields(self.reconciler, "_err_lock",
                                     ["_err_streak", "_err_hit"])
             if self.coord_server is not None:
                 racedetect.guard_fields(self.coord_server, "_barrier_lock",
                                         ["_first_denied", "_released_pods"])
+
+    def restart_operator(self) -> None:
+        """Model the operator PROCESS dying and a replacement starting
+        against the surviving cluster: every piece of operator memory —
+        informer cache, workqueues (in-flight requeues included),
+        reconciler dedup/backoff/port state, per-job metrics, the
+        coordination server — is lost; the apiserver store, the kubelet
+        (pod sim), and the elastic KV store are not. The replacement's
+        startup does what Manager.start() does after winning leadership:
+        re-list into a fresh cache and seed every queue (enqueue_all)."""
+        if self.coord_server is not None:
+            self.coord_server.stop()
+            self.coord_server = None
+        # the crashed process's watch connections die with it — without
+        # this, the old informer would keep feeding a zombie cache
+        self.client.clear_watch_callbacks()
+        self._build_operator()
+        self.manager.enqueue_all()
 
     def close(self) -> None:
         if self.coord_server is not None:

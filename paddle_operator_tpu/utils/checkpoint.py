@@ -6,20 +6,87 @@ framework citizen because TPU elasticity *is* restart-from-checkpoint — a
 collective job cannot shrink below its compiled mesh, so preemption recovery
 = whole-slice restart from the newest step (see elastic/sync.py epoch).
 
-Format: one directory per step, `state.npz` (flat path -> array) +
-`manifest.json` (treedef + dtypes + membership epoch). Atomic via tmp-dir
-rename so a preempted writer never leaves a half checkpoint.
+Format (v2): one directory per step, `state.npz` (flat path -> array) +
+`manifest.json` (treedef + dtypes + membership epoch + per-leaf CRC32
+checksums + a terminal COMMIT marker). Atomic via tmp-dir rename so a
+preempted writer never leaves a half checkpoint on a POSIX filesystem —
+and crash-safe beyond that: on storage where rename is not atomic (NFS,
+FUSE-mounted object stores) a torn write leaves either an unparseable or
+an uncommitted manifest, which readers skip. :func:`latest_step` answers
+the newest *committed* step; :func:`restore_latest` walks back past
+checksum-failing steps, quarantining them with a ``.corrupt`` rename, so
+one bad write can never wedge resume forever. :func:`gc_checkpoints`
+bounds disk to the newest ``keep_last_n`` valid steps plus a small cap of
+quarantined corpses.
+
+Recovery events (saves, corrupt skips, restores, duplicate-save dedup)
+flow into the process trace and an optional observer callback —
+:func:`set_checkpoint_observer` is how the chaos harness and the per-job
+metrics layer (obs.JobMetrics) count them.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import tempfile
-from typing import Any, Dict, Optional, Tuple
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
+
+from .trace import tracer
+
+log = logging.getLogger("tpujob.checkpoint")
+
+#: manifest format carrying checksums + the commit marker
+FORMAT_VERSION = 2
+#: terminal manifest key: written last, so a torn manifest either fails to
+#: parse or visibly lacks the marker — both read as "uncommitted"
+COMMIT_MARKER = "COMMIT"
+
+
+class CorruptCheckpointError(ValueError):
+    """A step directory exists but cannot be trusted: manifest missing or
+    torn, checksum mismatch, or shard coverage holes. Subclasses ValueError
+    so legacy callers catching ValueError keep working."""
+
+
+# -- recovery-event observer -------------------------------------------------
+
+_observer_lock = threading.Lock()
+_observer: Optional[Callable[[str, dict], None]] = None
+
+
+def set_checkpoint_observer(fn: Optional[Callable[[str, dict], None]]) -> None:
+    """Install a process-wide recovery-event observer ``fn(event, detail)``.
+    Events: ``save``, ``restore``, ``corrupt_skipped``,
+    ``duplicate_save_skipped``, ``gc``. Pass None to uninstall."""
+    global _observer
+    with _observer_lock:
+        _observer = fn
+
+
+def _notify(event: str, **detail: Any) -> None:
+    tracer().event("checkpoint_%s" % event, **detail)
+    with _observer_lock:
+        fn = _observer
+    if fn is not None:
+        try:
+            fn(event, detail)
+        except Exception:  # observer must never break a save/restore
+            log.exception("checkpoint observer failed on %r", event)
+
+
+def _leaf_crc(arr: Any) -> int:
+    """CRC32 over the leaf's raw bytes; dtype-agnostic (bf16 void views
+    hash identically to their unsigned round-trip form)."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return zlib.crc32(a.tobytes())
 
 
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
@@ -59,7 +126,13 @@ def _unflatten(structure: Any, flat: Dict[str, Any], prefix: str = "") -> Any:
 
 def save_checkpoint(ckpt_dir: str, step: int, state: Any,
                     meta: Optional[dict] = None, keep: int = 3) -> str:
-    """Write state atomically; prune to the newest `keep` checkpoints."""
+    """Write state atomically; prune to the newest `keep` checkpoints.
+
+    Crash-safe (format v2): the manifest carries per-leaf CRC32 checksums
+    and ends with the COMMIT marker, written after every array byte — a
+    reader never trusts a step whose manifest is missing, torn, or
+    uncommitted.
+    """
     flat = _flatten(state)
     arrays = {k: np.asarray(v) for k, v in flat.items()}
 
@@ -72,6 +145,11 @@ def save_checkpoint(ckpt_dir: str, step: int, state: Any,
             "step": step,
             "structure": _structure(state),
             "meta": meta or {},
+            "format_version": FORMAT_VERSION,
+            "checksums": {k: _leaf_crc(a) for k, a in arrays.items()},
+            # terminal key: json preserves insertion order, so a torn
+            # manifest write truncates BEFORE the marker
+            "commit": COMMIT_MARKER,
         }
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f)
@@ -82,10 +160,8 @@ def save_checkpoint(ckpt_dir: str, step: int, state: Any,
         shutil.rmtree(tmp, ignore_errors=True)
         raise
 
-    steps = sorted(all_steps(ckpt_dir))
-    for old in steps[:-keep]:
-        shutil.rmtree(os.path.join(ckpt_dir, "step_%012d" % old),
-                      ignore_errors=True)
+    _notify("save", dir=ckpt_dir, step=step)
+    gc_checkpoints(ckpt_dir, keep_last_n=keep)
     return final
 
 
@@ -117,19 +193,27 @@ class AsyncCheckpointer:
     """
 
     def __init__(self):
-        import threading
-
         self._thread = None
         self._error = None
         self._lock = threading.Lock()
+        # (dir, step) of the last accepted save: an elastic restart that
+        # re-enters the same step boundary calls save twice; the second
+        # is a deterministic no-op (it would race the first on the
+        # step dir and rewrite identical bytes for nothing)
+        self._last_accepted: Optional[Tuple[str, int]] = None
 
     def save(self, ckpt_dir: str, step: int, state: Any,
              meta: Optional[dict] = None, keep: int = 3) -> None:
-        import threading
-
         import jax
 
+        # drain FIRST: a previous write's failure must re-raise here (the
+        # class contract) and clears the dedup marker — checking the
+        # marker before wait() would silently swallow the retry of a
+        # failed same-step save
         self.wait()  # one in flight; raises a previous write's error
+        if self._last_accepted == (ckpt_dir, step):
+            _notify("duplicate_save_skipped", dir=ckpt_dir, step=step)
+            return
         host_state = jax.device_get(state)  # snapshot before returning
 
         def write():
@@ -143,6 +227,21 @@ class AsyncCheckpointer:
         self._thread = threading.Thread(
             target=write, name="ckpt-write-%d" % step, daemon=True)
         self._thread.start()
+        # marker set LAST: a synchronous failure above (device_get, thread
+        # start) left nothing on disk and no stored error for wait() to
+        # clear — the caller's retry of this step must be a real save
+        self._last_accepted = (ckpt_dir, step)
+
+    def sync_dedup(self, ckpt_dir: str, restored_step: int) -> None:
+        """Called after a cycle restores: the duplicate-save marker stays
+        valid only if it matches the step the restore actually landed on.
+        A fallback BELOW the marked step means the marked write no longer
+        exists on disk (quarantined corrupt) — retraining will legitimately
+        reach that boundary again and the save must be real, not a dedup
+        no-op."""
+        if (self._last_accepted is not None
+                and self._last_accepted != (ckpt_dir, restored_step)):
+            self._last_accepted = None
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Drain the pending write; re-raise a failed write's exception
@@ -158,9 +257,12 @@ class AsyncCheckpointer:
                     % (self._thread.name, timeout))
             self._thread = None
         with self._lock:
-            if self._error is not None:
-                err, self._error = self._error, None
-                raise err
+            err, self._error = self._error, None
+        if err is not None:
+            # the failed step never landed: a retry of the same
+            # (dir, step) must be a real save, not a dedup no-op
+            self._last_accepted = None
+            raise err
 
     def close(self, timeout: float = 30.0) -> None:
         """Bounded join-on-close (thread-hygiene contract, opslint
@@ -170,21 +272,215 @@ class AsyncCheckpointer:
         self.wait(timeout=timeout)
 
 
-def all_steps(ckpt_dir: str):
-    if not os.path.isdir(ckpt_dir):
-        return []
+def _listed_steps(ckpt_dir: str,
+                  _names: Optional[List[str]] = None) -> List[int]:
+    """Step numbers with a manifest.json file present — no validity check.
+    Quarantined ``.corrupt`` dirs and non-numeric names are skipped (never
+    crash the listing on debris). ``_names`` lets gc_checkpoints share one
+    directory listing across its phases (NFS round trips add up on the
+    per-save path)."""
+    if _names is None:
+        if not os.path.isdir(ckpt_dir):
+            return []
+        _names = os.listdir(ckpt_dir)
     out = []
-    for name in os.listdir(ckpt_dir):
-        if name.startswith("step_") and os.path.exists(
-            os.path.join(ckpt_dir, name, "manifest.json")
-        ):
-            out.append(int(name[len("step_"):]))
+    for name in _names:
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name[len("step_"):])
+        except ValueError:
+            continue  # step_N.corrupt quarantine or foreign debris
+        if os.path.exists(os.path.join(ckpt_dir, name, "manifest.json")):
+            out.append(step)
     return sorted(out)
+
+
+def _manifest_committed(manifest: dict) -> bool:
+    """v2 manifests must carry the terminal COMMIT marker; v1 manifests
+    (pre-checksum) are trusted if structurally complete — they were only
+    ever published by an atomic rename."""
+    try:
+        if int(manifest.get("format_version") or 1) >= FORMAT_VERSION:
+            return manifest.get("commit") == COMMIT_MARKER
+    except (TypeError, ValueError):
+        return False
+    return "step" in manifest and "structure" in manifest
+
+
+def _load_manifest(ckpt_dir: str, step: int) -> dict:
+    """Read + validate one step's manifest; CorruptCheckpointError on a
+    missing, torn, or uncommitted manifest (the torn-write signatures)."""
+    path = os.path.join(ckpt_dir, "step_%012d" % step, "manifest.json")
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        raise CorruptCheckpointError(
+            "checkpoint step %d under %s has no manifest.json "
+            "(torn write?)" % (step, ckpt_dir))
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        raise CorruptCheckpointError(
+            "checkpoint step %d under %s has an unreadable manifest "
+            "(torn write?): %s" % (step, ckpt_dir, e))
+    if not isinstance(manifest, dict) or not _manifest_committed(manifest):
+        raise CorruptCheckpointError(
+            "checkpoint step %d under %s is uncommitted (manifest lacks "
+            "the %s marker)" % (step, ckpt_dir, COMMIT_MARKER))
+    return manifest
+
+
+# Committed-verdict cache: without it, every save (save -> gc ->
+# all_steps) and every latest_step() would re-parse `keep` unchanged
+# manifests, which for a large model embed the full parameter-tree
+# structure + per-leaf checksums (multi-MB JSON). Keyed by the manifest's
+# stat identity (mtime_ns, size), so the verdict costs one stat per
+# listing and any replacement or tear of the file — which changes the
+# identity — forces a real re-parse; only POSITIVE verdicts are cached.
+_commit_cache_lock = threading.Lock()
+_committed_manifests: Dict[str, Tuple[int, int]] = {}
+
+
+def _forget_committed(paths: Iterable[str]) -> None:
+    with _commit_cache_lock:
+        for path in paths:
+            _committed_manifests.pop(path, None)
+
+
+def all_steps(ckpt_dir: str, _names: Optional[List[str]] = None):
+    """Steps safe to restore from: manifest present, parseable, committed.
+    An uncommitted/torn step is skipped with a warning — it must never
+    become ``latest_step`` and wedge resume (it stays on disk for
+    quarantine at restore time)."""
+    out = []
+    for step in _listed_steps(ckpt_dir, _names=_names):
+        path = os.path.join(ckpt_dir, "step_%012d" % step)
+        try:
+            st = os.stat(os.path.join(path, "manifest.json"))
+        except OSError:
+            continue  # vanished between the listing and now
+        ident = (st.st_mtime_ns, st.st_size)
+        with _commit_cache_lock:
+            cached = _committed_manifests.get(path) == ident
+        if not cached:
+            try:
+                _load_manifest(ckpt_dir, step)
+            except CorruptCheckpointError as e:
+                log.warning("skipping unusable checkpoint step %d: %s",
+                            step, e)
+                continue
+            with _commit_cache_lock:
+                _committed_manifests[path] = ident
+        out.append(step)
+    return out
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
     steps = all_steps(ckpt_dir)
     return steps[-1] if steps else None
+
+
+def quarantine_step(ckpt_dir: str, step: int) -> Optional[str]:
+    """Rename a corrupt step directory to ``step_N.corrupt`` so readers
+    stop considering it while the bytes stay inspectable. Returns the
+    quarantine path (None if the dir vanished underneath us)."""
+    src = os.path.join(ckpt_dir, "step_%012d" % step)
+    dst = src + ".corrupt"
+    n = 0
+    while os.path.exists(dst):  # same step corrupted twice across restarts
+        n += 1
+        dst = "%s.corrupt.%d" % (src, n)
+    try:
+        os.rename(src, dst)
+    except OSError:
+        return None
+    _forget_committed([src])
+    _notify("corrupt_skipped", dir=ckpt_dir, step=step, quarantine=dst)
+    log.warning("quarantined corrupt checkpoint step %d -> %s", step, dst)
+    return dst
+
+
+# GC serialization: the async writer's background prune and a foreground
+# save/GC may run concurrently in one process; rmtree of the same dir from
+# two threads turns ENOENT races into spurious errors, so all pruning in
+# this process funnels through one lock.
+_gc_lock = threading.Lock()
+
+
+def gc_checkpoints(ckpt_dir: str, keep_last_n: int = 3,
+                   keep_corrupt: int = 2,
+                   stale_grace_seconds: float = 3600.0) -> List[str]:
+    """Retention GC: bound disk to the newest ``keep_last_n`` valid steps
+    and at most ``keep_corrupt`` quarantined ``.corrupt`` corpses (oldest
+    removed first). Also sweeps crash debris — abandoned ``.tmp_*`` /
+    ``.partial_step_*`` staging (a SIGKILLed writer leaves a full-size
+    state copy behind) and manifest-less step dirs (torn rename) — once
+    older than ``stale_grace_seconds``, so a possibly-live writer's
+    staging (another process, an NFS rename still propagating) is never
+    yanked from under it. Returns the paths removed."""
+    removed: List[str] = []
+    if not os.path.isdir(ckpt_dir):
+        return removed
+    with _gc_lock:
+        # ONE directory listing shared by every phase below — on the
+        # network storage this module targets, per-save listdir round
+        # trips are the cost that adds up
+        try:
+            names = sorted(os.listdir(ckpt_dir))
+        except OSError:
+            return removed
+        listed = _listed_steps(ckpt_dir, _names=names)
+        steps = all_steps(ckpt_dir, _names=names)
+        if keep_last_n > 0:
+            for old in steps[:-keep_last_n]:
+                path = os.path.join(ckpt_dir, "step_%012d" % old)
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+        # torn/uncommitted debris OLDER than the newest valid step can
+        # never be a resume target (resume walks newest-first and the
+        # valid step wins) and steps only ever publish in increasing
+        # order, so nothing is concurrently mid-publish back there:
+        # remove it instead of letting crashes accumulate directories
+        # that cost a manifest parse + warning on every listing
+        if steps:
+            valid = set(steps)
+            for dead in [s for s in listed
+                         if s not in valid and s < steps[-1]]:
+                path = os.path.join(ckpt_dir, "step_%012d" % dead)
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+        corpses = [name for name in names
+                   if name.startswith("step_") and ".corrupt" in name]
+        for name in corpses[:max(0, len(corpses) - keep_corrupt)]:
+            path = os.path.join(ckpt_dir, name)
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+        now = time.time()
+        for name in names:
+            if name.startswith(".tmp_") or name.startswith(".partial_step_"):
+                stale = True
+            elif (name.startswith("step_") and ".corrupt" not in name
+                    and not os.path.exists(
+                        os.path.join(ckpt_dir, name, "manifest.json"))):
+                try:
+                    int(name[len("step_"):])
+                except ValueError:
+                    continue  # foreign debris: not ours to delete
+                stale = True  # torn rename left a manifest-less step
+            else:
+                continue
+            path = os.path.join(ckpt_dir, name)
+            try:
+                age = now - os.stat(path).st_mtime
+            except OSError:
+                continue  # vanished (its writer finished): not stale
+            if age >= stale_grace_seconds:
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+    if removed:
+        _forget_committed(removed)  # keep the verdict cache bounded
+        _notify("gc", dir=ckpt_dir, removed=len(removed))
+    return removed
 
 
 def save_checkpoint_sharded(ckpt_dir: str, step: int, state: Any,
@@ -229,10 +525,15 @@ def save_checkpoint_sharded(ckpt_dir: str, step: int, state: Any,
             if jax.process_index() == 0:
                 fname = "%s.s0.npy" % safe
                 _save_arr(os.path.join(staging, fname), arr)
-                entries.append({"file": fname, "slices": None})
+                entries.append({"file": fname, "slices": None,
+                                "crc32": _leaf_crc(arr)})
         for shard in shards:
             fname = "%s.s%d.npy" % (safe, shard.device.id)
-            _save_arr(os.path.join(staging, fname), shard.data)
+            # ONE device->host transfer feeds both the .npy write and the
+            # CRC (np.asarray(shard.data) twice would move every shard's
+            # bytes off-device twice, doubling save-path transfer time)
+            host = np.asarray(shard.data)
+            _save_arr(os.path.join(staging, fname), host)
             entries.append({
                 "file": fname,
                 # replicated dims give slice(None): normalize to full extent
@@ -241,6 +542,7 @@ def save_checkpoint_sharded(ckpt_dir: str, step: int, state: Any,
                      dim if s.stop is None else int(s.stop)]
                     for s, dim in zip(shard.index, shape)
                 ],
+                "crc32": _leaf_crc(host),
             })
         index[path] = {"shape": list(shape), "dtype": dtype,
                        "shards": entries}
@@ -276,17 +578,19 @@ def save_checkpoint_sharded(ckpt_dir: str, step: int, state: Any,
         with open(os.path.join(staging, "shards.json"), "w") as f:
             json.dump(index, f)
         # manifest is written INSIDE staging: the rename below atomically
-        # publishes a complete checkpoint (readers key off manifest.json)
+        # publishes a complete checkpoint (readers key off manifest.json);
+        # the terminal COMMIT marker additionally protects storage where
+        # the rename itself can tear (see module docstring)
         with open(os.path.join(staging, "manifest.json"), "w") as f:
             json.dump({"step": step, "structure": _structure(state),
-                       "meta": meta or {}, "format": "sharded"}, f)
+                       "meta": meta or {}, "format": "sharded",
+                       "format_version": FORMAT_VERSION,
+                       "commit": COMMIT_MARKER}, f)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(staging, final)
-        steps = sorted(all_steps(ckpt_dir))
-        for old in steps[:-keep]:
-            shutil.rmtree(os.path.join(ckpt_dir, "step_%012d" % old),
-                          ignore_errors=True)
+        _notify("save", dir=ckpt_dir, step=step, format="sharded")
+        gc_checkpoints(ckpt_dir, keep_last_n=keep)
     if jax.process_count() > 1:  # pragma: no cover - needs real multihost
         from jax.experimental import multihost_utils
 
@@ -316,7 +620,7 @@ def _check_coverage(entry: Dict[str, Any]) -> None:
             vol *= b - a
         covered += vol
     if covered != total:
-        raise ValueError(
+        raise CorruptCheckpointError(
             "sharded checkpoint coverage mismatch: %d/%d elements "
             "(lost shards or overlapping tiles)" % (covered, total))
 
@@ -331,9 +635,33 @@ def _save_arr(path: str, a) -> None:
     np.save(path, a)
 
 
-def _load_arr(path: str, dtype_str: str):
+def _load_shards_index(path: str, step: int) -> dict:
+    """Read a sharded step's ``shards.json``; CorruptCheckpointError on
+    the torn-write signatures (one classification, shared by every
+    sharded restore path — the manifest twin is :func:`_load_manifest`)."""
+    try:
+        with open(os.path.join(path, "shards.json")) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, UnicodeDecodeError,
+            OSError) as e:
+        raise CorruptCheckpointError(
+            "sharded checkpoint step %d has no usable shards.json: %s"
+            % (step, e))
+
+
+def _load_arr(path: str, dtype_str: str, crc: Optional[int] = None):
     want = np.dtype(dtype_str)
-    data = np.load(path)
+    try:
+        data = np.load(path)
+    except FileNotFoundError:
+        raise CorruptCheckpointError("checkpoint shard %s is missing" % path)
+    except (ValueError, OSError) as e:
+        raise CorruptCheckpointError(
+            "checkpoint shard %s is unreadable: %s" % (path, e))
+    if crc is not None and _leaf_crc(data) != crc:
+        raise CorruptCheckpointError(
+            "checkpoint shard %s failed its CRC32 check "
+            "(bit rot or torn write)" % path)
     if data.dtype != want:
         data = data.view(want)
     return data
@@ -345,7 +673,7 @@ def _restore_sharded_leaf(path_dir: str, entry: Dict[str, Any]):
     out = np.zeros(tuple(entry["shape"]), dtype)
     for shard in entry["shards"]:
         data = _load_arr(os.path.join(path_dir, shard["file"]),
-                         entry["dtype"])
+                         entry["dtype"], crc=shard.get("crc32"))
         if shard["slices"] is None:
             return data
         sl = tuple(slice(a, b) for a, b in shard["slices"])
@@ -354,17 +682,20 @@ def _restore_sharded_leaf(path_dir: str, entry: Dict[str, Any]):
 
 
 def read_manifest(ckpt_dir: str, step: Optional[int] = None) -> dict:
+    """Load one step's manifest; :class:`CorruptCheckpointError` (clear,
+    actionable) instead of a bare open()/json error when the step dir
+    exists but its manifest is missing or torn."""
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError("no checkpoints under %s" % ckpt_dir)
-    with open(os.path.join(ckpt_dir, "step_%012d" % step,
-                           "manifest.json")) as f:
-        return json.load(f)
+    return _load_manifest(ckpt_dir, step)
 
 
 def restore_checkpoint_sharded(ckpt_dir: str, target_state: Any,
-                               step: Optional[int] = None) -> Tuple[Any, dict]:
+                               step: Optional[int] = None,
+                               _manifest: Optional[dict] = None
+                               ) -> Tuple[Any, dict]:
     """Shard-wise restore into ``target_state``'s shardings — the read-side
     twin of :func:`save_checkpoint_sharded`: each process materialises only
     the blocks its own devices need (never a full host copy), assembled from
@@ -378,12 +709,13 @@ def restore_checkpoint_sharded(ckpt_dir: str, target_state: Any,
         if step is None:
             raise FileNotFoundError("no checkpoints under %s" % ckpt_dir)
     path = os.path.join(ckpt_dir, "step_%012d" % step)
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+    # _manifest: restore_latest already parsed it for format dispatch —
+    # a large model's manifest is multi-MB JSON, not worth parsing twice
+    manifest = (_manifest if _manifest is not None
+                else _load_manifest(ckpt_dir, step))
     if manifest.get("format") != "sharded":
         raise ValueError("checkpoint at step %d is not sharded format" % step)
-    with open(os.path.join(path, "shards.json")) as f:
-        index = json.load(f)
+    index = _load_shards_index(path, step)
 
     flat_t = _flatten(target_state)
     out_flat: Dict[str, Any] = {}
@@ -396,10 +728,12 @@ def restore_checkpoint_sharded(ckpt_dir: str, target_state: Any,
         shape = tuple(entry["shape"])
         cache: Dict[str, Any] = {}
 
-        def tile_data(fname):
+        def tile_data(tile):
+            fname = tile["file"]
             if fname not in cache:
                 cache[fname] = _load_arr(os.path.join(path, fname),
-                                         entry["dtype"])
+                                         entry["dtype"],
+                                         crc=tile.get("crc32"))
             return cache[fname]
 
         blocks, devices = [], []
@@ -415,7 +749,7 @@ def restore_checkpoint_sharded(ckpt_dir: str, target_state: Any,
                          for (a1, b1), (a2, b2) in zip(tsl, til)]
                 if any(a >= b for a, b in inter):
                     continue
-                data = tile_data(tile["file"])
+                data = tile_data(tile)
                 src = tuple(slice(a - ta, b - ta)
                             for (a, b), (ta, _) in zip(inter, til))
                 dst = tuple(slice(a - qa, b - qa)
@@ -426,27 +760,55 @@ def restore_checkpoint_sharded(ckpt_dir: str, target_state: Any,
         out_flat[key] = jax.make_array_from_single_device_arrays(
             shape, tgt.sharding, blocks)
     state = _unflatten(manifest["structure"], out_flat)
+    _notify("restore", dir=ckpt_dir, step=step, format="sharded")
     return state, manifest
 
 
 def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
-                       sharding_tree: Any = None) -> Tuple[Any, dict]:
+                       sharding_tree: Any = None,
+                       _manifest: Optional[dict] = None) -> Tuple[Any, dict]:
     """Load (state, manifest). If `sharding_tree` is given (a pytree of
-    NamedSharding matching the state), leaves are device_put sharded."""
+    NamedSharding matching the state), leaves are device_put sharded.
+
+    Raises :class:`CorruptCheckpointError` when the step's manifest is
+    torn or a leaf fails its CRC32 check — a single attempt, no fallback;
+    :func:`restore_latest` is the walk-back-past-corruption entry point.
+    """
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError("no checkpoints under %s" % ckpt_dir)
     path = os.path.join(ckpt_dir, "step_%012d" % step)
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+    manifest = (_manifest if _manifest is not None
+                else _load_manifest(ckpt_dir, step))
     if manifest.get("format") == "sharded":
-        with open(os.path.join(path, "shards.json")) as f:
-            index = json.load(f)
+        index = _load_shards_index(path, step)
         flat = {k: _restore_sharded_leaf(path, v) for k, v in index.items()}
     else:
-        with np.load(os.path.join(path, "state.npz")) as npz:
-            flat = {k: npz[k] for k in npz.files}
+        import zipfile
+
+        checksums = manifest.get("checksums") or {}
+        try:
+            with np.load(os.path.join(path, "state.npz")) as npz:
+                flat = {k: npz[k] for k in npz.files}
+        except FileNotFoundError:
+            raise CorruptCheckpointError(
+                "checkpoint step %d has no state.npz" % step)
+        except (ValueError, OSError, KeyError,
+                zipfile.BadZipFile, zlib.error) as e:
+            # zip directory/entry damage, npy header damage, payload
+            # inflate failures — the torn-write / bit-rot signatures
+            raise CorruptCheckpointError(
+                "checkpoint step %d has an unreadable state.npz: %s"
+                % (step, e))
+        for key, want in checksums.items():
+            if key not in flat:
+                raise CorruptCheckpointError(
+                    "checkpoint step %d is missing leaf %r" % (step, key))
+            if _leaf_crc(flat[key]) != int(want):
+                raise CorruptCheckpointError(
+                    "checkpoint step %d leaf %r failed its CRC32 check "
+                    "(bit rot or torn write)" % (step, key))
     state = _unflatten(manifest["structure"], flat)
     if sharding_tree is not None:
         import jax
@@ -454,4 +816,102 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
         state = jax.tree_util.tree_map(
             lambda leaf, sh: jax.device_put(leaf, sh), state, sharding_tree
         )
+    _notify("restore", dir=ckpt_dir, step=step)
     return state, manifest
+
+
+def restore_latest(ckpt_dir: str, target_state: Any = None,
+                   sharding_tree: Any = None) -> Tuple[Any, dict]:
+    """Restore the newest step that actually loads: walk newest -> oldest,
+    quarantining every step that turns out torn or checksum-corrupt
+    (``.corrupt`` rename) so the next reader doesn't trip over it again.
+    This is the crash-safe resume entry point the runner uses — a single
+    bad write costs at most ``checkpoint_every`` steps of progress, never
+    the whole run.
+
+    ``target_state`` enables the shard-wise restore path for sharded
+    manifests (each process reads only its devices' blocks); without it a
+    sharded step is assembled host-side like :func:`restore_checkpoint`.
+    Raises FileNotFoundError when no valid step survives.
+
+    Multi-host: every process runs this loop over the same shared
+    storage, but a shard-wise restore only CRC-checks the tiles ITS
+    devices need — corruption confined to a peer's shards is invisible
+    locally. Each round therefore agrees collectively: the candidate
+    step is the oldest of the per-process newest (a process that
+    already saw a quarantine lists fewer), and the restore only counts
+    if EVERY process succeeded — one process's corruption fails the
+    step for the whole gang, which falls back together instead of
+    resuming from different steps and deadlocking in the first
+    collective.
+    """
+    multi = False
+    try:
+        import jax
+
+        multi = jax.process_count() > 1
+    except Exception:  # jax absent/uninitialized: single-process semantics
+        multi = False
+    while True:
+        # walk the raw listing, not all_steps(): a torn-manifest step is
+        # not just skipped here but QUARANTINED, so it stops costing a
+        # manifest parse on every future latest_step() call
+        steps = _listed_steps(ckpt_dir)
+        step = steps[-1] if steps else None
+        if multi:  # pragma: no cover - needs real multihost
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(
+                np.asarray(step if step is not None else -1))
+            step = int(np.min(gathered))
+            if step < 0:
+                raise FileNotFoundError(
+                    "no restorable checkpoints under %s" % ckpt_dir)
+        elif step is None:
+            raise FileNotFoundError(
+                "no restorable checkpoints under %s" % ckpt_dir)
+        result = None
+        failure: Optional[CorruptCheckpointError] = None
+        try:
+            manifest = _load_manifest(ckpt_dir, step)
+            if (manifest.get("format") == "sharded"
+                    and target_state is not None):
+                result = restore_checkpoint_sharded(
+                    ckpt_dir, target_state, step=step, _manifest=manifest)
+            else:
+                result = restore_checkpoint(ckpt_dir, step=step,
+                                            sharding_tree=sharding_tree,
+                                            _manifest=manifest)
+        except CorruptCheckpointError as e:
+            failure = e
+        ok = failure is None
+        if multi:  # pragma: no cover - needs real multihost
+            from jax.experimental import multihost_utils
+
+            ok = bool(np.min(multihost_utils.process_allgather(
+                np.asarray(1 if failure is None else 0))))
+        if ok:
+            return result
+        log.warning("checkpoint step %d is unusable (%s); falling back "
+                    "to the previous step", step,
+                    failure if failure is not None
+                    else "a peer process saw corruption")
+        if quarantine_step(ckpt_dir, step) is None:
+            # Rename failed. Losing the rename race because a PEER (or a
+            # concurrent restorer) already quarantined the dir just means
+            # it is gone from the next listing — keep walking. A dir
+            # still present (permissions error) must raise, or this loop
+            # would spin on it forever.
+            if os.path.isdir(os.path.join(ckpt_dir,
+                                          "step_%012d" % step)):
+                raise failure if failure is not None else \
+                    CorruptCheckpointError(
+                        "step %d failed on a peer process and could not "
+                        "be quarantined" % step)
+        if multi:  # pragma: no cover - needs real multihost
+            from jax.experimental import multihost_utils
+
+            # the rename must be visible to every process before the
+            # next round re-lists, or a fast peer re-picks the dead step
+            multihost_utils.sync_global_devices(
+                "ckpt_quarantine_%d" % step)

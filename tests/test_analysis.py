@@ -105,6 +105,54 @@ def test_ops101_condition_aliases_its_wrapped_lock():
     assert opslint.lint_source(CONDITION_ALIAS, "fixture_alias.py") == []
 
 
+MODULE_LOCKED = '''
+import threading
+
+_observer_lock = threading.Lock()
+_observer = None
+
+
+def set_observer(fn):
+    global _observer
+    with _observer_lock:
+        _observer = fn
+
+
+def notify(event):
+    with _observer_lock:
+        fn = _observer
+    if fn is not None:
+        fn(event)
+'''
+
+MODULE_UNLOCKED = MODULE_LOCKED.replace(
+    """    with _observer_lock:
+        fn = _observer
+""",
+    """    fn = _observer              # planted: read outside the lock
+""")
+
+
+def test_ops101_module_scope_lock_discipline():
+    """Module-level locks guard module globals (the checkpoint-layer
+    observer/GC pattern): a global written under the lock read bare is
+    the same race OPS101 catches on instance attrs."""
+    assert opslint.lint_source(MODULE_LOCKED, "fixture_module.py") == []
+    findings = opslint.lint_source(MODULE_UNLOCKED, "fixture_module.py")
+    assert rules_of(findings) == {"OPS101"}
+    assert {f.symbol for f in findings} == {"<module>.notify._observer"}
+
+
+def test_ops101_module_scope_shadowed_local_not_tracked():
+    shadowing = MODULE_LOCKED + '''
+
+def unrelated():
+    _observer = object()        # plain local, shadows the global name
+    return _observer
+'''
+    assert opslint.lint_source(shadowing, "fixture_shadow.py") == []
+
+
 def test_ops101_suppression_comment():
     patched = UNLOCKED_WRITE.replace(
         "return len(self._rows)      # planted: read outside the lock",

@@ -281,3 +281,60 @@ def test_finalize_releases_port_and_finalizer():
     with pytest.raises(NotFoundError):
         h.client.get(api.KIND, "default", "gone")
     assert h.pods() == []
+
+
+# ---------------------------------------------------------------------------
+# restart-budget carry-over across status-patch conflicts
+# ---------------------------------------------------------------------------
+
+def test_restart_counter_carries_sibling_across_409_retry():
+    """Both budgets mid-flight in status while an increment rides through a
+    status-patch 409 retry: the bounded fresh-GET loop must carry the
+    SIBLING counter over untouched and land its own increment exactly once
+    (reconciler._count_restart_durably carry-over logic)."""
+    from paddle_operator_tpu.chaos import ChaosKubeClient, FaultInjector
+
+    injector = FaultInjector()
+    h = OperatorHarness(
+        client_middleware=lambda c: ChaosKubeClient(c, injector))
+    h.create_job(tpu_job(name="midflight", elastic=1))
+    h.converge()
+    # both counters already spent: a preemption AND an app-failure
+    # incident are mid-flight in the same status object
+    obj = h.client.get(api.KIND, "default", "midflight")
+    status = dict(obj["status"])
+    status["preemptionRestarts"] = 2
+    status["appFailureRestarts"] = 1
+    h.client.patch_status(api.KIND, "default", "midflight", status)
+
+    job = h.get_job("midflight")
+    injector.arm_error(409, count=2, verbs=("update_status",))
+    h.reconciler._count_restart_durably(job, "appFailureRestarts")
+
+    got = h.get_job("midflight")
+    # the sibling survived the 409 retries; the increment landed once
+    assert int(got.status["preemptionRestarts"]) == 2
+    assert int(got.status["appFailureRestarts"]) == 2
+    # and the in-memory view the pass keeps reasoning with agrees
+    assert int(job.status["appFailureRestarts"]) == 2
+    assert injector.counts.get("api_error_409") == 2
+
+
+def test_restart_counter_survives_persistent_conflict_in_memory():
+    """Past the bounded retries the increment still counts IN-MEMORY for
+    this pass's event/budget math (the durable value catches up on the
+    next pass)."""
+    from paddle_operator_tpu.chaos import ChaosKubeClient, FaultInjector
+
+    injector = FaultInjector()
+    h = OperatorHarness(
+        client_middleware=lambda c: ChaosKubeClient(c, injector))
+    h.create_job(tpu_job(name="stuck409", elastic=1))
+    h.converge()
+    job = h.get_job("stuck409")
+    job.status["preemptionRestarts"] = 3
+    injector.arm_error(409, count=10, verbs=("update_status",))
+    h.reconciler._count_restart_durably(job, "preemptionRestarts")
+    assert int(job.status["preemptionRestarts"]) == 4  # in-memory
+    got = h.get_job("stuck409")
+    assert not got.status.get("preemptionRestarts")  # not yet durable

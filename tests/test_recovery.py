@@ -1,0 +1,644 @@
+"""Durable recovery: crash-safe checkpoints, graceful drain, operator
+restart survival.
+
+Covers the checkpoint format-v2 contract (per-leaf CRC32 + COMMIT marker,
+torn-manifest skip, quarantine + fallback, retention GC), the runner's
+drain hook (final checkpoint at the next boundary, clean exit,
+bit-identical resume), the pod-sim grace model + the reconciler's drain
+notice (durable dedup, budgets), operator-restart survival, and the two
+new chaos scenarios end to end.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import helper
+from paddle_operator_tpu.elastic.sync import epoch_key
+from paddle_operator_tpu.testing import OperatorHarness
+from paddle_operator_tpu.utils import checkpoint as ckpt
+from paddle_operator_tpu.utils.checkpoint import (
+    CorruptCheckpointError, all_steps, gc_checkpoints, latest_step,
+    restore_checkpoint, restore_latest, save_checkpoint,
+    set_checkpoint_observer,
+)
+
+
+@pytest.fixture
+def events():
+    """Install a checkpoint observer collecting (event, detail) pairs;
+    always uninstalled (the observer is process-wide)."""
+    seen = []
+    set_checkpoint_observer(lambda event, detail: seen.append(
+        (event, dict(detail))))
+    yield seen
+    set_checkpoint_observer(None)
+
+
+def make_state(step=7):
+    return {
+        "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+        "opt": {"step": jnp.array(step, jnp.int32)},
+    }
+
+
+# one corruption implementation for tests AND the chaos recovery leg —
+# tier-1 must exercise exactly what `make recovery`/`make chaos` run
+from paddle_operator_tpu.chaos.recovery import (  # noqa: E402
+    flip_leaf_bytes as corrupt_leaf, linear_batch_source, tiny_linear_job,
+)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint format v2
+# ---------------------------------------------------------------------------
+
+def test_manifest_v2_checksums_and_terminal_commit(tmp_path):
+    save_checkpoint(str(tmp_path), 3, make_state())
+    with open(str(tmp_path / "step_000000000003" / "manifest.json")) as f:
+        text = f.read()
+    manifest = json.loads(text)
+    assert manifest["format_version"] == ckpt.FORMAT_VERSION
+    assert manifest["commit"] == ckpt.COMMIT_MARKER
+    assert set(manifest["checksums"]) == {"params/w", "opt/step"}
+    # the marker is TERMINAL: a torn (truncated) manifest can never
+    # parse as committed
+    assert text.rstrip("}").rstrip().endswith('"COMMIT"')
+
+
+def test_torn_manifest_skipped_with_warning(tmp_path, caplog):
+    save_checkpoint(str(tmp_path), 1, make_state(1))
+    save_checkpoint(str(tmp_path), 2, make_state(2))
+    manifest = tmp_path / "step_000000000002" / "manifest.json"
+    manifest.write_text(manifest.read_text()[:40])  # torn mid-write
+    with caplog.at_level("WARNING"):
+        assert latest_step(str(tmp_path)) == 1  # never the torn step
+    assert any("unusable" in r.message for r in caplog.records)
+    restored, manifest = restore_checkpoint(str(tmp_path))
+    assert manifest["step"] == 1
+    assert int(restored["opt"]["step"]) == 1
+
+
+def test_missing_manifest_raises_clear_error_on_explicit_step(tmp_path):
+    save_checkpoint(str(tmp_path), 5, make_state())
+    os.remove(str(tmp_path / "step_000000000005" / "manifest.json"))
+    with pytest.raises(CorruptCheckpointError, match="torn write"):
+        restore_checkpoint(str(tmp_path), step=5)
+    assert latest_step(str(tmp_path)) is None  # and never trusted blindly
+
+
+def test_uncommitted_v2_manifest_not_trusted(tmp_path):
+    save_checkpoint(str(tmp_path), 4, make_state())
+    path = tmp_path / "step_000000000004" / "manifest.json"
+    manifest = json.loads(path.read_text())
+    del manifest["commit"]
+    path.write_text(json.dumps(manifest))
+    assert all_steps(str(tmp_path)) == []
+
+
+def test_corrupt_step_quarantined_and_fallback(tmp_path, events):
+    save_checkpoint(str(tmp_path), 1, make_state(1))
+    save_checkpoint(str(tmp_path), 2, make_state(2))
+    corrupt_leaf(str(tmp_path), 2)
+    # single-attempt restore sees the rot...
+    with pytest.raises(CorruptCheckpointError, match="CRC32"):
+        restore_checkpoint(str(tmp_path), step=2)
+    # ...the walking restore falls back and quarantines
+    restored, manifest = restore_latest(str(tmp_path))
+    assert manifest["step"] == 1
+    assert int(restored["opt"]["step"]) == 1
+    corpses = [n for n in os.listdir(str(tmp_path)) if ".corrupt" in n]
+    assert corpses == ["step_000000000002.corrupt"]
+    kinds = [e for e, _ in events]
+    assert "corrupt_skipped" in kinds and "restore" in kinds
+
+
+def test_restore_latest_nothing_valid_raises(tmp_path):
+    save_checkpoint(str(tmp_path), 1, make_state())
+    corrupt_leaf(str(tmp_path), 1)
+    with pytest.raises(FileNotFoundError):
+        restore_latest(str(tmp_path))
+    assert any(".corrupt" in n for n in os.listdir(str(tmp_path)))
+
+
+def test_all_steps_caches_commit_verdicts_by_stat_identity(tmp_path,
+                                                           monkeypatch):
+    """Repeated listings must not re-parse unchanged manifests (the
+    per-save hot path), but any change to the file — a tear included —
+    changes the stat identity and forces a real re-check."""
+    save_checkpoint(str(tmp_path), 1, make_state(1))
+    save_checkpoint(str(tmp_path), 2, make_state(2))
+    parses = []
+    real = ckpt._load_manifest
+    monkeypatch.setattr(
+        ckpt, "_load_manifest",
+        lambda d, s: parses.append(s) or real(d, s))
+    assert all_steps(str(tmp_path)) == [1, 2]
+    assert parses == []  # save's own GC already verified both
+    manifest = tmp_path / "step_000000000002" / "manifest.json"
+    manifest.write_text(manifest.read_text()[:40])  # torn: new identity
+    assert all_steps(str(tmp_path)) == [1]
+    assert parses == [2]  # only the changed manifest was re-parsed
+
+
+def test_gc_bounds_valid_steps_and_corrupt_corpses(tmp_path):
+    for step in range(1, 7):
+        save_checkpoint(str(tmp_path), step, make_state(step), keep=10)
+    for step in (5, 6):
+        corrupt_leaf(str(tmp_path), step)
+        ckpt.quarantine_step(str(tmp_path), step)
+    removed = gc_checkpoints(str(tmp_path), keep_last_n=2, keep_corrupt=1)
+    assert removed  # something was pruned
+    assert all_steps(str(tmp_path)) == [3, 4]
+    corpses = [n for n in os.listdir(str(tmp_path)) if ".corrupt" in n]
+    assert corpses == ["step_000000000006.corrupt"]  # oldest corpse pruned
+
+
+def test_gc_sweeps_stale_staging_and_manifestless_debris(tmp_path):
+    """Crash debris — abandoned staging dirs and manifest-less step dirs —
+    is swept once past the grace age, but FRESH staging (a possibly-live
+    writer) is never touched."""
+    save_checkpoint(str(tmp_path), 1, make_state())
+    (tmp_path / ".tmp_abandoned").mkdir()
+    (tmp_path / ".tmp_abandoned" / "state.npz").write_bytes(b"partial")
+    (tmp_path / ".partial_step_000000000009").mkdir()
+    (tmp_path / "step_000000000005").mkdir()  # torn rename: no manifest
+    gc_checkpoints(str(tmp_path), stale_grace_seconds=0.0)
+    names = set(os.listdir(str(tmp_path)))
+    assert ".tmp_abandoned" not in names
+    assert ".partial_step_000000000009" not in names
+    assert "step_000000000005" not in names
+    assert "step_000000000001" in names
+    # fresh staging survives the default grace window
+    (tmp_path / ".tmp_live").mkdir()
+    gc_checkpoints(str(tmp_path))
+    assert ".tmp_live" in os.listdir(str(tmp_path))
+
+
+def test_sharded_checkpoint_carries_crcs_and_detects_rot(tmp_path):
+    import jax
+
+    from paddle_operator_tpu.parallel import make_mesh, named
+    from paddle_operator_tpu.parallel.sharding import P
+    from paddle_operator_tpu.utils.checkpoint import save_checkpoint_sharded
+
+    mesh = make_mesh({"dp": 8})
+    arr = jax.device_put(jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+                         named(mesh, P("dp", None)))
+    save_checkpoint_sharded(str(tmp_path), 1, {"w": arr})
+    index = json.loads(
+        (tmp_path / "step_000000000001" / "shards.json").read_text())
+    assert all("crc32" in shard for shard in index["w"]["shards"])
+    # rot one shard file: npy payload flip, index checksum left stale
+    fname = index["w"]["shards"][0]["file"]
+    shard_path = tmp_path / "step_000000000001" / fname
+    data = np.load(str(shard_path))
+    data.reshape(-1).view(np.uint8)[0] ^= 0xFF
+    np.save(str(shard_path), data)
+    with pytest.raises(CorruptCheckpointError, match="CRC32"):
+        restore_checkpoint(str(tmp_path), step=1)
+
+
+def test_async_duplicate_save_is_noop_with_trace_event(tmp_path, events):
+    from paddle_operator_tpu.utils.checkpoint import AsyncCheckpointer
+
+    writer = AsyncCheckpointer()
+    writer.save(str(tmp_path), 3, make_state())
+    writer.save(str(tmp_path), 3, make_state())  # elastic re-entry
+    writer.wait()
+    assert all_steps(str(tmp_path)) == [3]
+    assert [e for e, _ in events].count("duplicate_save_skipped") == 1
+    assert [e for e, _ in events].count("save") == 1
+    # a DIFFERENT step is a real save again
+    writer.save(str(tmp_path), 4, make_state())
+    writer.wait()
+    assert all_steps(str(tmp_path)) == [3, 4]
+
+
+def test_async_failed_save_retry_not_deduped(tmp_path):
+    from paddle_operator_tpu.utils.checkpoint import AsyncCheckpointer
+
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the ckpt dir should go")
+    writer = AsyncCheckpointer()
+    writer.save(str(blocked), 1, make_state())
+    with pytest.raises(Exception):
+        writer.wait()
+    # the failed (dir, step) must NOT be treated as already-saved
+    real = tmp_path / "real"
+    writer.save(str(real), 1, make_state())
+    writer.wait()
+    assert all_steps(str(real)) == [1]
+
+
+def test_async_same_step_retry_after_failure_surfaces_error(tmp_path):
+    """A retry of the SAME (dir, step) whose background write failed must
+    re-raise the stored error (class contract: failures surface on the
+    next save/wait), never silently dedup — and once the error is
+    consumed, the retry is a real save."""
+    from paddle_operator_tpu.utils.checkpoint import AsyncCheckpointer
+
+    target = tmp_path / "ckpt"
+    target.write_text("a file where the ckpt dir should go")
+    writer = AsyncCheckpointer()
+    writer.save(str(target), 1, make_state())
+    with pytest.raises(Exception):
+        writer.save(str(target), 1, make_state())  # same step: must raise
+    os.remove(str(target))  # the obstruction clears
+    writer.save(str(target), 1, make_state())  # not deduped: really saves
+    writer.wait()
+    assert all_steps(str(target)) == [1]
+
+
+def test_async_sync_dedup_invalidates_on_fallback(tmp_path, events):
+    """After a restore falls back BELOW the writer's last accepted step
+    (that step was quarantined corrupt), re-reaching the boundary must
+    really save; after a restore that matches it, the dedup holds."""
+    from paddle_operator_tpu.utils.checkpoint import AsyncCheckpointer
+
+    writer = AsyncCheckpointer()
+    writer.save(str(tmp_path), 8, make_state(8))
+    writer.wait()
+    writer.sync_dedup(str(tmp_path), 4)  # fallback: step 8 is gone
+    writer.save(str(tmp_path), 8, make_state(88))
+    writer.wait()
+    restored, _ = restore_checkpoint(str(tmp_path), step=8)
+    assert int(restored["opt"]["step"]) == 88  # the re-save was real
+    writer.sync_dedup(str(tmp_path), 8)  # restore landed ON the marker
+    writer.save(str(tmp_path), 8, make_state(0))
+    writer.wait()
+    assert [e for e, _ in events].count("duplicate_save_skipped") == 1
+    restored, _ = restore_checkpoint(str(tmp_path), step=8)
+    assert int(restored["opt"]["step"]) == 88  # deduped, not rewritten
+
+
+def test_drained_run_reports_loss(tmp_path):
+    from paddle_operator_tpu.launch import LaunchConfig
+    from paddle_operator_tpu.runner import DrainMonitor, run_training
+
+    monitor = DrainMonitor()
+    make_batch = _linear_batch()
+
+    def draining(rng, step):
+        if step == 3:
+            monitor.request()
+        return make_batch(rng, step)
+
+    out = run_training(_linear_job(str(tmp_path), draining,
+                                   drain_monitor=monitor),
+                       cfg=LaunchConfig(worker_id=0, num_workers=1),
+                       init_distributed=False)
+    assert out["drained"] is True
+    # the documented return contract holds on the drained path too
+    assert isinstance(out["loss"], float)
+
+
+def test_terminal_job_cleanup_is_not_a_drain():
+    """clean-pod-policy deletions on a COMPLETED job linger Terminating
+    on a real apiserver — they are cleanup, never a preemption drain."""
+    h = OperatorHarness()
+    h.create_job(elastic_job("fin"))
+    h.converge()
+    job = h.get_job("fin")
+    pods = h.client.list_owned("Pod", job.obj)
+    job.obj["status"]["phase"] = api.Phase.COMPLETED
+    for pod in pods:
+        pod["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    assert h.reconciler._graceful_drain(api.TpuJob(job.obj), pods) is None
+    assert not [e for e in h.client.events_for("fin")
+                if e.get("reason") == "GracefulDrain"]
+
+
+def test_gc_removes_torn_debris_older_than_newest_valid(tmp_path):
+    """Uncommitted/torn step dirs older than the newest valid step can
+    never be resume targets: GC removes them instead of letting crashed
+    writers accumulate directories that cost a warning per listing."""
+    save_checkpoint(str(tmp_path), 4, make_state(4), keep=10)
+    save_checkpoint(str(tmp_path), 8, make_state(8), keep=10)
+    torn = tmp_path / "step_000000000006" / "manifest.json"
+    torn.parent.mkdir()
+    torn.write_text('{"step": 6, "truncated')
+    gc_checkpoints(str(tmp_path), keep_last_n=10)
+    assert not torn.parent.exists()
+    assert all_steps(str(tmp_path)) == [4, 8]
+    # a torn step NEWER than every valid one is preserved (it is
+    # restore_latest's job to quarantine it on encounter)
+    newest = tmp_path / "step_000000000009" / "manifest.json"
+    newest.parent.mkdir()
+    newest.write_text('{"step": 9, "truncated')
+    gc_checkpoints(str(tmp_path), keep_last_n=10)
+    assert newest.parent.exists()
+
+
+def test_restore_latest_tolerates_peer_quarantine_race(tmp_path,
+                                                      monkeypatch):
+    """Multi-host shared storage: every process walks restore_latest; a
+    process that LOSES the quarantine rename (a peer renamed the dir
+    first) must keep walking to the same surviving step, not crash."""
+    save_checkpoint(str(tmp_path), 1, make_state(1))
+    save_checkpoint(str(tmp_path), 2, make_state(2))
+    corrupt_leaf(str(tmp_path), 2)
+
+    real_quarantine = ckpt.quarantine_step
+
+    def losing_quarantine(ckpt_dir, step):
+        real_quarantine(ckpt_dir, step)  # "the peer" wins the rename...
+        return None                      # ...so OUR rename fails
+
+    monkeypatch.setattr(ckpt, "quarantine_step", losing_quarantine)
+    restored, manifest = restore_latest(str(tmp_path))
+    assert manifest["step"] == 1
+    assert int(restored["opt"]["step"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# runner drain hook
+# ---------------------------------------------------------------------------
+
+def _linear_job(ckpt_dir, make_batch, **kw):
+    return tiny_linear_job(ckpt_dir, make_batch, total_steps=10, **kw)
+
+
+_linear_batch = linear_batch_source
+
+
+def test_runner_drain_file_cuts_checkpoint_and_resumes_bit_identical(
+        tmp_path):
+    from paddle_operator_tpu.launch import LaunchConfig
+    from paddle_operator_tpu.runner import run_training
+
+    cfg = LaunchConfig(worker_id=0, num_workers=1)
+    make_batch = _linear_batch()
+    drain_file = str(tmp_path / "drain-requested")
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def draining(rng, step):
+        if step == 5:  # what a preStop hook / node agent does
+            with open(drain_file, "w"):
+                pass
+        return make_batch(rng, step)
+
+    out = run_training(_linear_job(ckpt_dir, draining,
+                                   drain_file=drain_file),
+                       cfg=cfg, init_distributed=False)
+    assert out["drained"] is True
+    drain_step = out["drain_step"]
+    assert 0 < drain_step < 10
+    # the final checkpoint landed AT the drain boundary — zero lost steps
+    assert latest_step(ckpt_dir) == drain_step
+    os.remove(drain_file)
+
+    resumed = run_training(_linear_job(ckpt_dir, make_batch),
+                           cfg=cfg, init_distributed=False)
+    assert resumed["resume_steps"] == [drain_step]
+    assert resumed["steps"] == 10
+
+    ref = run_training(_linear_job(str(tmp_path / "ref"), make_batch),
+                       cfg=cfg, init_distributed=False)
+    # EasyScale restart consistency, bit-exact
+    assert float(ref["loss"]).hex() == float(resumed["loss"]).hex()
+
+
+def test_runner_drain_signal(tmp_path):
+    import signal
+
+    from paddle_operator_tpu.launch import LaunchConfig
+    from paddle_operator_tpu.runner import run_training
+
+    make_batch = _linear_batch()
+
+    def killing(rng, step):
+        if step == 4:
+            os.kill(os.getpid(), signal.SIGUSR1)
+        return make_batch(rng, step)
+
+    out = run_training(
+        _linear_job(str(tmp_path), killing,
+                    drain_signals=(signal.SIGUSR1,)),
+        cfg=LaunchConfig(worker_id=0, num_workers=1),
+        init_distributed=False)
+    assert out["drained"] is True
+    assert latest_step(str(tmp_path)) == out["drain_step"]
+    # the handler was restored on exit
+    assert signal.getsignal(signal.SIGUSR1) in (
+        signal.SIG_DFL, signal.SIG_IGN, signal.default_int_handler)
+
+
+def test_runner_resumes_past_corrupt_step(tmp_path, events):
+    """A corrupted newest checkpoint costs checkpoint_every steps, not the
+    run: the runner restores the previous valid step, quarantines the bad
+    one, and the finished run is bit-identical to an unfaulted one."""
+    from paddle_operator_tpu.launch import LaunchConfig
+    from paddle_operator_tpu.runner import DrainMonitor, run_training
+
+    cfg = LaunchConfig(worker_id=0, num_workers=1)
+    make_batch = _linear_batch()
+    monitor = DrainMonitor()
+
+    def draining(rng, step):
+        if step == 6:
+            monitor.request()
+        return make_batch(rng, step)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    out = run_training(_linear_job(ckpt_dir, draining,
+                                   drain_monitor=monitor),
+                       cfg=cfg, init_distributed=False)
+    drain_step = out["drain_step"]
+    valid_before = all_steps(ckpt_dir)
+    corrupt_leaf(ckpt_dir, drain_step)
+
+    resumed = run_training(_linear_job(ckpt_dir, make_batch),
+                           cfg=cfg, init_distributed=False)
+    expect = max(s for s in valid_before if s != drain_step)
+    assert resumed["resume_steps"] == [expect]
+    ref = run_training(_linear_job(str(tmp_path / "ref"), make_batch),
+                       cfg=cfg, init_distributed=False)
+    assert float(ref["loss"]).hex() == float(resumed["loss"]).hex()
+    assert any(e == "corrupt_skipped" for e, _ in events)
+
+
+# ---------------------------------------------------------------------------
+# pod-sim grace model + reconciler drain notice
+# ---------------------------------------------------------------------------
+
+def role_spec(replicas):
+    return {"replicas": replicas, "template": {"spec": {"containers": [
+        {"name": "main", "image": "img"}]}}}
+
+
+def elastic_job(name, workers=4):
+    return api.new_tpujob(name, spec={
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": "4x8"},
+        "worker": role_spec(workers), "elastic": 1,
+    })
+
+
+def test_graceful_preempt_terminating_then_killed_then_replaced():
+    h = OperatorHarness()
+    h.create_job(elastic_job("g"))
+    h.converge()
+    epoch_before = int(h.kv.get(epoch_key("default", "g")) or 0)
+    h.sim.preempt("g-worker-0", grace_seconds=3)
+    h.manager.drain()
+    h.sim.step()
+    # the drain window: Terminating (deletionTimestamp), still Running
+    pod = h.client.get("Pod", "default", "g-worker-0")
+    assert pod["metadata"]["deletionTimestamp"]
+    assert pod["status"]["phase"] == "Running"
+    h.converge(max_ticks=80)
+    job = h.get_job("g")
+    assert job.phase == api.Phase.RUNNING
+    assert int(job.status.get("preemptionRestarts")) == 1
+    assert not job.status.get("appFailureRestarts")
+    # exactly ONE drain notice and ONE epoch bump for the incident
+    drains = [e for e in h.client.events_for("g")
+              if e.get("reason") == "GracefulDrain"]
+    assert len(drains) == 1
+    assert int(h.kv.get(epoch_key("default", "g"))) == epoch_before + 1
+    # the replacement gang is whole again
+    assert len(h.pods()) == 4
+    # and the notice reached the metrics plane
+    text = h.job_metrics.metrics_block()
+    assert 'tpujob_drain_notices_total{job="default/g"} 1' in text
+
+
+def test_drain_ack_dedup_survives_operator_restart():
+    """The drain-acked marker lives on the POD, so a restarted operator
+    must not re-bump the epoch or double-count the same incident."""
+    h = OperatorHarness()
+    h.create_job(elastic_job("d"))
+    h.converge()
+    h.sim.preempt("d-worker-1", grace_seconds=4)
+    h.manager.drain()  # ack + count + bump happen here
+    h.sim.step()
+    epoch_after_ack = int(h.kv.get(epoch_key("default", "d")))
+    pod = h.client.get("Pod", "default", "d-worker-1")
+    assert pod["metadata"]["annotations"][helper.ANNOT_DRAIN_ACK] == "true"
+
+    h.restart_operator()  # operator dies MID-DRAIN
+    h.converge(max_ticks=80)
+    job = h.get_job("d")
+    assert job.phase == api.Phase.RUNNING
+    assert int(job.status.get("preemptionRestarts")) == 1  # not 2
+    assert int(h.kv.get(epoch_key("default", "d"))) == epoch_after_ack
+
+
+def test_scale_down_terminating_pod_is_not_a_drain():
+    """A pod the controller is deleting for scale-down (index >= replicas)
+    must never be mistaken for an eviction drain."""
+    h = OperatorHarness()
+    h.create_job(elastic_job("s"))
+    h.converge()
+    job = h.get_job("s")
+    pods = h.client.list_owned("Pod", job.obj)
+    victim = next(p for p in pods
+                  if p["metadata"]["name"] == "s-worker-3")
+    victim["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    job.obj["spec"]["worker"]["replicas"] = 2  # shrunk spec
+    assert h.reconciler._graceful_drain(api.TpuJob(job.obj), pods) is None
+    assert not [e for e in h.client.events_for("s")
+                if e.get("reason") == "GracefulDrain"]
+
+
+def test_operator_restart_mid_incident_preserves_world():
+    from paddle_operator_tpu.chaos import FaultInjector, PodChaos
+
+    h = OperatorHarness()
+    h.create_job(elastic_job("c"))
+    h.converge()
+    chaos = PodChaos(h.sim, h.client, FaultInjector())
+    chaos.preempt(h.client.get("Pod", "default", "c-worker-1"))
+    h.manager.drain()
+    h.sim.step()
+    chaos.tick()
+    h.restart_operator()
+    for _ in range(40):
+        h.manager.drain()
+        h.sim.step()
+        chaos.tick()
+    job = h.get_job("c")
+    assert job.phase == api.Phase.RUNNING
+    assert int(job.status.get("preemptionRestarts")) == 1
+    names = sorted(p["metadata"]["name"] for p in h.pods())
+    assert names == ["c-worker-0", "c-worker-1", "c-worker-2", "c-worker-3"]
+
+
+# ---------------------------------------------------------------------------
+# chaos scenarios (fast single seeds; the sweep is slow-marked in
+# tests/test_chaos.py)
+# ---------------------------------------------------------------------------
+
+def test_chaos_operator_crash_single_seed():
+    from paddle_operator_tpu.chaos import run_scenario
+
+    report = run_scenario("operator_crash", seed=0, quick=True)
+    assert report.converged, report.summary_line()
+    assert report.violations == [], report.summary_line()
+    assert report.faults.get("operator_crash") == 1
+    st = report.jobs["crashy"]
+    assert st["phase"] == "Running"
+    assert st["preemptionRestarts"] >= 1
+
+
+def test_chaos_operator_crash_deterministic():
+    from paddle_operator_tpu.chaos import run_scenario
+
+    a = run_scenario("operator_crash", seed=5, quick=True)
+    b = run_scenario("operator_crash", seed=5, quick=True)
+    assert a.violations == [] and b.violations == []
+    assert a.fingerprint() == b.fingerprint()
+
+
+def test_chaos_graceful_drain_with_corruption_single_seed():
+    """The acceptance seed: a checkpoint step is corrupted mid-incident
+    and training resumes from the prior valid step with bit-identical
+    loss to the reference replay."""
+    from paddle_operator_tpu.chaos import run_scenario
+
+    report = run_scenario("graceful_drain", seed=2, quick=True)
+    assert report.converged, report.summary_line()
+    assert report.violations == [], report.summary_line()
+    assert report.extra["corrupt"] != "none"
+    assert report.extra["resume_step"] < report.extra["drain_step"]
+    assert report.faults.get("ckpt_corrupt_skipped", 0) >= 1
+    assert report.jobs["drainful"]["phase"] == "Running"
+
+
+def test_jobmetrics_recovery_families_parse_and_wire(tmp_path):
+    """The new exposition families are strict-parser-valid, and the
+    checkpoint observer glue attributes worker-side events to the job."""
+    from paddle_operator_tpu.obs import (
+        JobMetrics, parse_exposition, wire_checkpoint_observer,
+    )
+
+    metrics = JobMetrics()
+    set_checkpoint_observer(wire_checkpoint_observer(
+        metrics, "default", "wired"))
+    try:
+        save_checkpoint(str(tmp_path), 4, make_state())
+        corrupt_leaf(str(tmp_path), 4)
+        with pytest.raises(FileNotFoundError):
+            restore_latest(str(tmp_path))
+        save_checkpoint(str(tmp_path), 8, make_state())
+        restore_latest(str(tmp_path))
+    finally:
+        set_checkpoint_observer(None)
+    metrics.observe_drain("default", "wired", pods=4)
+    text = metrics.metrics_block() + "\n"
+    assert parse_exposition(text) == []  # strict-parser valid
+    assert 'tpujob_checkpoint_saves_total{job="default/wired"} 2' in text
+    assert ('tpujob_checkpoint_corrupt_skipped_total{job="default/wired"} 1'
+            in text)
+    assert 'tpujob_checkpoint_restore_step{job="default/wired"} 8' in text
+    assert 'tpujob_drain_notices_total{job="default/wired"} 1' in text
+    # flight recorder saw the whole story
+    kinds = [e["kind"] for e in metrics.flight.dump("default", "wired")]
+    for kind in ("checkpoint_save", "checkpoint_corrupt",
+                 "checkpoint_restore", "drain"):
+        assert kind in kinds
