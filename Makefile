@@ -4,14 +4,15 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos recovery obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
 # the default pre-merge gate: project lint + the fast suite + the fast
 # suite again under the runtime race detector (docs/static-analysis.md)
-# + one seed of each durable-recovery chaos scenario
-verify: analyze test-fast race recovery
+# + one seed of each durable-recovery chaos scenario + the fleet-
+# scheduler fast lane
+verify: analyze test-fast race recovery sched
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -55,7 +56,7 @@ race:
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
 	  tests/test_observability.py tests/test_reconciler.py \
 	  tests/test_recovery.py tests/test_runtime_edge.py \
-	  tests/test_scale_stress.py tests/test_trace.py \
+	  tests/test_scale_stress.py tests/test_sched.py tests/test_trace.py \
 	  tests/test_websocket.py
 
 # deterministic fault-injection sweep: every chaos scenario under seeded
@@ -71,6 +72,14 @@ chaos:
 recovery:
 	$(PY) scripts/chaos_stress.py --scenario operator_crash \
 	  --scenario graceful_drain --seeds 1 --quick
+
+# fleet-scheduler fast lane (docs/design.md "Fleet scheduling &
+# multi-tenancy"): scheduler unit tests + one seed of the multi_tenant
+# scenario (priority/fair-share arbitration, shrink-before-evict,
+# checkpoint-aware preemption, FIFO-baseline goodput comparison)
+sched:
+	$(PY) -m pytest tests/test_sched.py -x -q -m "not slow"
+	$(PY) scripts/chaos_stress.py --scenario multi_tenant --seeds 1 --quick
 
 # observability lanes (see docs/observability.md):
 #   obs          — rebuild a failure timeline from a recorded chaos run

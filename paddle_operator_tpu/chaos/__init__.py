@@ -22,10 +22,12 @@ from .plan import CONTROL_SCENARIOS, SCENARIOS, ChaosPlan, FaultEvent, \
     build_plan
 from .pod_faults import PodChaos
 from .recovery import run_recovery_scenario
+from .tenants import TenantFleetRun, run_tenant_scenario
 
 __all__ = [
     "ChaosHarness", "ChaosKubeClient", "ChaosPlan", "ChaosReport",
     "ChaosSourceError", "CONTROL_SCENARIOS", "FaultEvent", "FaultInjector",
-    "FaultySource", "PodChaos", "SCENARIOS", "build_plan",
-    "run_loader_scenario", "run_recovery_scenario", "run_scenario",
+    "FaultySource", "PodChaos", "SCENARIOS", "TenantFleetRun",
+    "build_plan", "run_loader_scenario", "run_recovery_scenario",
+    "run_scenario", "run_tenant_scenario",
 ]

@@ -421,6 +421,13 @@ def run_scenario(scenario: str, seed: int, quick: bool = True) -> ChaosReport:
     """Build the plan and run one scenario to a report (the one entry point
     tests and scripts/chaos_stress.py share)."""
     plan = build_plan(scenario, seed, quick=quick)
+    if scenario == "multi_tenant":
+        # the fleet-scheduler harness: an arbitrated run (invariants:
+        # no starvation, no capacity leak, priority-ordered preemptions,
+        # goodput) plus a naive-FIFO baseline replay of the same seed
+        from .tenants import run_tenant_scenario
+
+        return run_tenant_scenario(plan)
     if scenario == "loader_faults":
         t0 = time.perf_counter()
         injector = FaultInjector()

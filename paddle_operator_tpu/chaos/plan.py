@@ -48,12 +48,16 @@ from typing import Dict, List, Tuple
 
 #: control-plane scenarios run the operator harness; ``loader_faults`` runs
 #: the data plane only (ShardedLoader + FaultySource); ``graceful_drain``
-#: additionally runs the training-plane recovery leg (chaos.recovery).
+#: additionally runs the training-plane recovery leg (chaos.recovery);
+#: ``multi_tenant`` runs the fleet-scheduler harness (chaos.tenants): N
+#: prioritized jobs churning over a limited simulated fleet, with a
+#: naive-FIFO baseline replayed from the same seed for the goodput
+#: invariant.
 CONTROL_SCENARIOS = (
     "preemption_burst", "apiserver_flake", "slice_drain_resize",
     "graceful_drain", "operator_crash",
 )
-SCENARIOS = CONTROL_SCENARIOS + ("loader_faults",)
+SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant")
 
 
 @dataclass(frozen=True)
@@ -101,6 +105,7 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "graceful_drain": _graceful_drain,
         "operator_crash": _operator_crash,
         "loader_faults": _loader_faults,
+        "multi_tenant": _multi_tenant,
     }[scenario]
     events, horizon = builder(rng, quick)
     return ChaosPlan(scenario, seed, events, horizon)
@@ -223,6 +228,77 @@ def _operator_crash(rng: random.Random, quick: bool
             rng.randint(1, crash_at), "api_error",
             {"code": rng.choice([500, 503]), "count": rng.randint(1, 2)}))
     return events, 72 if quick else 144
+
+
+def _multi_tenant(rng: random.Random, quick: bool
+                  ) -> Tuple[List[FaultEvent], int]:
+    """Fleet-scheduler churn: prioritized jobs from two tenants contend
+    for a 2-slice/64-chip simulated fleet. The schedule always contains
+    the adversarial shape the arbiter exists for — a full-fleet
+    high-priority job arriving while smaller work runs (naive FIFO
+    head-of-line blocks on it; the arbiter shrinks + preempts) — plus
+    randomized small arrivals, an occasional hard preemption, and
+    apiserver errors. ``job_submit`` params feed chaos.tenants.
+
+    Base jobs are sized so their sum exceeds one slice but fits the
+    fleet; min_hosts=hosts on some jobs models "refuses to shrink"."""
+    events: List[FaultEvent] = []
+    tenants = ("team-a", "team-b")
+    classes = ("tpu-low", "tpu-standard")
+    n_base = rng.randint(3, 4)
+    small_names = []
+    for i in range(n_base):
+        hosts = rng.choice([1, 2, 2, 4])
+        name = "base%d" % i
+        small_names.append(name)
+        events.append(FaultEvent(0, "job_submit", {
+            "name": name,
+            "tenant": tenants[i % 2],
+            "class": classes[rng.randrange(2)],
+            "hosts": hosts,
+            # one base job in ~3 refuses to shrink (floor == size)
+            "min_hosts": hosts if rng.random() < 0.34 else 1,
+            # long enough that the whale always lands mid-flight: naive
+            # FIFO must head-of-line block on it, the arbiter must not
+            "duration": rng.randint(14, 20),
+            "elastic": True,
+        }))
+    if rng.random() < 0.5:
+        # a rigid bystander: non-elastic, never preemptible — the
+        # arbiter must reserve around it
+        events.append(FaultEvent(rng.randint(0, 2), "job_submit", {
+            "name": "rigid", "tenant": tenants[rng.randrange(2)],
+            "class": "tpu-low", "hosts": 1,
+            "duration": rng.randint(8, 14), "elastic": False,
+        }))
+    big_at = rng.randint(8, 14)
+    # 48 of 64 chips: big enough to force preemptions, small enough that
+    # shrunk victims and late arrivals can backfill around it
+    events.append(FaultEvent(big_at, "job_submit", {
+        "name": "whale", "tenant": "team-a", "class": "tpu-high",
+        "hosts": 6, "min_hosts": 6, "duration": rng.randint(6, 9),
+        "elastic": True,
+    }))
+    for j in range(rng.randint(1, 3)):
+        name = "late%d" % j
+        small_names.append(name)
+        events.append(FaultEvent(rng.randint(big_at, big_at + 10),
+                                 "job_submit", {
+            "name": name, "tenant": tenants[rng.randrange(2)],
+            "class": classes[rng.randrange(2)],
+            "hosts": rng.choice([1, 2]), "min_hosts": 1,
+            "duration": rng.randint(4, 8), "elastic": True,
+        }))
+    if rng.random() < 0.4:
+        events.append(FaultEvent(
+            rng.randint(4, big_at), "pod_preempt",
+            {"job": small_names[rng.randrange(len(small_names))]}))
+    for _ in range(rng.randint(1, 2)):
+        events.append(FaultEvent(
+            rng.randint(2, big_at + 8), "api_error",
+            {"code": rng.choice([409, 500, 503]),
+             "count": rng.randint(1, 2)}))
+    return events, 200 if quick else 300
 
 
 def _loader_faults(rng: random.Random, quick: bool

@@ -173,6 +173,17 @@ ANNOT_MAX_RESTARTS = "batch.tpujob.dev/max-preemption-restarts"
 # lives on the object, not in reconciler memory.
 ANNOT_DRAIN_ACK = "batch.tpujob.dev/drain-acked"
 
+# Job annotation the fleet arbiter (sched/) stamps on a victim before
+# draining its gang: the reconciler's drain handler books the incident as
+# a scheduler preemption (status.schedPreemptions — voluntary, budget-
+# free) instead of spending the job's preemption-restart budget, then
+# strips the annotation. Lives on the object so a scheduler eviction
+# survives an operator restart mid-drain.
+ANNOT_SCHED_EVICT = "batch.tpujob.dev/sched-evict"
+# The job's own worker np, parked while the arbiter runs it shrunk and
+# restored when fleet pressure subsides.
+ANNOT_SCHED_RESTORE_NP = "batch.tpujob.dev/sched-restore-np"
+
 
 def preemption_budget(job: api.TpuJob) -> int:
     ann = (job.metadata.get("annotations") or {}).get(ANNOT_MAX_RESTARTS)

@@ -57,8 +57,19 @@ class TpuJobReconciler:
         backoff_base: float = 1.0,
         backoff_cap: float = 30.0,
         job_metrics: Optional[JobMetrics] = None,
+        arbiter=None,
     ):
         self.client = client
+        # Fleet capacity arbiter (sched.FleetArbiter or None). When set,
+        # its decide() gates pod creation where the first-come gang gate
+        # used to: jobs wait for fleet capacity instead of racing for it,
+        # and the arbiter drives shrink/preempt through this reconciler's
+        # existing elastic-resize and graceful-drain paths.
+        self.arbiter = arbiter
+        # last SchedQueued reason evented per job (the queue decision
+        # repeats every requeue pass; the Event must not — worker-thread
+        # only, same single-writer contract as _exec_release_warned)
+        self._sched_queued: Dict[Tuple[str, str], str] = {}
         # Per-job observability collector: phase gauges/histograms,
         # cause-split restart counters, flight recorder. Whoever owns the
         # Manager registers ``self.obs.metrics_block`` as a provider.
@@ -155,6 +166,7 @@ class TpuJobReconciler:
             # Job is gone: drop its warn-once marker so memory stays bounded
             # across job churn and a recreated same-name job warns afresh.
             self._exec_release_warned.discard((namespace, name))
+            self._sched_queued.pop((namespace, name), None)
             self.obs.forget_job(namespace, name)
             return Result()
         job = api.TpuJob(obj)
@@ -198,6 +210,17 @@ class TpuJobReconciler:
         # -- elastic preemption: whole-slice restart (SURVEY §7) --------
         if job.elastic is not None:
             gate = self._elastic_preemption(job, child_pods)
+            if gate is not None:
+                return gate
+
+        # -- fleet arbiter admission (sched/) ---------------------------
+        # Replaces the gang gate's first-come ordering: the arbiter packs
+        # the whole fleet (priority tiers, weighted fair share, shrink-
+        # before-evict) and this gate simply asks whether THIS job's gang
+        # may exist right now. Runs before the Volcano gate so a queued
+        # job does not even claim a PodGroup.
+        if self.arbiter is not None:
+            gate = self._sched_gate(job)
             if gate is not None:
                 return gate
 
@@ -403,6 +426,51 @@ class TpuJobReconciler:
                field, int(job.status[field]), budget))
         return Result(requeue=True)
 
+    def _sched_gate(self, job: api.TpuJob) -> Optional[Result]:
+        """Consult the fleet arbiter; None = admitted, fall through to
+        normal reconciliation. Queue decisions requeue (the arbiter
+        replans as the cluster changes) with a once-per-reason Event."""
+        key = (job.namespace, job.name)
+        if job.phase in (api.Phase.COMPLETED, api.Phase.FAILED):
+            # a job can reach terminal while queued — drop its entry now
+            # rather than waiting for object deletion
+            self._sched_queued.pop(key, None)
+            # terminal jobs are not gated, but their teardown passes are
+            # exactly when capacity frees — poke the arbiter so queued
+            # admissions / parked-np restores flow without waiting for a
+            # queued job's next poll
+            try:
+                self.arbiter.poke()
+            except Exception as e:
+                log.error("fleet arbiter poke failed: %s", e)
+            return None
+        try:
+            decision = self.arbiter.decide(job)
+        except Exception as e:  # arbiter read failed — surface and retry
+            log.error("fleet arbiter decide failed for %s/%s: %s",
+                      job.namespace, job.name, e)
+            return self._requeue_error(key)
+        if decision.admitted:
+            if decision.np is not None:
+                worker = job.spec.get(api.RES_WORKER) or {}
+                if int(worker.get("replicas") or 0) != decision.np:
+                    # decide() just realigned spec.worker.replicas; the
+                    # object THIS pass holds predates the write — acting
+                    # on it would size the gang stale (chips beyond the
+                    # allocation). Requeue for a fresh read.
+                    return Result(requeue=True)
+            if key in self._sched_queued:
+                del self._sched_queued[key]
+                self.recorder.event(
+                    job.obj, "Normal", "SchedAdmitted",
+                    "admitted by the fleet arbiter")
+            return None
+        if self._sched_queued.get(key) != decision.reason:
+            self._sched_queued[key] = decision.reason
+            self.recorder.event(job.obj, "Normal", "SchedQueued",
+                                decision.reason)
+        return Result(requeue_after=decision.retry_after or 1.0)
+
     def _count_restart_durably(self, job: api.TpuJob, field: str) -> None:
         """Increment a restart counter with bounded retry and a fresh GET
         per attempt: a lost increment under persistent status-update
@@ -471,12 +539,35 @@ class TpuJobReconciler:
             # cleanup, the same class as an index beyond replicas
             return spec is not None and idx < spec["replicas"]
 
+        alive = any(k8s.pod_phase(p) in ("Pending", "Running")
+                    for p in child_pods)
+        if (helper.ANNOT_SCHED_EVICT in
+                (job.metadata.get("annotations") or {})
+                and not alive):
+            # The arbiter's eviction finished draining without any pass
+            # observing it (operator down mid-drain, pods already gone):
+            # the incident is over, and a stale marker left behind would
+            # misbook the NEXT genuine preemption as budget-free. Strip
+            # only when the gang is fully gone — a lagging informer
+            # cache can briefly show the victim's pods as live Running
+            # right after the arbiter's deletes, and stripping then
+            # would spend the victim's restart budget on a voluntary
+            # eviction. (Pod recreation happens later in the pass, so
+            # the restarted operator strips before re-creating.)
+            self._strip_job_annotation(job, helper.ANNOT_SCHED_EVICT)
         fresh = [p for p in child_pods if is_drain(p)
                  and helper.ANNOT_DRAIN_ACK
                  not in (p["metadata"].get("annotations") or {})]
         if not fresh:
             return None
-        if helper.restart_budget_exhausted(job):
+        # A fleet-arbiter eviction (sched/) drains through this same path
+        # but is VOLUNTARY: it books status.schedPreemptions instead of
+        # spending the preemption-restart budget (the budget exists to
+        # bound crash loops; a scheduler reclaiming chips must never push
+        # a well-behaved job toward terminal Failed).
+        sched_evict = helper.ANNOT_SCHED_EVICT in (
+            job.metadata.get("annotations") or {})
+        if not sched_evict and helper.restart_budget_exhausted(job):
             return None
         # Bump BEFORE acking (mirror of the hard-preemption ordering): an
         # acked-but-unbumped incident could never retry its restart
@@ -495,6 +586,23 @@ class TpuJobReconciler:
             # retry is harmless (workers restart once per poll, however
             # many bumps landed in between)
             return self._requeue_error((job.namespace, job.name))
+        if sched_evict:
+            self._count_restart_durably(job, "schedPreemptions")
+            self._strip_job_annotation(job, helper.ANNOT_SCHED_EVICT)
+            self.obs.observe_sched_eviction(job.namespace, job.name)
+            self.obs.observe_drain(job.namespace, job.name,
+                                   pods=len(fresh))
+            self.recorder.event(
+                job.obj, "Normal", "SchedulerPreempted",
+                "%d pod(s) draining for the fleet arbiter (%s)%s; final "
+                "checkpoints cut at the next step boundary; the job "
+                "re-queues for capacity (schedPreemptions %d)"
+                % (len(fresh),
+                   ", ".join(p["metadata"]["name"] for p in fresh),
+                   "; membership epoch bumped to %s" % epoch
+                   if epoch else "",
+                   int(job.status.get("schedPreemptions") or 0)))
+            return Result(requeue=True)
         self._count_restart_durably(job, "preemptionRestarts")
         self.obs.observe_drain(job.namespace, job.name, pods=len(fresh))
         self.obs.observe_restart(job.namespace, job.name, "preemption")
@@ -508,6 +616,29 @@ class TpuJobReconciler:
                int(job.status.get("preemptionRestarts") or 0),
                helper.preemption_budget(job)))
         return Result(requeue=True)
+
+    def _strip_job_annotation(self, job: api.TpuJob, annot: str) -> None:
+        """Remove a handled incident marker from the job (bounded conflict
+        retry, fresh GET per attempt). If every attempt conflicts the
+        marker survives one incident too long — harmless for dedup (the
+        pods are already acked), and the next arbiter pass re-stamps or
+        the next drain re-strips it."""
+        for _attempt in range(4):
+            try:
+                cur = self.client.get(api.KIND, job.namespace, job.name)
+            except NotFoundError:
+                return
+            annots = cur["metadata"].get("annotations") or {}
+            if annot not in annots:
+                return
+            del annots[annot]
+            cur["metadata"]["annotations"] = annots
+            try:
+                self.client.update(cur)
+            except ConflictError:
+                continue
+            (job.metadata.get("annotations") or {}).pop(annot, None)
+            return
 
     def _ack_drain(self, pod: dict) -> bool:
         """Stamp ANNOT_DRAIN_ACK on a draining pod (bounded conflict
@@ -546,6 +677,8 @@ class TpuJobReconciler:
             new_status["preemptionRestarts"] = job.status["preemptionRestarts"]
         if job.status.get("appFailureRestarts"):
             new_status["appFailureRestarts"] = job.status["appFailureRestarts"]
+        if job.status.get("schedPreemptions"):
+            new_status["schedPreemptions"] = job.status["schedPreemptions"]
 
         per_role = {}
         for pod in child_pods:
@@ -712,12 +845,16 @@ class TpuJobReconciler:
                                     ["touch", "goon"],
                                 )
                         except Exception as e:
-                            # A silent warning here strands the whole gang in
+                            # A failed release strands the whole gang in
                             # init containers (the shipped ClusterRole grants
                             # no pods/exec — the HTTP coordination channel is
                             # the production release path). Surface it where
-                            # the user is looking: on the job — ONCE, not on
-                            # every 1s requeue pass of every pod.
+                            # the user is looking: a Warning Event on the job
+                            # — ONCE, not on every requeue pass of every pod
+                            # — plus the tpujob_gang_stranded_total counter
+                            # every failing pass, and requeue with the error
+                            # backoff instead of hammering the apiserver at
+                            # a fixed 1s cadence.
                             log.warning("exec release failed: %s", e)
                             key = (job.namespace, job.name)
                             if key not in self._exec_release_warned:
@@ -725,13 +862,18 @@ class TpuJobReconciler:
                                 self.recorder.event(
                                     job.obj, "Warning", "ExecReleaseFailed",
                                     "exec release of %s failed: %s — the "
-                                    "exec fallback needs a pods/exec RBAC "
-                                    "rule (not in the shipped ClusterRole); "
-                                    "enable the HTTP coordination channel "
+                                    "gang is stranded in init containers; "
+                                    "the exec fallback needs a pods/exec "
+                                    "RBAC rule (not in the shipped "
+                                    "ClusterRole); enable the HTTP "
+                                    "coordination channel "
                                     "(--coordination-bind-address) or grant "
                                     "pods/exec"
                                     % (pod["metadata"]["name"], e),
                                 )
+                            self.obs.observe_gang_stranded(
+                                job.namespace, job.name)
+                            return self._requeue_error(key)
                 return Result(requeue_after=1.0)
         return Result()
 

@@ -29,8 +29,102 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 from ..api import crd, types as api
+from ..sched.fairshare import PREEMPTION_POLICIES, PRIORITY_CLASSES
 
 log = logging.getLogger("tpujob.webhook")
+
+
+def validate_scheduling(obj: dict) -> list:
+    """Admission checks for the pod-template scheduling fields the fleet
+    arbiter consumes (sched/): reject what the arbiter could only
+    misinterpret later. Runs per role template:
+
+    * ``priority`` must be >= 0 — the arbiter's tiers treat priority as a
+      rank, and Kubernetes reserves negative semantics to PriorityClass
+      objects this operator does not resolve dynamically;
+    * ``preemptionPolicy`` must be one of the two Kubernetes-defined
+      values (``PreemptLowerPriority`` | ``Never``) — an unknown value
+      would silently fall back to the default and preempt;
+    * ``priorityClassName`` (and ``schedulingPolicy.priorityClass``)
+      must name a class this operator resolves — an unknown (typo'd)
+      class would silently schedule at priority 0; and together with an
+      explicit ``priority`` the resolved value must agree: on a real
+      apiserver the admission chain RESOLVES priority from the class, so
+      a mismatched explicit value is a contradiction.
+    """
+    errs = []
+    spec = obj.get("spec") or {}
+    # bool is an int subclass, and JSON whole-valued floats (-5.0)
+    # satisfy the CRD's OpenAPI integer check — both would reach
+    # effective_priority() as a rank, so only a plain int is one
+    def is_rank(p):
+        return isinstance(p, int) and not isinstance(p, bool)
+    templates = []
+    for role in api.RESOURCE_ORDER:
+        tmpl = (((spec.get(role) or {}).get("template") or {})
+                .get("spec") or {})
+        templates.append(("spec.%s.template.spec" % role,
+                          tmpl.get("priority"),
+                          tmpl.get("priorityClassName"),
+                          tmpl.get("preemptionPolicy")))
+    for where, prio, cls, policy in templates:
+        if prio is not None:
+            if not is_rank(prio):
+                errs.append("%s.priority must be an integer (got %r)"
+                            % (where, prio))
+            elif prio < 0:
+                errs.append("%s.priority must be >= 0 (got %d)"
+                            % (where, prio))
+        if policy is not None and policy not in PREEMPTION_POLICIES:
+            errs.append(
+                "%s.preemptionPolicy must be one of %s (got %r)"
+                % (where, "|".join(PREEMPTION_POLICIES), policy))
+        if cls and cls not in PRIORITY_CLASSES:
+            # a typo'd class would silently fall through to priority 0
+            # in effective_priority — the exact silent-default failure
+            # this validator exists to prevent
+            errs.append(
+                "%s.priorityClassName %r is not a class this operator "
+                "resolves (known: %s) — the job would silently schedule "
+                "at priority 0"
+                % (where, cls, "|".join(sorted(PRIORITY_CLASSES))))
+        elif prio is not None and cls and PRIORITY_CLASSES[cls] != prio:
+            errs.append(
+                "%s: priorityClassName %r resolves to %d but "
+                "priority is %r — remove the explicit priority or "
+                "fix the class" % (where, cls, PRIORITY_CLASSES[cls],
+                                   prio))
+    sp_cls = (spec.get("schedulingPolicy") or {}).get("priorityClass")
+    if sp_cls and sp_cls not in PRIORITY_CLASSES:
+        errs.append(
+            "spec.schedulingPolicy.priorityClass %r is not a class this "
+            "operator resolves (known: %s) — the job would silently "
+            "schedule at priority 0"
+            % (sp_cls, "|".join(sorted(PRIORITY_CLASSES))))
+    elif sp_cls:
+        # the same contradiction checks the template-level class gets:
+        # an explicit template priority (and a template class) silently
+        # outrank schedulingPolicy.priorityClass in effective_priority,
+        # so a mismatch must not pass admission
+        for where, prio, cls, _policy in templates:
+            if is_rank(prio) and prio != PRIORITY_CLASSES[sp_cls]:
+                errs.append(
+                    "%s.priority %r contradicts "
+                    "spec.schedulingPolicy.priorityClass %r (resolves "
+                    "to %d) — remove the explicit priority or fix the "
+                    "class" % (where, prio, sp_cls,
+                               PRIORITY_CLASSES[sp_cls]))
+            if (cls and cls in PRIORITY_CLASSES
+                    and PRIORITY_CLASSES[cls]
+                    != PRIORITY_CLASSES[sp_cls]):
+                errs.append(
+                    "%s.priorityClassName %r (resolves to %d) "
+                    "contradicts spec.schedulingPolicy.priorityClass "
+                    "%r (resolves to %d) — the template class would "
+                    "silently win"
+                    % (where, cls, PRIORITY_CLASSES[cls], sp_cls,
+                       PRIORITY_CLASSES[sp_cls]))
+    return errs
 
 
 def validate_admission(review: dict) -> dict:
@@ -70,6 +164,8 @@ def validate_admission(review: dict) -> dict:
                     errs = api.TpuJob(obj).validate()
                 except Exception as e:
                     errs = ["semantic validation failed: %r" % (e,)]
+            if not errs:
+                errs = validate_scheduling(obj)
     response = {"uid": uid, "allowed": not errs}
     if errs:
         response["status"] = {

@@ -149,6 +149,11 @@ def main(argv=None):
                     help="seconds to wait for the cert pair to appear in "
                          "--webhook-cert-dir before exiting (cert-manager "
                          "may still be issuing at first boot)")
+    ap.add_argument("--fleet-sched", action="store_true",
+                    help="enable the fleet capacity arbiter (sched/): "
+                         "priority + weighted fair-share admission over "
+                         "TPU node-pool capacity, shrink-before-evict, "
+                         "checkpoint-cost-aware preemption")
     ap.add_argument("--kube-api", default=None, help="apiserver URL override")
     ap.add_argument("--insecure-skip-tls-verify", action="store_true")
     args = ap.parse_args(argv)
@@ -276,6 +281,15 @@ def main(argv=None):
                 args.webhook_bind_address, cert_file=cert, key_file=key)
             webhook_srv.start()
 
+    arbiter = None
+    if args.fleet_sched:
+        from .sched import FleetArbiter
+
+        # default evictor (graceful pod delete) + annotation-fed
+        # checkpoint costs; everything it knows is recomputed from
+        # cluster state, so restarts and failovers lose nothing
+        arbiter = FleetArbiter(cached_client, job_metrics=job_metrics)
+
     reconciler = TpuJobReconciler(
         cached_client,
         scheduling=args.scheduling,
@@ -284,6 +298,7 @@ def main(argv=None):
         kv_store=kv,
         coordination_url=coord_url,
         job_metrics=job_metrics,
+        arbiter=arbiter,
     )
     stop = threading.Event()
     exit_code = [0]
@@ -312,6 +327,8 @@ def main(argv=None):
     )
     ctrl.backoff_provider = reconciler.current_backoff
     mgr.add_metrics_provider(job_metrics.metrics_block)
+    if arbiter is not None:
+        mgr.add_metrics_provider(arbiter.metrics_block)
 
     Probes = probes_handler(cache, mgr, leader_elect=args.leader_elect,
                             standby_ready=args.standby_ready)

@@ -142,6 +142,11 @@ class JobMetrics:
         self._resizes: Dict[str, int] = {}
         self._barrier_wait: Dict[str, float] = {}
         self._releases: Dict[str, int] = {}
+        # fleet-scheduler plane (sched/): arbiter evictions handled by the
+        # reconciler's drain path, and gangs stranded by a failed startup
+        # release
+        self._sched_evictions: Dict[str, int] = {}
+        self._gang_stranded: Dict[str, int] = {}
         # durable-recovery plane (PR 5): graceful-drain notices, and the
         # checkpoint lifecycle fed through wire_checkpoint_observer
         self._drains: Dict[str, int] = {}
@@ -213,6 +218,26 @@ class JobMetrics:
         self.flight.record(namespace, name, "drain", pods=pods)
         tracer().event("drain_notice", job=key, pods=pods)
 
+    def observe_sched_eviction(self, namespace: str, name: str) -> None:
+        """The fleet arbiter preempted this job (ANNOT_SCHED_EVICT drain
+        incident booked by the reconciler) — voluntary, budget-free."""
+        key = job_key(namespace, name)
+        with self._lock:
+            self._sched_evictions[key] = \
+                self._sched_evictions.get(key, 0) + 1
+        self.flight.record(namespace, name, "sched_evicted")
+        tracer().event("sched_evicted", job=key)
+
+    def observe_gang_stranded(self, namespace: str, name: str) -> None:
+        """A startup-release failure left the gang stuck in its init
+        containers (the exec channel failed and no HTTP coordination is
+        configured) — the reconciler requeues with backoff."""
+        key = job_key(namespace, name)
+        with self._lock:
+            self._gang_stranded[key] = self._gang_stranded.get(key, 0) + 1
+        self.flight.record(namespace, name, "gang_stranded")
+        tracer().event("gang_stranded", job=key)
+
     def observe_checkpoint_save(self, namespace: str, name: str,
                                 step: int) -> None:
         key = job_key(namespace, name)
@@ -254,6 +279,8 @@ class JobMetrics:
             self._barrier_wait.pop(key, None)
             self._releases.pop(key, None)
             self._drains.pop(key, None)
+            self._sched_evictions.pop(key, None)
+            self._gang_stranded.pop(key, None)
             self._ckpt_saves.pop(key, None)
             self._ckpt_corrupt.pop(key, None)
             self._ckpt_restore_step.pop(key, None)
@@ -288,6 +315,8 @@ class JobMetrics:
             barrier = dict(self._barrier_wait)
             releases = dict(self._releases)
             drains = dict(self._drains)
+            sched_evictions = dict(self._sched_evictions)
+            gang_stranded = dict(self._gang_stranded)
             ckpt_saves = dict(self._ckpt_saves)
             ckpt_corrupt = dict(self._ckpt_corrupt)
             ckpt_restore = dict(self._ckpt_restore_step)
@@ -360,6 +389,23 @@ class JobMetrics:
             for key in sorted(drains):
                 lines.append('tpujob_drain_notices_total{job="%s"} %d'
                              % (esc(key), drains[key]))
+        if sched_evictions:
+            lines.append("# HELP tpujob_sched_evictions_total Fleet-"
+                         "arbiter preemptions handled (victim gang "
+                         "drained, job re-queued; no restart budget "
+                         "spent).")
+            lines.append("# TYPE tpujob_sched_evictions_total counter")
+            for key in sorted(sched_evictions):
+                lines.append('tpujob_sched_evictions_total{job="%s"} %d'
+                             % (esc(key), sched_evictions[key]))
+        if gang_stranded:
+            lines.append("# HELP tpujob_gang_stranded_total Reconcile "
+                         "passes that found the gang stranded in init "
+                         "containers by a failed startup release.")
+            lines.append("# TYPE tpujob_gang_stranded_total counter")
+            for key in sorted(gang_stranded):
+                lines.append('tpujob_gang_stranded_total{job="%s"} %d'
+                             % (esc(key), gang_stranded[key]))
         if ckpt_saves:
             lines.append("# HELP tpujob_checkpoint_saves_total Committed "
                          "checkpoint saves observed.")

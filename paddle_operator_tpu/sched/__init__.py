@@ -1,0 +1,34 @@
+"""Fleet scheduler: multi-tenant TPU capacity arbitration above the
+reconciler.
+
+* :mod:`.capacity` — the fleet model: slices/chips from Node pool state.
+* :mod:`.fairshare` — priority tiers + DRF-style weighted fair share.
+* :mod:`.arbiter` — :class:`FleetArbiter`: admission, shrink-before-evict,
+  checkpoint-cost-aware preemption through the graceful-drain path.
+
+See docs/design.md "Fleet scheduling & multi-tenancy".
+"""
+
+from .arbiter import (  # noqa: F401
+    ANNOT_CKPT_STEP, ANNOT_PROGRESS_STEP, ANNOT_RESTORE_NP,
+    ANNOT_SCHED_EVICT, Decision, FleetArbiter, annotation_ckpt_info,
+    checkpoint_staleness,
+)
+from .capacity import (  # noqa: F401
+    FleetCapacity, FleetSnapshot, job_chip_demand, make_tpu_node,
+)
+from .fairshare import (  # noqa: F401
+    ANNOT_ARRIVAL, ANNOT_TENANT_WEIGHT, PREEMPTION_POLICIES,
+    PRIORITY_CLASSES, ShareTable, effective_priority, fair_order,
+    preemption_policy, tenant_of, tenant_weight,
+)
+
+__all__ = [
+    "ANNOT_ARRIVAL", "ANNOT_CKPT_STEP", "ANNOT_PROGRESS_STEP",
+    "ANNOT_RESTORE_NP", "ANNOT_SCHED_EVICT", "ANNOT_TENANT_WEIGHT",
+    "Decision", "FleetArbiter", "FleetCapacity", "FleetSnapshot",
+    "PREEMPTION_POLICIES", "PRIORITY_CLASSES", "ShareTable",
+    "annotation_ckpt_info", "checkpoint_staleness", "effective_priority",
+    "fair_order", "job_chip_demand", "make_tpu_node", "preemption_policy",
+    "tenant_of", "tenant_weight",
+]

@@ -1,0 +1,869 @@
+"""FleetArbiter — the multi-tenant capacity arbiter above the reconciler.
+
+The per-job gang gate (Volcano PodGroup phase) admits first-come; under a
+fleet of competing TpuJobs that degenerates to FIFO with random
+starvation. The arbiter replaces that ordering with a fleet-wide
+scheduling pass (*Singularity*, arXiv 2202.07848: transparent
+checkpoint-preemption makes jobs movable, so a global scheduler can pack
+the fleet; *elastic multi-tenant GPU clusters*, arXiv 1909.11985:
+shrink-before-evict):
+
+1. **Capacity**: :class:`~.capacity.FleetCapacity` — chips from Node
+   pool state. No TPU nodes registered → capacity unknown → admit all
+   (drop-in safe).
+2. **Order**: priority tiers (descending), and inside a tier running
+   jobs before queued ones (run-to-completion: an equal-priority arrival
+   never churns a running gang), queued jobs interleaved by weighted
+   fair share (:mod:`.fairshare`).
+3. **Allocate** greedily in that order. An elastic job that no longer
+   fits whole is allocated *shrunk* (down to its ``worker.requests``
+   floor) before anyone is evicted; a running job that cannot fit even
+   at its floor is **evicted** — and because running jobs are served
+   stalest-checkpoint-first, the victim forced out is always the one
+   with the FRESHEST checkpoint, i.e. the cheapest to preempt (its
+   drain re-saves the least work).
+4. **Act**: shrinks rewrite ``spec.worker.replicas`` (riding the
+   existing elastic resize path: epoch bump, scale-down with drain-ack);
+   evictions stamp :data:`ANNOT_SCHED_EVICT` on the victim and drain its
+   pods through the evictor (grace-window eviction — the PR 5 path that
+   cuts a final checkpoint), so the victim resumes later with no lost
+   steps. Both are undone automatically: the original ``np`` rides
+   :data:`ANNOT_RESTORE_NP` and is restored when pressure subsides.
+
+The reconciler consults :meth:`decide` where the gang gate used to be;
+everything the arbiter knows is recomputed from cluster state, so an
+operator restart loses nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..api import types as api
+from ..controllers.helper import ANNOT_SCHED_EVICT, ANNOT_SCHED_RESTORE_NP
+from ..k8s.errors import ApiError, ConflictError, NotFoundError
+from ..k8s.runtime import escape_label_value
+from ..utils.trace import tracer
+from .capacity import FleetCapacity, FleetSnapshot, job_chip_demand
+from .fairshare import (
+    PREEMPT_NEVER, ShareTable, arrival_key, effective_priority, fair_order,
+    preemption_policy, tenant_of, tenant_weight,
+)
+
+#: re-exported spellings (the canonical constants live in
+#: controllers.helper so the reconciler needs no sched import)
+ANNOT_RESTORE_NP = ANNOT_SCHED_RESTORE_NP
+#: worker-stamped checkpoint facts the default cost model reads
+ANNOT_CKPT_STEP = "batch.tpujob.dev/latest-checkpoint-step"
+ANNOT_PROGRESS_STEP = "batch.tpujob.dev/progress-step"
+
+ADMIT, SHRINK, QUEUE, EVICT = "admit", "shrink", "queue", "evict"
+
+#: indirection so tests can fake the clock without patching time itself
+_monotonic = time.monotonic
+
+
+def annotation_ckpt_info(job: api.TpuJob) -> Optional[dict]:
+    """Default checkpoint-cost source: the runner (or harness) stamps the
+    latest committed checkpoint step and the current progress step as job
+    annotations; staleness is their gap."""
+    annots = job.metadata.get("annotations") or {}
+    if ANNOT_CKPT_STEP not in annots and ANNOT_PROGRESS_STEP not in annots:
+        return None
+    try:
+        step = int(annots.get(ANNOT_CKPT_STEP) or 0)
+        progress = int(annots.get(ANNOT_PROGRESS_STEP) or step)
+    except ValueError:
+        return None
+    return {"step": step, "progress": progress}
+
+
+def checkpoint_staleness(job: api.TpuJob, ckpt_info) -> int:
+    """Steps of work at risk if this job is preempted right now (0 = a
+    checkpoint covers everything it has done)."""
+    info = ckpt_info(job) if ckpt_info is not None else None
+    if not info:
+        return 0
+    return max(0, int(info.get("progress", 0)) - int(info.get("step", 0)))
+
+
+@dataclass
+class Decision:
+    """What the reconciler's scheduling gate acts on."""
+
+    admitted: bool
+    reason: str = ""
+    retry_after: float = 1.0
+    #: the allocated worker np for arbitrated jobs (None = job not
+    #: arbitrated). The gate compares this against the spec it HOLDS:
+    #: decide() may have just realigned spec.worker.replicas, and a
+    #: reconcile pass that kept reading its pre-align object would
+    #: create the gang at a stale size.
+    np: Optional[int] = None
+
+
+@dataclass
+class _Target:
+    state: str
+    np: int
+    desired_np: int
+    chips: int
+    priority: int
+    ready: bool = True
+    reason: str = ""
+
+
+@dataclass
+class _Plan:
+    snapshot: Optional[FleetSnapshot]
+    targets: Dict[Tuple[str, str], _Target] = field(default_factory=dict)
+    allocated_chips: int = 0
+    shares: Dict[str, float] = field(default_factory=dict)
+    #: chip-demanding jobs this plan saw but deliberately left alone
+    #: (mid-completion gangs) — decide() must not force a replan for
+    #: them, or every gate consult would burn a full-fleet pass
+    skipped: Set[Tuple[str, str]] = field(default_factory=set)
+
+
+class FleetArbiter:
+    """One scheduling brain per operator process. Thread-safe; all state
+    is a cache over cluster objects (restart-survivable by construction).
+
+    ``mode="fifo"`` is the naive baseline the chaos goodput invariant
+    measures against: strict arrival order, head-of-line blocking, no
+    shrink, no preemption.
+    """
+
+    def __init__(self, client, evictor: Optional[Callable] = None,
+                 job_metrics=None, mode: str = "fair",
+                 drain_grace: int = 3,
+                 ckpt_info: Callable[[api.TpuJob], Optional[dict]]
+                 = annotation_ckpt_info,
+                 decision_log_depth: int = 256,
+                 replan_interval: float = 0.5,
+                 clock: Callable[[], float] = None):
+        self.client = client
+        self.capacity = FleetCapacity(client)
+        # evictor(pod_dict, grace_seconds): production uses the eviction
+        # API (here: a graceful delete); harnesses inject the pod-sim's
+        # eviction-with-grace so the drain window is observable
+        self.evictor = evictor or self._delete_evictor
+        self.obs = job_metrics
+        self.mode = mode
+        self.drain_grace = int(drain_grace)
+        self.ckpt_info = ckpt_info
+        self._lock = threading.Lock()
+        self._plan: Optional[_Plan] = None
+        self._plan_rv: Optional[str] = None
+        # Real apiservers expose no global resourceVersion to key the
+        # plan cache on; there, replans are bounded to one per
+        # ``replan_interval`` seconds so N requeuing jobs cannot drive
+        # N full-fleet list+write bursts per second through one lock.
+        # (Fake-client harnesses take the rv path and never consult the
+        # clock, so chaos determinism is unaffected.)
+        self._replan_interval = replan_interval
+        self._clock = clock if clock is not None else _monotonic
+        self._plan_t: Optional[float] = None
+        # np the arbiter last WROTE per job: lets a replan distinguish
+        # its own shrink from a user's spec edit (see _desired_np).
+        # In-memory only — after an operator restart the parked
+        # annotation is simply trusted again.
+        self._written_np: Dict[Tuple[str, str], int] = {}
+        self._passes = 0
+        self._preempts: Dict[str, int] = {}
+        self._shrinks: Dict[str, int] = {}
+        #: bounded, deterministic audit trail of preempt/shrink decisions
+        #: (the chaos invariants replay it); oldest entries drop first
+        self.decision_log: List[dict] = []
+        self._log_depth = decision_log_depth
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def poke(self) -> None:
+        """Replan (and act) if the cluster changed — called from passes
+        that carry no admission question themselves (e.g. a completed
+        job's cleanup) so capacity they free flows back out (queued
+        admissions, parked-np restores) without waiting for a queued
+        job's next requeue poll."""
+        with self._lock:
+            self._replan_locked()
+
+    def decide(self, job: api.TpuJob) -> Decision:
+        """The reconciler's scheduling gate: may this job's gang exist
+        right now? Replans lazily when the cluster changed."""
+        key = (job.namespace, job.name)
+        with self._lock:
+            self._replan_locked()
+            assert self._plan is not None
+            target = self._plan.targets.get(key)
+            if (target is None
+                    and key not in self._plan.skipped
+                    and job.phase not in (api.Phase.COMPLETED,
+                                          api.Phase.FAILED)
+                    and job_chip_demand(job, self._desired_np(job)) > 0):
+                # A chip-demanding job the cached plan has never seen —
+                # created inside the rv/TTL cache window — must not
+                # slip through unarbitrated (a full fleet would
+                # overcommit, permanently if the job is rigid): force
+                # one fresh pass so it gets a real target. A job the
+                # fresh pass STILL does not cover (list lag) is marked
+                # skipped so it cannot force again until the next plan.
+                self._plan_rv = None
+                self._plan_t = None
+                self._replan_locked()
+                target = self._plan.targets.get(key)
+                if target is None:
+                    self._plan.skipped.add(key)
+        if target is None:
+            # zero-demand, terminal, or mid-completion: not arbitrated
+            return Decision(True)
+        if target.state in (ADMIT, SHRINK) and target.ready:
+            return Decision(True, np=target.np)
+        if target.state in (ADMIT, SHRINK):
+            return Decision(False, target.reason or
+                            "admitted; waiting for capacity to drain")
+        return Decision(False, target.reason or "queued for fleet capacity")
+
+    def metrics_block(self) -> str:
+        esc = escape_label_value
+        with self._lock:
+            plan = self._plan
+            passes = self._passes
+            preempts = dict(self._preempts)
+            shrinks = dict(self._shrinks)
+        lines = [
+            "# HELP tpujob_sched_passes_total Fleet scheduling passes "
+            "executed.",
+            "# TYPE tpujob_sched_passes_total counter",
+            "tpujob_sched_passes_total %d" % passes,
+        ]
+        if plan is not None and plan.snapshot is not None:
+            snap = plan.snapshot
+            states = [t.state for t in plan.targets.values()]
+            lines += [
+                "# HELP tpujob_sched_fleet_chips Schedulable TPU chips "
+                "in the fleet (from Node pools).",
+                "# TYPE tpujob_sched_fleet_chips gauge",
+                "tpujob_sched_fleet_chips %d" % snap.fleet_chips,
+                "# HELP tpujob_sched_allocated_chips Chips allocated by "
+                "the last scheduling pass.",
+                "# TYPE tpujob_sched_allocated_chips gauge",
+                "tpujob_sched_allocated_chips %d" % plan.allocated_chips,
+                "# HELP tpujob_sched_admitted_jobs Jobs holding an "
+                "allocation after the last pass.",
+                "# TYPE tpujob_sched_admitted_jobs gauge",
+                "tpujob_sched_admitted_jobs %d"
+                % sum(1 for s in states if s in (ADMIT, SHRINK)),
+                "# HELP tpujob_sched_queued_jobs Jobs waiting for fleet "
+                "capacity after the last pass.",
+                "# TYPE tpujob_sched_queued_jobs gauge",
+                "tpujob_sched_queued_jobs %d"
+                % sum(1 for s in states if s in (QUEUE, EVICT)),
+            ]
+            if plan.shares:
+                lines += [
+                    "# HELP tpujob_sched_tenant_share Weighted dominant "
+                    "share (chips/weight) per tenant at the last pass.",
+                    "# TYPE tpujob_sched_tenant_share gauge",
+                ]
+                for tenant in sorted(plan.shares):
+                    share = plan.shares[tenant]
+                    lines.append(
+                        'tpujob_sched_tenant_share{tenant="%s"} %s'
+                        % (esc(tenant), "+Inf" if share == float("inf")
+                           else "%.6f" % share))
+        if preempts:
+            lines += [
+                "# HELP tpujob_sched_preempt_decisions_total Scheduler "
+                "preemption decisions, by victim job.",
+                "# TYPE tpujob_sched_preempt_decisions_total counter",
+            ]
+            for victim in sorted(preempts):
+                lines.append(
+                    'tpujob_sched_preempt_decisions_total{victim="%s"} %d'
+                    % (esc(victim), preempts[victim]))
+        if shrinks:
+            lines += [
+                "# HELP tpujob_sched_shrink_decisions_total Scheduler "
+                "shrink decisions, by job.",
+                "# TYPE tpujob_sched_shrink_decisions_total counter",
+            ]
+            for job in sorted(shrinks):
+                lines.append(
+                    'tpujob_sched_shrink_decisions_total{job="%s"} %d'
+                    % (esc(job), shrinks[job]))
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------
+    # planning
+    # ------------------------------------------------------------------
+
+    def _replan_locked(self) -> None:
+        rv = _cluster_rv(self.client)
+        if self._plan is not None:
+            if rv is not None and rv == self._plan_rv:
+                return
+            if (rv is None and self._plan_t is not None
+                    and self._clock() - self._plan_t
+                    < self._replan_interval):
+                return  # real apiserver: bound full-fleet replans
+        with tracer().span("sched_pass", mode=self.mode) as span:
+            plan = self._compute_plan()
+            self._apply_plan(plan)
+            states = [t.state for t in plan.targets.values()]
+            span.set(jobs=len(plan.targets),
+                     admitted=sum(1 for s in states
+                                  if s in (ADMIT, SHRINK)),
+                     queued=sum(1 for s in states if s == QUEUE),
+                     evicted=sum(1 for s in states if s == EVICT),
+                     allocated_chips=plan.allocated_chips)
+        self._plan = plan
+        self._passes += 1
+        # capture the rv AFTER apply so the arbiter's own writes do not
+        # immediately invalidate the plan it just made
+        self._plan_rv = _cluster_rv(self.client)
+        self._plan_t = self._clock()
+
+    def _log(self, entry: dict) -> None:
+        self.decision_log.append(entry)
+        if len(self.decision_log) > self._log_depth:
+            del self.decision_log[:len(self.decision_log) - self._log_depth]
+
+    def _jobs(self) -> List[api.TpuJob]:
+        return [api.TpuJob(o) for o in self.client.list(api.KIND)]
+
+    def _worker_pods(self, job: api.TpuJob) -> List[dict]:
+        return [pod for pod in self.client.list_owned("Pod", job.obj)
+                if (pod["metadata"].get("annotations") or {})
+                .get(api.ANNOT_RESOURCE) == api.RES_WORKER]
+
+    def _live_worker_pods(self, job: api.TpuJob) -> List[dict]:
+        out = []
+        for pod in self._worker_pods(job):
+            phase = (pod.get("status") or {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                continue
+            out.append(pod)
+        return out
+
+    def _desired_np(self, job: api.TpuJob) -> int:
+        """The user's np: the parked original when the arbiter shrank
+        the job, else the current spec. If spec.worker.replicas differs
+        from what the arbiter itself last wrote, the USER edited it
+        mid-shrink — their value becomes the new desired np (the stale
+        parked annotation must not resurrect a size they gave up)."""
+        annots = job.metadata.get("annotations") or {}
+        worker = job.spec.get(api.RES_WORKER) or {}
+        cur = int(worker.get("replicas") or 0)
+        parked = annots.get(ANNOT_RESTORE_NP)
+        if parked is None:
+            return cur
+        written = self._written_np.get((job.namespace, job.name))
+        if written is not None and cur != written:
+            return cur  # user edit wins; _align_np re-parks or clears
+        try:
+            return max(cur, int(parked))
+        except ValueError:
+            return cur
+
+    @staticmethod
+    def _min_np(job: api.TpuJob) -> Optional[int]:
+        """Smallest np the job accepts, or None when it cannot shrink:
+        only elastic jobs without a pinned slice topology are malleable
+        (a fixed topology is a physical slice shape — it cannot shrink
+        in place)."""
+        if job.elastic is None or job.tpu.get("topology"):
+            return None
+        worker = job.spec.get(api.RES_WORKER) or {}
+        lo = worker.get("requests")
+        try:
+            lo = int(lo) if lo is not None else 1
+        except (TypeError, ValueError):
+            lo = 1
+        return max(1, lo)
+
+    def _compute_plan(self) -> _Plan:
+        snap = self.capacity.snapshot()
+        plan = _Plan(snapshot=snap)
+        jobs = self._jobs()
+        candidates: List[api.TpuJob] = []
+        live_chips: Dict[Tuple[str, str], int] = {}
+        draining: Dict[Tuple[str, str], bool] = {}
+        completing_live = 0
+        for job in jobs:
+            if job.phase in (api.Phase.COMPLETED, api.Phase.FAILED):
+                continue
+            if job_chip_demand(job, self._desired_np(job)) <= 0:
+                continue  # non-TPU / zero workers: not arbitrated
+            key = (job.namespace, job.name)
+            all_pods = self._worker_pods(job)
+            pods = [p for p in all_pods
+                    if (p.get("status") or {}).get("phase")
+                    not in ("Succeeded", "Failed")]
+            if any((p.get("status") or {}).get("phase") == "Succeeded"
+                   for p in all_pods):
+                # mid-completion: workers are exiting 0 — resizing or
+                # re-admitting now would wedge a half-succeeded gang;
+                # leave it alone, reserving its FULL gang (not just the
+                # live pods): the reconciler may still recreate a
+                # hard-killed member of the gang, and chips granted away
+                # in that window would transiently exceed the fleet
+                completing_live += max(
+                    len(pods) * job.tpu_chips_per_host(),
+                    job_chip_demand(job, self._desired_np(job)))
+                plan.skipped.add(key)
+                continue
+            live_chips[key] = len(pods) * job.tpu_chips_per_host()
+            draining[key] = bool(pods) and all(
+                p["metadata"].get("deletionTimestamp") for p in pods)
+            candidates.append(job)
+        if snap is None:
+            # capacity unknown: admit everything (pre-arbiter behavior)
+            for job in candidates:
+                key = (job.namespace, job.name)
+                np = self._desired_np(job)
+                plan.targets[key] = _Target(
+                    ADMIT, np, np, job_chip_demand(job, np),
+                    effective_priority(job))
+            return plan
+        total_live = sum(live_chips.values()) + completing_live
+        # Placement sanity for pinned slice shapes: a job whose topology
+        # requires more chips per slice than the largest pool offers can
+        # NEVER schedule — admitting it would hold (and preempt for) an
+        # allocation no node pool can realize. It parks as queued with
+        # the reason on its Events. (Topology-less jobs are arbitrated
+        # chip-granularly; see docs/design.md.)
+        placeable = []
+        for job in candidates:
+            key = (job.namespace, job.name)
+            chips = job_chip_demand(job, self._desired_np(job))
+            per_slice = chips // job.tpu_num_slices()
+            if job.tpu.get("topology") and per_slice > snap.slice_chips:
+                plan.targets[key] = _Target(
+                    QUEUE, 0, self._desired_np(job), chips,
+                    effective_priority(job),
+                    reason="unplaceable: topology needs a %d-chip slice "
+                           "but the largest pool has %d chips"
+                           % (per_slice, snap.slice_chips))
+                continue
+            placeable.append(job)
+        candidates = placeable
+        if self.mode == "fifo":
+            self._plan_fifo(plan, candidates, live_chips, total_live)
+        else:
+            self._plan_fair(plan, candidates, live_chips, draining,
+                            total_live)
+        # prune the own-write ledger to live arbitrated jobs so memory
+        # stays bounded across job churn
+        self._written_np = {k: v for k, v in self._written_np.items()
+                            if k in plan.targets}
+        return plan
+
+    class _Realized:
+        """Stateful physical-headroom ledger: an allocation is only
+        actionable once its chips are free ON THE NODES — pods of
+        victims (or of the job's own previous incarnation) occupy
+        their chips until fully drained. Consuming through one ledger
+        (in allocation order) keeps two pending admits from both
+        claiming the same free chips."""
+
+        def __init__(self, fleet: int, total_live: int):
+            self.free = fleet - total_live
+
+        def claim(self, target: "_Target", live_self: int) -> None:
+            need = max(0, target.chips - live_self)
+            if need > self.free:
+                target.ready = False
+                target.reason = ("admitted; waiting for capacity to "
+                                 "drain")
+                return
+            self.free -= need
+
+    def _plan_fifo(self, plan: _Plan, candidates, live_chips,
+                   total_live) -> None:
+        """The naive baseline: arrival order, gang-or-nothing, stop at
+        the first job that does not fit (head-of-line blocking)."""
+        fleet = plan.snapshot.fleet_chips
+        remaining = fleet
+        realized = self._Realized(fleet, total_live)
+        blocked = False
+        for job in sorted(candidates, key=arrival_key):
+            key = (job.namespace, job.name)
+            np = self._desired_np(job)
+            chips = job_chip_demand(job, np)
+            prio = effective_priority(job)
+            if not blocked and chips <= remaining:
+                target = _Target(ADMIT, np, np, chips, prio)
+                realized.claim(target, live_chips.get(key, 0))
+                remaining -= chips
+                plan.allocated_chips += chips
+            else:
+                blocked = True  # FIFO: nothing behind the head may pass
+                target = _Target(QUEUE, 0, np, chips, prio,
+                                 reason="queued for fleet capacity "
+                                        "(FIFO order)")
+            plan.targets[key] = target
+
+    def _plan_fair(self, plan: _Plan, candidates, live_chips, draining,
+                   total_live) -> None:
+        fleet = plan.snapshot.fleet_chips
+        remaining = fleet
+        # Entries already in plan.targets here are unplaceable parks
+        # (topology outgrew the largest pool, e.g. a node vanished under
+        # a RUNNING gang). Their live pods still occupy chips that no
+        # one else can be granted — planning the full fleet over them
+        # would admit allocations that can never realize concurrently.
+        for pkey in plan.targets:
+            if live_chips.get(pkey, 0) and not draining.get(pkey):
+                remaining -= live_chips[pkey]
+        realized = self._Realized(fleet, total_live)
+        table = ShareTable()
+        for job in candidates:
+            table.note_weight(tenant_of(job), tenant_weight(job))
+
+        # Rigid reservations first: a running NON-elastic job has no
+        # whole-slice restart machinery, so preempting it would turn its
+        # drained pods into a terminal Failed — the arbiter never evicts
+        # or shrinks one. Its chips come off the top; higher-priority
+        # demand that needs them simply waits (ready=False) until the
+        # job completes.
+        rigid_keys = set()
+        for job in candidates:
+            key = (job.namespace, job.name)
+            if (job.elastic is None and live_chips.get(key, 0) > 0
+                    and not draining.get(key)):
+                np = self._desired_np(job)
+                chips = job_chip_demand(job, np)
+                prio = effective_priority(job)
+                plan.targets[key] = _Target(ADMIT, np, np, chips, prio)
+                remaining -= chips
+                plan.allocated_chips += chips
+                table.add(tenant_of(job), chips)
+                rigid_keys.add(key)
+
+        tiers: Dict[int, List[api.TpuJob]] = {}
+        for job in candidates:
+            if (job.namespace, job.name) in rigid_keys:
+                continue
+            tiers.setdefault(effective_priority(job), []).append(job)
+
+        def protected_below(prio: int) -> int:
+            """Chips running lower-priority (non-rigid) jobs are
+            entitled to keep — capacity a preemptionPolicy=Never job
+            must not claim (rigid jobs' chips are already off the top).
+            Each is protected at max(live, guaranteed floor): a gang
+            momentarily below its floor (pod died, still creating)
+            would otherwise be displaced by a job whose contract is
+            "waits for free capacity and displaces no one"."""
+            out = 0
+            for other in candidates:
+                okey = (other.namespace, other.name)
+                if okey in rigid_keys:
+                    continue
+                if (effective_priority(other) < prio
+                        and live_chips.get(okey, 0) > 0
+                        and not draining.get(okey)):
+                    onp = self._desired_np(other)
+                    floor = self._min_np(other)
+                    guarantee = ((min(floor, onp) if floor is not None
+                                  else onp)
+                                 * other.tpu_chips_per_host())
+                    out += max(live_chips[okey], guarantee)
+            return out
+
+        top_admitted_prio: Optional[int] = None
+        # elastic jobs running below their own np (previously shrunk):
+        # growing back is OPPORTUNISTIC — it happens from whatever is
+        # left after every tier is served, never at a peer's expense
+        growth: List[Tuple[int, api.TpuJob]] = []
+        for prio in sorted(tiers, reverse=True):
+            tier = tiers[prio]
+            # run-to-completion: running gangs of this tier allocate
+            # before queued arrivals; stalest checkpoint first, so under
+            # pressure the job squeezed out is the freshest-checkpointed
+            # one — the cheapest victim (ROADMAP item 1 / Singularity)
+            running = [j for j in tier
+                       if live_chips.get((j.namespace, j.name), 0) > 0
+                       and not draining.get((j.namespace, j.name))]
+            queued = [j for j in tier if j not in running]
+            running.sort(key=lambda j: (
+                -checkpoint_staleness(j, self.ckpt_info), arrival_key(j)))
+            for job in running:
+                key = (job.namespace, job.name)
+                np = self._desired_np(job)
+                cph = job.tpu_chips_per_host()
+                min_np = self._min_np(job)
+                # WATER-FILLING shrink-before-evict: every malleable
+                # running job is guaranteed only its floor here; the
+                # leftover is handed back toward desired np by the
+                # growth queue below. Under pressure that shrinks ALL
+                # peers to their floors before anyone is evicted; with
+                # no pressure floor+growth reassembles the full np in
+                # the same plan, so nothing is ever actually resized.
+                guarantee_np = min(min_np, np) if min_np is not None \
+                    else np
+                chips = guarantee_np * cph
+                staleness = checkpoint_staleness(job, self.ckpt_info)
+                if chips <= remaining:
+                    state = ADMIT if guarantee_np == np else SHRINK
+                    target = _Target(state, guarantee_np, np, chips, prio,
+                                     reason="" if state == ADMIT
+                                     else "shrunk to yield capacity")
+                else:
+                    plan.targets[key] = _Target(
+                        EVICT, 0, np, 0, prio,
+                        reason="preempted for higher-priority work",
+                    )
+                    self._log({"action": EVICT,
+                               "victim": "%s/%s" % key,
+                               "victim_priority": prio,
+                               "top_admitted_priority": top_admitted_prio,
+                               "staleness": staleness,
+                               # unshrinkable outright, or floor pinned
+                               # at full size: either way the job would
+                               # not yield chips short of eviction
+                               "refused_shrink": (min_np is None
+                                                  or min_np >= np)})
+                    continue
+                realized.claim(target, live_chips.get(key, 0))
+                remaining -= target.chips
+                plan.allocated_chips += target.chips
+                table.add(tenant_of(job), target.chips)
+                if top_admitted_prio is None:
+                    top_admitted_prio = prio
+                plan.targets[key] = target
+                if target.np < np:
+                    growth.append((prio, job))
+            for job in fair_order(queued, table,
+                                  lambda j: job_chip_demand(
+                                      j, self._desired_np(j))):
+                key = (job.namespace, job.name)
+                np = self._desired_np(job)
+                chips = job_chip_demand(job, np)
+                min_np = self._min_np(job)
+                cph = job.tpu_chips_per_host()
+                if (preemption_policy(job) == PREEMPT_NEVER
+                        and chips > remaining - protected_below(prio)):
+                    plan.targets[key] = _Target(
+                        QUEUE, 0, np, chips, prio,
+                        reason="preemptionPolicy=Never waits for free "
+                               "capacity")
+                    continue
+                if chips <= remaining:
+                    target = _Target(ADMIT, np, np, chips, prio)
+                elif (min_np is not None
+                      and min_np * cph <= remaining):
+                    fit_np = max(min_np, remaining // cph)
+                    target = _Target(SHRINK, fit_np, np, fit_np * cph,
+                                     prio, reason="admitted shrunk")
+                else:
+                    plan.targets[key] = _Target(
+                        QUEUE, 0, np, chips, prio,
+                        reason="queued for fleet capacity")
+                    continue
+                realized.claim(target, live_chips.get(key, 0))
+                remaining -= target.chips
+                plan.allocated_chips += target.chips
+                table.add(tenant_of(job), target.chips)
+                if top_admitted_prio is None:
+                    top_admitted_prio = prio
+                plan.targets[key] = target
+                if target.np < np:
+                    growth.append((prio, job))
+        # opportunistic growth: hand leftover chips back to shrunk jobs,
+        # highest priority first (then arrival order) — pure backfill,
+        # no one loses anything they were allocated above. Growth is
+        # capped by the PHYSICAL headroom too: chips a draining victim
+        # still occupies cannot be granted yet (they will be, next pass,
+        # once the drain completes).
+        for prio, job in sorted(
+                growth, key=lambda pj: (-pj[0], arrival_key(pj[1]))):
+            if remaining <= 0:
+                break
+            key = (job.namespace, job.name)
+            target = plan.targets.get(key)
+            if (target is None or not target.ready
+                    or target.state not in (ADMIT, SHRINK)):
+                continue
+            cph = job.tpu_chips_per_host()
+            live_self = live_chips.get(key, 0)
+            # chips the job already physically holds beyond its current
+            # target are its own to grow back into; anything further
+            # must come from free nodes
+            headroom = realized.free + max(0, live_self - target.chips)
+            grow_np = min(target.desired_np,
+                          target.np + min(remaining, headroom) // cph)
+            if grow_np <= target.np:
+                continue
+            added = (grow_np - target.np) * cph
+            realized.free -= max(0, target.chips + added
+                                 - max(live_self, target.chips))
+            target.np = grow_np
+            target.chips += added
+            target.state = (ADMIT if grow_np == target.desired_np
+                            else SHRINK)
+            if target.state == ADMIT:
+                target.reason = ""
+            remaining -= added
+            plan.allocated_chips += added
+            table.add(tenant_of(job), added)
+        plan.shares = table.snapshot()
+
+    # ------------------------------------------------------------------
+    # acting on the plan
+    # ------------------------------------------------------------------
+
+    def _apply_plan(self, plan: _Plan) -> None:
+        for key, target in sorted(plan.targets.items()):
+            try:
+                if target.state in (ADMIT, SHRINK):
+                    self._align_np(key, target)
+                elif target.state == EVICT:
+                    self._evict(key, target)
+            except (ApiError, NotFoundError):
+                # a failed write is retried by the next pass (the plan is
+                # recomputed from cluster state, nothing is lost)
+                continue
+
+    def _align_np(self, key: Tuple[str, str], target: _Target) -> None:
+        """Make spec.worker.replicas match the allocation, parking or
+        restoring the job's own np through ANNOT_RESTORE_NP. No-op when
+        already aligned (plan stability depends on that)."""
+        for _attempt in range(3):
+            try:
+                obj = self.client.get(api.KIND, *key)
+            except NotFoundError:
+                return
+            job = api.TpuJob(obj)
+            worker = job.spec.get(api.RES_WORKER)
+            if worker is None:
+                return
+            if self._desired_np(job) != target.desired_np:
+                # The user edited replicas after this plan was computed
+                # (the conflict-retry would otherwise re-apply the
+                # planned np right over their edit and park a stale
+                # restore value). Their value re-baselines desired np —
+                # drop the write and let the next pass replan from it.
+                target.ready = False
+                return
+            annots = job.metadata.setdefault("annotations", {})
+            cur = int(worker.get("replicas") or 0)
+            dirty = False
+            if target.state == SHRINK and target.np < target.desired_np:
+                # also refreshes a stale parked value after a user edit
+                # re-baselined desired_np
+                if annots.get(ANNOT_RESTORE_NP) != str(target.desired_np):
+                    annots[ANNOT_RESTORE_NP] = str(target.desired_np)
+                    dirty = True
+            elif ANNOT_RESTORE_NP in annots:
+                del annots[ANNOT_RESTORE_NP]
+                dirty = True
+            if cur != target.np and target.np > 0:
+                worker["replicas"] = target.np
+                dirty = True
+            if not dirty:
+                if cur != target.np:
+                    # replicas write skipped (np==0): treat as unaligned
+                    target.ready = False
+                    return
+                self._written_np[key] = target.np
+                return
+            try:
+                self.client.update(obj)
+            except ConflictError:
+                continue
+            self._written_np[key] = target.np
+            if target.state == SHRINK and target.np < target.desired_np:
+                jkey = "%s/%s" % key
+                self._shrinks[jkey] = self._shrinks.get(jkey, 0) + 1
+                self._log({"action": SHRINK, "job": jkey,
+                           "np": target.np,
+                           "desired_np": target.desired_np,
+                           "priority": target.priority})
+                if self.obs is not None:
+                    self.obs.flight.record(key[0], key[1], "sched_shrink",
+                                           np=target.np,
+                                           desired_np=target.desired_np)
+                tracer().event("sched_shrink", job=jkey, np=target.np,
+                               desired_np=target.desired_np)
+            return
+        target.ready = False
+        target.reason = "awaiting resize to allocated np"
+
+    def _evict(self, key: Tuple[str, str], target: _Target) -> None:
+        """Stamp the victim and drain its gang through the evictor. The
+        reconciler's drain handler sees ANNOT_SCHED_EVICT and books the
+        incident as a scheduler preemption (no restart budget spent)."""
+        try:
+            obj = self.client.get(api.KIND, *key)
+        except NotFoundError:
+            return
+        job = api.TpuJob(obj)
+        pods = self._live_worker_pods(job)
+        fresh = [p for p in pods
+                 if not p["metadata"].get("deletionTimestamp")]
+        if not fresh:
+            return  # drain already under way (or gang already gone)
+        if not self._stamp_evict_annotation(key):
+            # the marker did not persist (conflict churn / job gone):
+            # draining anyway would book the voluntary eviction against
+            # the victim's preemption-restart budget — retry next pass
+            return
+        jkey = "%s/%s" % key
+        self._preempts[jkey] = self._preempts.get(jkey, 0) + 1
+        if self.obs is not None:
+            self.obs.flight.record(key[0], key[1], "sched_preempt",
+                                   pods=len(fresh),
+                                   priority=target.priority)
+        tracer().event("sched_preempt", job=jkey, pods=len(fresh),
+                       priority=target.priority)
+        for pod in fresh:
+            self.evictor(pod, self.drain_grace)
+
+    def _stamp_evict_annotation(self, key: Tuple[str, str]) -> bool:
+        """True when the marker is persisted (or already was)."""
+        for _attempt in range(3):
+            try:
+                obj = self.client.get(api.KIND, *key)
+            except NotFoundError:
+                return False
+            annots = obj["metadata"].setdefault("annotations", {})
+            if annots.get(ANNOT_SCHED_EVICT):
+                return True
+            annots[ANNOT_SCHED_EVICT] = "true"
+            try:
+                self.client.update(obj)
+                return True
+            except ConflictError:
+                continue
+        return False
+
+    def _delete_evictor(self, pod: dict, grace_seconds: int) -> None:
+        """Production fallback: a plain graceful delete (the apiserver
+        grants the pod its terminationGracePeriod — the kubelet delivers
+        SIGTERM and the runner's drain hook cuts the final checkpoint)."""
+        meta = pod["metadata"]
+        try:
+            self.client.delete("Pod", meta.get("namespace", "default"),
+                               meta["name"])
+        except (NotFoundError, ApiError):
+            pass
+
+
+def _cluster_rv(client) -> Optional[str]:
+    """Walk wrapper chains (CachedKubeClient.inner, ChaosKubeClient.inner)
+    to the store that knows the global resourceVersion; None for real
+    apiservers (the arbiter then replans on every gate consult)."""
+    seen = 0
+    while client is not None and seen < 8:
+        rv = getattr(client, "resource_version", None)
+        if rv is not None:
+            return rv
+        client = getattr(client, "inner", None)
+        seen += 1
+    return None

@@ -1,0 +1,129 @@
+"""Fleet capacity model: TPU slices/chips derived from node-pool state.
+
+The fleet the arbiter packs is whatever the apiserver says it is: ``Node``
+objects carrying the GKE TPU labels (``cloud.google.com/gke-nodepool`` —
+one node pool IS one physical slice — and the accelerator selector) and a
+``google.com/tpu`` allocatable quantity. :class:`FleetCapacity` folds them
+into a :class:`FleetSnapshot`: total schedulable chips plus the per-pool
+(per-slice) breakdown the metrics surface.
+
+A cluster with no TPU nodes registered answers ``None`` — capacity
+unknown — and the arbiter admits everything (the pre-arbiter behavior), so
+wiring the arbiter into a harness without nodes changes nothing.
+
+Demand is counted in chips: a TpuJob's worker gang of ``np`` hosts needs
+``np × chipsPerHost`` (``job_chip_demand``). Non-TPU jobs demand 0 TPU
+chips and pass straight through admission.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..api import types as api
+from ..controllers import helper
+
+log = logging.getLogger("tpujob.sched")
+
+
+@dataclass(frozen=True)
+class FleetSnapshot:
+    """Immutable view of the schedulable TPU fleet at one instant."""
+
+    fleet_chips: int
+    #: pool name (== physical slice) -> chips in that pool
+    pools: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def slices(self) -> int:
+        return len(self.pools)
+
+    @property
+    def slice_chips(self) -> int:
+        """Chips of the largest single slice — the biggest ICI domain a
+        single-slice job could occupy."""
+        return max(self.pools.values()) if self.pools else 0
+
+
+class FleetCapacity:
+    """Reads the fleet from ``Node`` objects on every snapshot — the
+    arbiter re-reads per scheduling pass, so node-pool resizes (autoscaler,
+    maintenance drains deleting nodes) show up without restarts."""
+
+    def __init__(self, client):
+        self.client = client
+        self._last: Optional[FleetSnapshot] = None
+        self._list_failing = False
+
+    def snapshot(self) -> Optional[FleetSnapshot]:
+        try:
+            nodes = self.client.list("Node")
+        except Exception as e:
+            # A transient list failure must NOT read as "no TPU fleet"
+            # — snapshot None flips the arbiter into admit-everything,
+            # and one 500 during a pass with queued demand would
+            # overcommit the fleet. Plan against the last known fleet
+            # instead (None only before the first successful list).
+            # Log once per failure streak: a PERSISTENT error (RBAC
+            # Forbidden, bad apiserver URL) otherwise leaves no clue why
+            # arbitration never engages.
+            if not self._list_failing:
+                self._list_failing = True
+                log.error(
+                    "fleet capacity: Node list failed (%s); planning "
+                    "against %s", e,
+                    "the last known fleet" if self._last is not None
+                    else "no capacity data — admitting everything")
+            return self._last
+        self._list_failing = False
+        pools: Dict[str, int] = {}
+        for node in nodes:
+            alloc = (node.get("status") or {}).get("allocatable") or {}
+            try:
+                chips = int(str(alloc.get(helper.TPU_RESOURCE, 0)))
+            except ValueError:
+                continue
+            if chips <= 0:
+                continue
+            labels = (node.get("metadata") or {}).get("labels") or {}
+            pool = labels.get(helper.GKE_NODEPOOL_TOPOLOGY, "default")
+            pools[pool] = pools.get(pool, 0) + chips
+        if not pools:
+            # a successful list with no TPU nodes really is "no fleet"
+            self._last = None
+            return None
+        self._last = FleetSnapshot(fleet_chips=sum(pools.values()),
+                                   pools=pools)
+        return self._last
+
+
+def make_tpu_node(name: str, pool: str, chips: int,
+                  accelerator: str = "v5e") -> dict:
+    """A Node manifest shaped like a GKE TPU node-pool member — what tests
+    and the chaos harness feed the FakeKubeClient to define a fleet."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {
+                helper.GKE_NODEPOOL_TOPOLOGY: pool,
+                helper.GKE_TPU_ACCEL_SELECTOR: api.TPU_GKE_ACCELERATOR.get(
+                    accelerator, api.TPU_GKE_ACCELERATOR["v5e"]),
+            },
+        },
+        "status": {"allocatable": {helper.TPU_RESOURCE: str(chips)}},
+    }
+
+
+def job_chip_demand(job: api.TpuJob, np: Optional[int] = None) -> int:
+    """TPU chips a worker gang of ``np`` hosts occupies (0 for non-TPU
+    jobs — they are invisible to the chip arbiter)."""
+    if job.device != api.Device.TPU:
+        return 0
+    if np is None:
+        worker = job.spec.get(api.RES_WORKER) or {}
+        np = int(worker.get("replicas") or 0)
+    return max(0, int(np)) * job.tpu_chips_per_host()

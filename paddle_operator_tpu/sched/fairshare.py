@@ -1,0 +1,182 @@
+"""Priority tiers + weighted fair-share accounting for the fleet arbiter.
+
+Priority comes from the pod-template scheduling fields the CRD already
+carries (api/crd.py pod template: ``priority`` / ``priorityClassName`` /
+``preemptionPolicy``) — until now they passed through unconsumed. An
+explicit integer ``priority`` wins; otherwise ``priorityClassName`` (or
+``spec.schedulingPolicy.priorityClass``) resolves through
+:data:`PRIORITY_CLASSES`; the default is 0.
+
+Fair share is DRF-style with one dominant resource (TPU chips are the only
+contended resource the arbiter manages): each tenant's share is
+``allocated_chips / weight``, and within a priority tier queued jobs are
+interleaved by picking the tenant with the smallest weighted share next.
+A tenant is ``spec.schedulingPolicy.queue`` when set, else the job's
+namespace; weight comes from the job annotation
+``batch.tpujob.dev/tenant-weight`` (a tenant's weight is the max any of
+its jobs declares — documented in docs/design.md). Weight <= 0 means
+"scavenger": the tenant's share is infinite, so it is served only after
+every positive-weight tenant in the tier.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from ..api import types as api
+
+#: priorityClassName -> priority value. The two ``system-`` names mirror
+#: the Kubernetes built-ins; the ``tpu-`` tiers are this operator's.
+PRIORITY_CLASSES: Dict[str, int] = {
+    "system-node-critical": 2000001000,
+    "system-cluster-critical": 2000000000,
+    "tpu-high": 1000,
+    "tpu-standard": 100,
+    "tpu-low": 10,
+}
+
+#: the only preemptionPolicy values Kubernetes defines
+PREEMPTION_POLICIES = ("PreemptLowerPriority", "Never")
+PREEMPT_LOWER = "PreemptLowerPriority"
+PREEMPT_NEVER = "Never"
+
+ANNOT_TENANT_WEIGHT = "batch.tpujob.dev/tenant-weight"
+#: arrival sequence stamped by submitters that need sub-second FIFO
+#: ordering (creationTimestamp has 1s resolution)
+ANNOT_ARRIVAL = "batch.tpujob.dev/arrival-seq"
+
+
+def _worker_template_spec(job: api.TpuJob) -> dict:
+    worker = job.spec.get(api.RES_WORKER) or {}
+    return (worker.get("template") or {}).get("spec") or {}
+
+
+def effective_priority(job: api.TpuJob) -> int:
+    """Resolve the job's scheduling priority from the worker pod template
+    (explicit integer wins), falling back through priorityClassName and
+    schedulingPolicy.priorityClass to 0."""
+    tmpl = _worker_template_spec(job)
+    if tmpl.get("priority") is not None:
+        try:
+            return int(tmpl["priority"])
+        except (TypeError, ValueError):
+            pass
+    for cls in (tmpl.get("priorityClassName"),
+                (job.scheduling_policy or {}).get("priorityClass")):
+        if cls and cls in PRIORITY_CLASSES:
+            return PRIORITY_CLASSES[cls]
+    return 0
+
+
+def preemption_policy(job: api.TpuJob) -> str:
+    """Worker-template preemptionPolicy; anything unset or unknown means
+    the Kubernetes default, PreemptLowerPriority (the webhook rejects
+    unknown values at admission, so this fallback is belt-and-braces)."""
+    policy = _worker_template_spec(job).get("preemptionPolicy")
+    return policy if policy in PREEMPTION_POLICIES else PREEMPT_LOWER
+
+
+def tenant_of(job: api.TpuJob) -> str:
+    sp = job.scheduling_policy or {}
+    return sp.get("queue") or job.namespace
+
+
+def tenant_weight(job: api.TpuJob) -> float:
+    ann = (job.metadata.get("annotations") or {}).get(ANNOT_TENANT_WEIGHT)
+    if ann is None:
+        return 1.0
+    try:
+        w = float(ann)
+    except ValueError:
+        return 1.0
+    # float() happily parses "nan"/"inf": NaN poisons the min()-based
+    # pick (every comparison is False, pinning the tenant to the head
+    # of the queue) and inf makes the share permanently 0 with the same
+    # effect — treat both like the <= 0 scavenger case
+    return w if math.isfinite(w) else 0.0
+
+
+def arrival_key(job: api.TpuJob):
+    """FIFO ordering key: creationTimestamp, then the explicit arrival
+    sequence annotation (sub-second arrivals), then name."""
+    meta = job.metadata
+    ann = (meta.get("annotations") or {}).get(ANNOT_ARRIVAL)
+    try:
+        seq = int(ann) if ann is not None else 0
+    except ValueError:
+        seq = 0
+    return (meta.get("creationTimestamp") or "", seq, job.namespace,
+            job.name)
+
+
+class ShareTable:
+    """Weighted dominant-share ledger: tenant -> allocated chips.
+
+    ``pick`` answers which of several tenants should be served next —
+    the one with the smallest ``chips / weight`` (ties by tenant name,
+    so the order is total and deterministic)."""
+
+    def __init__(self) -> None:
+        self._chips: Dict[str, int] = {}
+        self._weights: Dict[str, float] = {}
+
+    def clone(self) -> "ShareTable":
+        """Scratch copy for what-if ordering: fair_order charges demand
+        progressively to decide who goes next, but a job that ends up
+        DENIED must not leave its demand on the real ledger (a tenant
+        being refused capacity must not be penalized for asking)."""
+        out = ShareTable()
+        out._chips = dict(self._chips)
+        out._weights = dict(self._weights)
+        return out
+
+    def note_weight(self, tenant: str, weight: float) -> None:
+        """A tenant's weight is the max any of its jobs declares."""
+        cur = self._weights.get(tenant)
+        if cur is None or weight > cur:
+            self._weights[tenant] = weight
+
+    def add(self, tenant: str, chips: int) -> None:
+        self._chips[tenant] = self._chips.get(tenant, 0) + chips
+
+    def share(self, tenant: str) -> float:
+        weight = self._weights.get(tenant, 1.0)
+        chips = self._chips.get(tenant, 0)
+        if weight <= 0.0:
+            return float("inf")
+        return chips / weight
+
+    def pick(self, tenants: List[str]) -> Optional[str]:
+        if not tenants:
+            return None
+        return min(tenants, key=lambda t: (self.share(t), t))
+
+    def snapshot(self) -> Dict[str, float]:
+        return {t: self.share(t) for t in self._chips}
+
+
+def fair_order(jobs: List[api.TpuJob], table: ShareTable,
+               demand_of) -> List[api.TpuJob]:
+    """Interleave queued jobs of one tier by weighted fair share:
+    repeatedly serve the min-share tenant's oldest job, charging its
+    demand to a SCRATCH copy of the table so the next pick reflects it
+    (``demand_of(job) -> chips``). The caller's table is never mutated —
+    real allocations are charged by the allocator, so denied demand
+    does not distort lower tiers or the exported share gauge."""
+    scratch = table.clone()
+    by_tenant: Dict[str, List[api.TpuJob]] = {}
+    for job in jobs:
+        scratch.note_weight(tenant_of(job), tenant_weight(job))
+        by_tenant.setdefault(tenant_of(job), []).append(job)
+    for queue in by_tenant.values():
+        queue.sort(key=arrival_key)
+    out: List[api.TpuJob] = []
+    while by_tenant:
+        tenant = scratch.pick(sorted(by_tenant))
+        job = by_tenant[tenant].pop(0)
+        if not by_tenant[tenant]:
+            del by_tenant[tenant]
+        scratch.add(tenant, demand_of(job))
+        out.append(job)
+    return out

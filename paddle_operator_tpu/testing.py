@@ -33,6 +33,7 @@ class OperatorHarness:
         namespace: Optional[str] = None,
         http_coordination: bool = False,
         client_middleware=None,
+        arbiter_factory=None,
     ):
         self.client = FakeKubeClient()
         self.client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
@@ -49,6 +50,12 @@ class OperatorHarness:
         self._namespace = namespace
         self._http_coordination = http_coordination
         self._client_middleware = client_middleware
+        # optional fleet arbiter (sched.FleetArbiter): factory(client,
+        # job_metrics) — a factory, not an instance, because the arbiter
+        # is operator memory and must be rebuilt by restart_operator()
+        # (its whole state is a cache over cluster objects)
+        self._arbiter_factory = arbiter_factory
+        self.arbiter = None
         self.coord_server = None
         self._build_operator()
 
@@ -85,6 +92,10 @@ class OperatorHarness:
                 self.cached_client, ":0",
                 job_metrics=self.job_metrics).start()
             coord_url = self.coord_server.url
+        self.arbiter = None
+        if self._arbiter_factory is not None:
+            self.arbiter = self._arbiter_factory(self.cached_client,
+                                                 self.job_metrics)
         self.reconciler = TpuJobReconciler(
             self.cached_client,
             scheduling=self._scheduling,
@@ -95,10 +106,13 @@ class OperatorHarness:
             kv_store=self.kv,
             coordination_url=coord_url,
             job_metrics=self.job_metrics,
+            arbiter=self.arbiter,
         )
         self.manager = Manager(self.cached_client, namespace=self._namespace,
                                cache=self.cache)
         self.manager.add_metrics_provider(self.job_metrics.metrics_block)
+        if self.arbiter is not None:
+            self.manager.add_metrics_provider(self.arbiter.metrics_block)
         self.controller = self.manager.add_controller(
             "tpujob",
             self.reconciler.reconcile,
@@ -118,8 +132,15 @@ class OperatorHarness:
             racedetect.guard_fields(self.job_metrics, "_lock", [
                 "_phase", "_hist", "_hist_sum", "_hist_count",
                 "_restarts", "_resizes", "_barrier_wait", "_releases",
-                "_drains", "_ckpt_saves", "_ckpt_corrupt",
-                "_ckpt_restore_step"])
+                "_drains", "_sched_evictions", "_gang_stranded",
+                "_ckpt_saves", "_ckpt_corrupt", "_ckpt_restore_step"])
+            if self.arbiter is not None:
+                # decision_log is deliberately unguarded: the chaos
+                # auditor and tests read it post-quiescence without the
+                # lock (all writes happen inside _replan_locked)
+                racedetect.guard_fields(self.arbiter, "_lock", [
+                    "_plan", "_plan_rv", "_plan_t", "_passes",
+                    "_preempts", "_shrinks", "_written_np"])
             racedetect.guard_fields(self.reconciler, "_err_lock",
                                     ["_err_streak", "_err_hit"])
             if self.coord_server is not None:

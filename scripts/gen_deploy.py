@@ -39,6 +39,10 @@ def operator_manifests(namespace=NAMESPACE, image=IMAGE, jobnamespace=""):
             {"apiGroups": [""], "resources": ["pods"],
              "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
             {"apiGroups": [""], "resources": ["pods/status"], "verbs": ["get"]},
+            # the fleet arbiter (--fleet-sched, sched/capacity.py) reads
+            # TPU node-pool capacity from Node objects
+            {"apiGroups": [""], "resources": ["nodes"],
+             "verbs": ["get", "list", "watch"]},
             # no pods/exec: the HTTP coordination channel replaced the
             # reference's exec push (controllers/coordination.py)
             {"apiGroups": [""], "resources": ["services"],

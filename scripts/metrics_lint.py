@@ -37,24 +37,70 @@ def selftest_text() -> str:
     """Drive a real harness lifecycle (with an adversarial job name) so
     the linted text contains every family a production scrape can emit:
     controller counters, JobMetrics gauges/histograms/restart counters,
-    and the chaos fault provider."""
+    the chaos fault provider, and the fleet arbiter's tpujob_sched_*
+    families (fleet gauges + preempt/shrink decision counters)."""
     from paddle_operator_tpu.api import types as api
     from paddle_operator_tpu.chaos.api_faults import FaultInjector
+    from paddle_operator_tpu.sched import FleetArbiter, make_tpu_node
     from paddle_operator_tpu.testing import OperatorHarness
 
-    h = OperatorHarness()
+    # lint-tpu reports a stale checkpoint so it is served (shrunk)
+    # first; checkpoint-less lint-low2 counts as freshest and is the
+    # one squeezed out — the documented victim ranking
+    ckpt = {"lint-tpu": {"progress": 100, "step": 0}}
+    h = OperatorHarness(
+        arbiter_factory=lambda c, m: FleetArbiter(
+            c, job_metrics=m, ckpt_info=lambda j: ckpt.get(j.name)))
     injector = FaultInjector()
     injector.record("api_error")
     h.manager.add_metrics_provider(injector.metrics_block)
+    # a 2-pool fleet + REAL contention so the sched families populate:
+    # two running low-priority jobs (one in an adversarial tenant) are
+    # displaced by a high-priority arrival — one SHRUNK (shrink decision
+    # counter, and its allocated chips carry the evil tenant through the
+    # share gauge), one EVICTED (preempt decision counter)
+    for i in range(2):
+        h.client.create(make_tpu_node("n%d" % i, "pool-%d" % i, 16))
     role = {"replicas": 1, "template": {"spec": {"containers": [
         {"name": "main", "image": "img"}]}}}
     h.create_job(api.new_tpujob("lint-job", spec={"worker": role}))
+    tpu_role = {"replicas": 2, "requests": 1, "template": {"spec": {
+        "containers": [{"name": "main", "image": "img"}],
+        "priorityClassName": "tpu-low"}}}
+    h.create_job(api.new_tpujob("lint-tpu", spec={
+        "device": "tpu", "tpu": {"accelerator": "v5e"},
+        "worker": tpu_role, "elastic": 1,
+        "schedulingPolicy": {"queue": 'evil"tenant\\x'}}))
+    h.create_job(api.new_tpujob("lint-low2", spec={
+        "device": "tpu", "tpu": {"accelerator": "v5e"},
+        "worker": {"replicas": 1, "requests": 1, "template": {"spec": {
+            "containers": [{"name": "main", "image": "img"}],
+            "priorityClassName": "tpu-low"}}},
+        "elastic": 1}))
+    h.converge()
+    h.create_job(api.new_tpujob("lint-high", spec={
+        "device": "tpu", "tpu": {"accelerator": "v5e"},
+        "worker": {"replicas": 3, "requests": 3, "template": {"spec": {
+            "containers": [{"name": "main", "image": "img"}],
+            "priorityClassName": "tpu-high"}}},
+        "elastic": 1}))
     h.converge()
     # a webhook-bypassed write can carry quotes/backslashes in names —
     # feed one straight into the collector to prove escaping holds
     h.job_metrics.observe_phase("default", 'evil"name\\x', "Pending")
     h.job_metrics.observe_restart("default", 'evil"name\\x', "oom")
-    return h.manager.metrics_text()
+    h.job_metrics.observe_sched_eviction("default", 'evil"name\\x')
+    h.job_metrics.observe_gang_stranded("default", 'evil"name\\x')
+    text = h.manager.metrics_text()
+    # the coverage this selftest claims must actually be in the text —
+    # a scenario drift that stops exercising these emitters should fail
+    # loudly here, not ship an unlinted family
+    for fam in ("tpujob_sched_tenant_share",
+                "tpujob_sched_preempt_decisions_total",
+                "tpujob_sched_shrink_decisions_total"):
+        assert "# TYPE %s" % fam in text, "selftest lost %s" % fam
+    assert 'tenant="evil' in text, "adversarial tenant label missing"
+    return text
 
 
 def main(argv=None) -> int:

@@ -11,7 +11,8 @@ import yaml
 
 from paddle_operator_tpu.api import types as api
 from paddle_operator_tpu.controllers.webhook import (
-    AdmissionWebhookServer, self_signed_cert, validate_admission)
+    AdmissionWebhookServer, self_signed_cert, validate_admission,
+    validate_scheduling)
 
 
 def _review(obj, uid="u1"):
@@ -50,6 +51,84 @@ def test_validate_admission_denies_semantic_error():
     job["spec"]["worker"]["replicas"] = -2
     out = validate_admission(_review(job))
     assert out["response"]["allowed"] is False
+
+
+def _sched_job(**tmpl):
+    job = _good_job()
+    job["spec"]["worker"]["template"]["spec"].update(tmpl)
+    return job
+
+
+def test_webhook_rejects_negative_priority():
+    out = validate_admission(_review(_sched_job(priority=-5)))
+    assert out["response"]["allowed"] is False
+    assert "priority must be >= 0" in out["response"]["status"]["message"]
+    assert validate_scheduling(_sched_job(priority=0)) == []
+
+
+def test_webhook_rejects_non_integer_priority():
+    # JSON whole-valued floats satisfy the CRD's OpenAPI integer check
+    # but would sneak a negative (or fractional) rank past the sign
+    # check above; bools are int subclasses and equally meaningless
+    for bad in (-5.0, 5.0, 1.5, True, "10"):
+        errs = validate_scheduling(_sched_job(priority=bad))
+        assert errs and "must be an integer" in errs[0], bad
+
+
+def test_webhook_rejects_unknown_preemption_policy():
+    out = validate_admission(
+        _review(_sched_job(preemptionPolicy="EvictEveryone")))
+    assert out["response"]["allowed"] is False
+    assert "preemptionPolicy" in out["response"]["status"]["message"]
+    for ok in ("PreemptLowerPriority", "Never"):
+        assert validate_scheduling(_sched_job(preemptionPolicy=ok)) == []
+
+
+def test_webhook_rejects_priority_class_conflicts():
+    # unknown class (with or without an explicit priority): it would
+    # silently resolve to priority 0, so it is rejected outright
+    errs = validate_scheduling(
+        _sched_job(priorityClassName="mystery", priority=5))
+    assert errs and "not a class this operator resolves" in errs[0]
+    errs = validate_scheduling(_sched_job(priorityClassName="tpu-hgih"))
+    assert errs and "not a class this operator resolves" in errs[0]
+    # spec.schedulingPolicy.priorityClass takes the same check
+    job = _good_job()
+    job["spec"]["schedulingPolicy"] = {"priorityClass": "mystery"}
+    errs = validate_scheduling(job)
+    assert errs and "schedulingPolicy.priorityClass" in errs[0]
+    job["spec"]["schedulingPolicy"] = {"priorityClass": "tpu-high"}
+    assert validate_scheduling(job) == []
+    # a known schedulingPolicy class contradicted by an explicit
+    # template priority is rejected like the template-level pair
+    job = _sched_job(priority=5)
+    job["spec"]["schedulingPolicy"] = {"priorityClass": "tpu-high"}
+    errs = validate_scheduling(job)
+    assert errs and "contradicts" in errs[0]
+    # ...and so is a template class that resolves differently from it
+    job = _sched_job(priorityClassName="tpu-low")
+    job["spec"]["schedulingPolicy"] = {"priorityClass": "tpu-high"}
+    errs = validate_scheduling(job)
+    assert errs and "silently win" in errs[0]
+    job = _sched_job(priorityClassName="tpu-high")
+    job["spec"]["schedulingPolicy"] = {"priorityClass": "tpu-high"}
+    assert validate_scheduling(job) == []
+    # known class with a DIFFERENT explicit value: contradiction
+    errs = validate_scheduling(
+        _sched_job(priorityClassName="tpu-high", priority=5))
+    assert errs and "resolves to 1000" in errs[0]
+    # known class with the matching value (or alone): fine
+    assert validate_scheduling(
+        _sched_job(priorityClassName="tpu-high", priority=1000)) == []
+    assert validate_scheduling(
+        _sched_job(priorityClassName="tpu-high")) == []
+    out = validate_admission(
+        _review(_sched_job(priorityClassName="tpu-high", priority=5)))
+    assert out["response"]["allowed"] is False
+
+
+def test_webhook_scheduling_fields_pass_when_absent():
+    assert validate_scheduling(_good_job()) == []
 
 
 def test_validate_admission_ignores_other_kinds():
