@@ -4,15 +4,15 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
 # the default pre-merge gate: project lint + the fast suite + the fast
 # suite again under the runtime race detector (docs/static-analysis.md)
 # + one seed of each durable-recovery chaos scenario + the fleet-
-# scheduler fast lane
-verify: analyze test-fast race recovery sched
+# scheduler fast lane + the quick control-plane load profile
+verify: analyze test-fast race recovery sched loadtest
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -49,7 +49,8 @@ analyze:
 # jax-version reasons — they would mask this gate's signal).
 race:
 	env TPUJOB_RACE_DETECT=1 $(PY) -m pytest -x -q -m "not slow" \
-	  tests/test_analysis.py tests/test_chaos.py tests/test_coordination.py \
+	  tests/test_analysis.py tests/test_chaos.py \
+	  tests/test_control_plane.py tests/test_coordination.py \
 	  tests/test_data.py tests/test_elastic_e2e.py tests/test_fake_client.py \
 	  tests/test_helper.py tests/test_hostport_elastic_server.py \
 	  tests/test_http_client.py tests/test_informer.py \
@@ -92,6 +93,15 @@ obs:
 
 metrics-lint:
 	$(PY) scripts/metrics_lint.py --selftest
+
+# control-plane load harness (docs/design.md "Control-plane scale"):
+#   loadtest — quick 1k-job profile: bring-up, read-only resync,
+#              RTT-modeled churn through the threaded parallel queue;
+#              asserts per-key ordering and a parallel-vs-baseline floor
+#   the full 1k/5k/10k curve (BENCH_CONTROL_PLANE.json) is
+#   `python scripts/perf_control_plane.py` with no flags
+loadtest:
+	$(PY) scripts/perf_control_plane.py --quick
 
 bench:
 	$(PY) bench.py

@@ -39,7 +39,8 @@ from ..k8s.errors import NotFoundError
 from ..testing import OperatorHarness
 from .api_faults import ChaosKubeClient, FaultInjector
 from .data_faults import run_loader_scenario
-from .plan import CONTROL_SCENARIOS, ChaosPlan, build_plan
+from .plan import (CONTROL_SCENARIOS, STORM_DRAIN_WORKERS, STORM_ELASTIC,
+                   STORM_PLAIN, ChaosPlan, build_plan)
 from .pod_faults import PodChaos
 
 
@@ -79,11 +80,23 @@ class ChaosReport:
 
     def summary_line(self) -> str:
         faults = " ".join("%s=%d" % kv for kv in sorted(self.faults.items()))
-        jobs = " ".join(
-            "%s=%s(pr=%d,ar=%d)" % (name, st["phase"],
-                                    st["preemptionRestarts"],
-                                    st["appFailureRestarts"])
-            for name, st in sorted(self.jobs.items()))
+        if len(self.jobs) > 12:
+            # fleet-scale scenarios: a phase histogram instead of 500
+            # per-job entries (the fingerprint keeps the full table)
+            phases: Dict[str, int] = {}
+            pr = ar = 0
+            for st in self.jobs.values():
+                phases[st["phase"]] = phases.get(st["phase"], 0) + 1
+                pr += st["preemptionRestarts"]
+                ar += st["appFailureRestarts"]
+            jobs = " ".join("%s=%d" % kv for kv in sorted(phases.items()))
+            jobs += " pr=%d ar=%d" % (pr, ar)
+        else:
+            jobs = " ".join(
+                "%s=%s(pr=%d,ar=%d)" % (name, st["phase"],
+                                        st["preemptionRestarts"],
+                                        st["appFailureRestarts"])
+                for name, st in sorted(self.jobs.items()))
         extra = ""
         if self.extra:
             extra = "  " + " ".join(
@@ -105,7 +118,16 @@ class ChaosHarness:
                              % plan.scenario)
         self.plan = plan
         self.injector = FaultInjector()
+        # the storm runs the PARALLEL queue: drain() pops a batch of
+        # drain_workers keys before processing any — deterministic, but
+        # the per-key exclusivity/dirty-requeue machinery runs exactly
+        # as under real threads. It also skips the coordination init
+        # container (covered by every other scenario) so 500-job
+        # bring-up measures the reconcile machinery, not exec churn.
+        storm = plan.scenario == "control_plane_storm"
+        self.drain_workers = STORM_DRAIN_WORKERS if storm else 1
         self.h = OperatorHarness(
+            init_image="" if storm else "docker.io/library/busybox:1",
             client_middleware=lambda c: ChaosKubeClient(c, self.injector))
         self.h.manager.add_metrics_provider(self.injector.metrics_block)
         self.pod_chaos = PodChaos(self.h.sim, self.h.client, self.injector)
@@ -114,6 +136,10 @@ class ChaosHarness:
         self._rng = random.Random("chaos-run:%s:%d"
                                   % (plan.scenario, plan.seed))
         self._jobs: List[str] = []
+        # per-job injected-kill ledger: the restarts-vs-kills invariant
+        # must charge a job only for ITS incidents (in a 500-job storm a
+        # healthy job coexists with kills aimed elsewhere)
+        self._kills_by_job: Dict[str, int] = {}
         # operator_crash bookkeeping: restart-budget floors + job set
         # captured at the instant of the crash — the rebuilt operator must
         # never lose a job or reset a budget below these
@@ -157,6 +183,17 @@ class ChaosHarness:
                 "tpu": {"accelerator": "v5e", "topology": "4x8"},
                 "worker": self._role(4), "elastic": 1,
             }))
+        elif s == "control_plane_storm":
+            for i in range(STORM_PLAIN):
+                self._add_job(api.new_tpujob(
+                    "storm-%04d" % i, spec={"worker": self._role(1)}))
+            for i in range(STORM_ELASTIC):
+                self._add_job(api.new_tpujob("storm-e%02d" % i, spec={
+                    "device": "tpu",
+                    "tpu": {"accelerator": "v5e", "topology": "2x4",
+                            "chipsPerHost": 4},
+                    "worker": self._role(2), "elastic": 1,
+                }))
 
     def _add_job(self, job: dict) -> None:
         self.h.create_job(job)
@@ -195,6 +232,7 @@ class ChaosHarness:
             if not pods:
                 return
             pod = pods[self._rng.randrange(len(pods))]
+            self._count_kill(p["job"])
             if ev.kind == "pod_preempt":
                 self.pod_chaos.preempt(pod)
             else:
@@ -204,6 +242,7 @@ class ChaosHarness:
                     if (pod.get("status") or {}).get("phase")
                     not in ("Failed", "Succeeded")]
             if pods:
+                self._count_kill(p["job"], n=len(pods))
                 self.pod_chaos.drain_slice(pods)
         elif ev.kind == "graceful_drain":
             pods = [pod for pod in self._job_pods(p["job"])
@@ -214,12 +253,31 @@ class ChaosHarness:
                 return
             grace = int(p.get("grace", 3))
             if p.get("all"):
+                self._count_kill(p["job"], n=len(pods))
                 self.pod_chaos.drain_slice(pods, grace_seconds=grace)
             else:
                 pod = pods[self._rng.randrange(len(pods))]
+                self._count_kill(p["job"])
                 self.pod_chaos.preempt(pod, grace_seconds=grace)
         elif ev.kind == "operator_crash":
             self._crash_operator()
+        elif ev.kind == "job_submit":
+            # late-arrival churn (control_plane_storm)
+            self._add_job(api.new_tpujob(p["name"], spec={
+                "worker": self._role(int(p.get("replicas", 1)))}))
+            self.injector.record("job_submit")
+        elif ev.kind == "job_delete":
+            name = self._jobs[p["index"] % len(self._jobs)]
+            try:
+                self.h.client.delete(api.KIND, "default", name)
+            except NotFoundError:
+                return  # double-picked: already deleted
+            self.injector.record("job_delete")
+        elif ev.kind == "resync_surge":
+            # the full-fleet normal-lane backlog the priority lanes are
+            # measured against: every primary key re-enqueued at once
+            self.h.manager.enqueue_all()
+            self.injector.record("resync_surge")
         elif ev.kind == "elastic_resize":
             self.injector.record("elastic_resize")
 
@@ -232,6 +290,9 @@ class ChaosHarness:
                 pass
         else:
             raise ValueError("unknown fault kind %r" % ev.kind)
+
+    def _count_kill(self, job: str, n: int = 1) -> None:
+        self._kills_by_job[job] = self._kills_by_job.get(job, 0) + n
 
     def _crash_operator(self) -> None:
         """Tear the Manager/Reconciler/cache down mid-incident and build a
@@ -272,7 +333,7 @@ class ChaosHarness:
                 self._fire(events.popleft())
                 fired = True
             rv_before = self.h.client.resource_version
-            self.h.manager.drain()
+            self.h.manager.drain(workers=self.drain_workers)
             sim_changed = self.h.sim.step()
             self.pod_chaos.tick()
             # deferred counts as pending work: an error-backoff retry parked
@@ -293,10 +354,18 @@ class ChaosHarness:
                 stable = 0
         violations = self.check_invariants(converged, ticks)
         jobs = self._job_states()
+        extra = {}
+        if self.drain_workers > 1:
+            # the parallel queue's audit counters join the determinism
+            # fingerprint: a same-seed replay must make the same lane
+            # decisions, not just reach the same end state
+            extra = {"wq_%s" % k: v for k, v in sorted(
+                self.h.manager.controllers[0].queue.stats().items())}
         self.h.close()
         return ChaosReport(self.plan.scenario, self.plan.seed, converged,
                            ticks, dict(self.injector.counts), jobs,
-                           violations, time.perf_counter() - t0)
+                           violations, time.perf_counter() - t0,
+                           extra=extra)
 
     def _job_states(self) -> Dict[str, dict]:
         out = {}
@@ -342,7 +411,23 @@ class ChaosHarness:
                              % (kind, meta.get("name"), ref.get("kind"),
                                 ref.get("name")))
 
-        kills = self.injector.kill_count()
+        # "priority lane never starved": while incident keys (deletes,
+        # drains — the high lane) were queued, the pick policy bounds how
+        # many routine-resync pops could cut ahead of any one of them:
+        # the high keys ahead of it in FIFO order, interleaved with one
+        # normal pop per normal_share consecutive high pops.
+        for ctrl in self.h.manager.controllers:
+            stats = ctrl.queue.stats()
+            if stats["high_pops"]:
+                bound = (stats["max_high_depth"] // ctrl.queue.normal_share
+                         + 2)
+                if stats["max_normal_behind_high"] > bound:
+                    v.append(
+                        "priority lane starved on %s: a high key waited "
+                        "behind %d normal pops (policy bound %d; %r)"
+                        % (ctrl.name, stats["max_normal_behind_high"],
+                           bound, stats))
+
         for name in self._jobs:
             try:
                 job = api.TpuJob(store.get(api.KIND, "default", name))
@@ -375,9 +460,13 @@ class ChaosHarness:
             if ar > helper.app_failure_budget(job):
                 v.append("job %s appFailureRestarts %d exceeds budget %d"
                          % (name, ar, helper.app_failure_budget(job)))
+            # restarts are charged against the kills injected at THIS
+            # job — in a 500-job storm a healthy bystander must not be
+            # excused (or blamed) by incidents aimed elsewhere
+            kills = self._kills_by_job.get(name, 0)
             if pr + ar > kills:
                 v.append("job %s counted %d restarts but only %d kills "
-                         "were injected" % (name, pr + ar, kills))
+                         "were injected at it" % (name, pr + ar, kills))
             if kills and job.elastic is not None and \
                     phase == api.Phase.RUNNING and pr + ar == 0:
                 v.append("job %s recovered to Running but no restart "

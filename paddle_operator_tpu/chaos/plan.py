@@ -55,9 +55,17 @@ from typing import Dict, List, Tuple
 #: invariant.
 CONTROL_SCENARIOS = (
     "preemption_burst", "apiserver_flake", "slice_drain_resize",
-    "graceful_drain", "operator_crash",
+    "graceful_drain", "operator_crash", "control_plane_storm",
 )
 SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant")
+
+#: control_plane_storm fleet shape: 500+ TpuJobs (the ISSUE-7 scale bar)
+#: churning through the PARALLEL workqueue (drain workers > 1) while api
+#: faults, watch drops, deletes and drains land on top of a full-fleet
+#: resync surge. Elastic TPU jobs are the drain/preempt targets.
+STORM_PLAIN = 460
+STORM_ELASTIC = 40
+STORM_DRAIN_WORKERS = 4
 
 
 @dataclass(frozen=True)
@@ -104,6 +112,7 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "slice_drain_resize": _slice_drain_resize,
         "graceful_drain": _graceful_drain,
         "operator_crash": _operator_crash,
+        "control_plane_storm": _control_plane_storm,
         "loader_faults": _loader_faults,
         "multi_tenant": _multi_tenant,
     }[scenario]
@@ -299,6 +308,48 @@ def _multi_tenant(rng: random.Random, quick: bool
             {"code": rng.choice([409, 500, 503]),
              "count": rng.randint(1, 2)}))
     return events, 200 if quick else 300
+
+
+def _control_plane_storm(rng: random.Random, quick: bool
+                         ) -> Tuple[List[FaultEvent], int]:
+    """Fleet-scale control-plane churn (ISSUE 7): 500 jobs created at
+    tick 0 (the harness workload), late-arrival waves, then a full-fleet
+    ``resync_surge`` (500+ normal-lane keys) with deletes, graceful
+    drains and hard preemptions landing ON TOP of the backlog — the
+    incidents ride the high-priority lane and must not wait out the
+    surge. Apiserver errors and a dropped pod watch run throughout. The
+    harness drains with STORM_DRAIN_WORKERS deterministic parallel
+    workers, so the per-key exclusivity/dirty-requeue machinery is
+    exercised on every tick."""
+    events: List[FaultEvent] = []
+    for j in range(rng.randint(20, 40)):
+        events.append(FaultEvent(rng.randint(2, 18), "job_submit",
+                                 {"name": "late-%03d" % j, "replicas": 1}))
+    surge_at = rng.randint(6, 12)
+    events.append(FaultEvent(surge_at, "resync_surge", {}))
+    # deletes land while the surge backlog is at its deepest
+    for _ in range(rng.randint(8, 16)):
+        events.append(FaultEvent(surge_at + rng.randint(0, 3), "job_delete",
+                                 {"index": rng.randrange(10_000)}))
+    for _ in range(rng.randint(3, 6)):
+        events.append(FaultEvent(
+            rng.randint(4, 20), "graceful_drain",
+            {"job": "storm-e%02d" % rng.randrange(STORM_ELASTIC),
+             "grace": rng.randint(2, 3)}))
+    for _ in range(rng.randint(2, 4)):
+        events.append(FaultEvent(
+            rng.randint(2, 20), "pod_preempt",
+            {"job": "storm-e%02d" % rng.randrange(STORM_ELASTIC)}))
+    for _ in range(rng.randint(2, 5)):
+        events.append(FaultEvent(
+            rng.randint(1, 20), "api_error",
+            {"code": rng.choice([409, 500, 503]),
+             "count": rng.randint(1, 3)}))
+    t0 = rng.randint(3, 10)
+    events.append(FaultEvent(t0, "watch_drop", {"kind": "Pod"}))
+    events.append(FaultEvent(t0 + rng.randint(2, 4), "watch_restore",
+                             {"kind": "Pod"}))
+    return events, 80 if quick else 140
 
 
 def _loader_faults(rng: random.Random, quick: bool

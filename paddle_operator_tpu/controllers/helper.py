@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 from ..api import types as api
 from ..k8s import objects as k8s
+from ..k8s.runtime import LANE_HIGH, LANE_NORMAL
 
 # reference: paddlejob_controller.go:49-55
 TRAIN_PORT = 2379          # base intra-job port (PADDLE_PORT parity)
@@ -183,6 +184,29 @@ ANNOT_SCHED_EVICT = "batch.tpujob.dev/sched-evict"
 # The job's own worker np, parked while the arbiter runs it shrunk and
 # restored when fleet pressure subsides.
 ANNOT_SCHED_RESTORE_NP = "batch.tpujob.dev/sched-restore-np"
+
+
+def event_lane(etype: str, obj: dict) -> str:
+    """Workqueue priority lane for a watch event (the ``lane_for`` hook
+    on the TpuJob controller — see k8s.runtime.WorkQueue).
+
+    ``high``: the events whose handling has a ticking clock — deletes,
+    anything already Terminating (a graceful-drain grace window is
+    running), a Failed pod (a preemption incident waiting for its
+    whole-slice restart), and a job the fleet arbiter marked for
+    eviction. At fleet scale these must not queue behind a 10k-key
+    resync backlog. Everything else — creates, routine status drift,
+    periodic resyncs — rides ``normal``."""
+    if etype == "DELETED":
+        return LANE_HIGH
+    meta = obj.get("metadata") or {}
+    if meta.get("deletionTimestamp"):
+        return LANE_HIGH
+    if obj.get("kind") == "Pod" and k8s.pod_phase(obj) == "Failed":
+        return LANE_HIGH
+    if ANNOT_SCHED_EVICT in (meta.get("annotations") or {}):
+        return LANE_HIGH
+    return LANE_NORMAL
 
 
 def preemption_budget(job: api.TpuJob) -> int:

@@ -67,9 +67,22 @@ class TpuJobReconciler:
         # existing elastic-resize and graceful-drain paths.
         self.arbiter = arbiter
         # last SchedQueued reason evented per job (the queue decision
-        # repeats every requeue pass; the Event must not — worker-thread
-        # only, same single-writer contract as _exec_release_warned)
+        # repeats every requeue pass; the Event must not). Shared with
+        # _exec_release_warned under _warn_lock: per-key workqueue
+        # exclusivity serializes same-key passes, but with
+        # --reconcile-workers > 1 DIFFERENT keys mutate these tables
+        # concurrently.
+        self._warn_lock = threading.Lock()
         self._sched_queued: Dict[Tuple[str, str], str] = {}
+        # Hard-preemption incident dedup by pod uid (per job): under a
+        # dropped watch the informer cache can keep serving a Failed pod
+        # this process already deleted — "not already deleting" is a
+        # stale-cache-defeatable proxy, and re-counting the same pod
+        # burns the whole restart budget on ONE kill. A recreated pod
+        # carries a fresh uid, so legitimate re-kills still count; a
+        # restarted operator re-lists into a fresh cache, so losing this
+        # memory is safe (the pod is either really gone or really fresh).
+        self._preempt_handled: Dict[Tuple[str, str], set] = {}
         # Per-job observability collector: phase gauges/histograms,
         # cause-split restart counters, flight recorder. Whoever owns the
         # Manager registers ``self.obs.metrics_block`` as a provider.
@@ -165,8 +178,10 @@ class TpuJobReconciler:
         except NotFoundError:
             # Job is gone: drop its warn-once marker so memory stays bounded
             # across job churn and a recreated same-name job warns afresh.
-            self._exec_release_warned.discard((namespace, name))
-            self._sched_queued.pop((namespace, name), None)
+            with self._warn_lock:
+                self._exec_release_warned.discard((namespace, name))
+                self._sched_queued.pop((namespace, name), None)
+                self._preempt_handled.pop((namespace, name), None)
             self.obs.forget_job(namespace, name)
             return Result()
         job = api.TpuJob(obj)
@@ -192,14 +207,13 @@ class TpuJobReconciler:
         child_pods = self.client.list_owned("Pod", job.obj)
 
         # -- status derivation (reference :122-131) ---------------------
-        old_status = k8s.deep_copy(job.status)
-        self._sync_current_status(job, child_pods)
+        status_changed = self._sync_current_status(job, child_pods)
         # observe the freshly derived phase (no-op when unchanged): this
         # is the one site every phase transition flows through, so the
         # phase gauge / time-in-phase histogram / flight recorder see the
         # same machine the status subresource does
         self.obs.observe_phase(namespace, name, job.phase)
-        if job.status != old_status:
+        if status_changed:
             try:
                 self.client.update_status(job.obj)
             except ConflictError:
@@ -369,6 +383,17 @@ class TpuJobReconciler:
         gate = self._graceful_drain(job, child_pods)
         if gate is not None:
             return gate
+        jkey = (job.namespace, job.name)
+        # prune the handled-incident memory to pods that still exist, so
+        # it stays bounded across recreate churn
+        child_uids = {p["metadata"].get("uid") for p in child_pods}
+        with self._warn_lock:
+            handled = self._preempt_handled.get(jkey)
+            if handled is not None:
+                handled &= child_uids
+                if not handled:
+                    del self._preempt_handled[jkey]
+            handled = set(self._preempt_handled.get(jkey, ()))
         failed = [p for p in child_pods if k8s.pod_phase(p) == "Failed"]
         if not failed:
             return None
@@ -377,9 +402,12 @@ class TpuJobReconciler:
             # the clean-pod-policy path own the wreckage, don't restart
             return None
         fresh = [p for p in failed
-                 if not p["metadata"].get("deletionTimestamp")]
+                 if not p["metadata"].get("deletionTimestamp")
+                 and p["metadata"].get("uid") not in handled]
         if not fresh:
-            # all already deleting: wait for the objects to go away
+            # all already deleting (or already handled — a stale cache
+            # can replay a deleted Failed pod): wait for the objects to
+            # go away / the resync to heal
             return Result(requeue_after=1.0)
         # Bump BEFORE deleting: once the pods are gone the next pass sees
         # no Failed pod, so a bump failure after deletion could never be
@@ -393,6 +421,11 @@ class TpuJobReconciler:
                 return self._requeue_error((job.namespace, job.name))
         for pod in fresh:
             self._delete_resource(job, pod)
+        # the incident is now owned: later passes re-serving these pods
+        # from a stale cache must not count them again
+        with self._warn_lock:
+            self._preempt_handled.setdefault(jkey, set()).update(
+                p["metadata"].get("uid") for p in fresh)
         # Increment the restart count against the FRESH object: job.obj's
         # resourceVersion is stale once the status-sync update above has
         # landed, so updating it again would conflict every time and the
@@ -434,7 +467,8 @@ class TpuJobReconciler:
         if job.phase in (api.Phase.COMPLETED, api.Phase.FAILED):
             # a job can reach terminal while queued — drop its entry now
             # rather than waiting for object deletion
-            self._sched_queued.pop(key, None)
+            with self._warn_lock:
+                self._sched_queued.pop(key, None)
             # terminal jobs are not gated, but their teardown passes are
             # exactly when capacity frees — poke the arbiter so queued
             # admissions / parked-np restores flow without waiting for a
@@ -459,14 +493,18 @@ class TpuJobReconciler:
                     # on it would size the gang stale (chips beyond the
                     # allocation). Requeue for a fresh read.
                     return Result(requeue=True)
-            if key in self._sched_queued:
-                del self._sched_queued[key]
+            with self._warn_lock:
+                was_queued = self._sched_queued.pop(key, None) is not None
+            if was_queued:
                 self.recorder.event(
                     job.obj, "Normal", "SchedAdmitted",
                     "admitted by the fleet arbiter")
             return None
-        if self._sched_queued.get(key) != decision.reason:
-            self._sched_queued[key] = decision.reason
+        with self._warn_lock:
+            reason_changed = self._sched_queued.get(key) != decision.reason
+            if reason_changed:
+                self._sched_queued[key] = decision.reason
+        if reason_changed:
             self.recorder.event(job.obj, "Normal", "SchedQueued",
                                 decision.reason)
         return Result(requeue_after=decision.retry_after or 1.0)
@@ -663,10 +701,26 @@ class TpuJobReconciler:
                 return True
         return False
 
-    def _sync_current_status(self, job: api.TpuJob, child_pods: List[dict]) -> None:
-        """reference: syncCurrentStatus (paddlejob_controller.go:335-381)."""
+    def _sync_current_status(self, job: api.TpuJob,
+                             child_pods: List[dict]) -> bool:
+        """reference: syncCurrentStatus (paddlejob_controller.go:335-381).
+
+        Returns True when the freshly derived status differs from the
+        object's current one — the no-op suppression lives HERE, with the
+        derivation, so no caller can forget it: at fleet scale an
+        unconditional status write per pass is the biggest apiserver
+        write amplifier (each write fans out a MODIFIED watch event that
+        re-enqueues the key, so the queue never drains).
+
+        The phase is derived once, from the fresh per-role statuses; the
+        persisted phase seeds the sticky-terminal/no-decision fallbacks
+        in helper.get_job_phase (the old double derivation — once against
+        the stale roles, once against the fresh ones — was ~20%% of a
+        steady-state pass for the same answer).
+        """
+        old_status = job.status
         new_status = {
-            "phase": helper.get_job_phase(job),
+            "phase": job.phase,  # recomputed below from the fresh roles
             "mode": helper.get_job_mode(job),
         }
         if job.status.get("startTime"):
@@ -728,6 +782,7 @@ class TpuJobReconciler:
         if done:
             job.status["completionTime"] = done
         job.status["observedGeneration"] = job.metadata.get("generation", 1)
+        return new_status != old_status
 
     def _ensure_podgroup(self, job: api.TpuJob) -> Optional[Result]:
         """Volcano gate: create PodGroup, block pod creation until it is
@@ -857,8 +912,11 @@ class TpuJobReconciler:
                             # a fixed 1s cadence.
                             log.warning("exec release failed: %s", e)
                             key = (job.namespace, job.name)
-                            if key not in self._exec_release_warned:
-                                self._exec_release_warned.add(key)
+                            with self._warn_lock:
+                                first = key not in self._exec_release_warned
+                                if first:
+                                    self._exec_release_warned.add(key)
+                            if first:
                                 self.recorder.event(
                                     job.obj, "Warning", "ExecReleaseFailed",
                                     "exec release of %s failed: %s — the "

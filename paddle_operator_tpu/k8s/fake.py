@@ -25,6 +25,13 @@ class FakeKubeClient(KubeClient):
         self._lock = threading.RLock()
         # (kind, namespace, name) -> object dict
         self._store: Dict[Tuple[str, str, str], dict] = {}
+        # secondary indexes (the apiserver-side analog of the informer's
+        # owner index): kind -> store keys, and ownerReference uid ->
+        # child store keys. At 10k-object fleets a per-kind LIST or a
+        # cascade-GC child scan over the WHOLE store turns every
+        # control-plane pass O(fleet); these keep them O(result).
+        self._by_kind: Dict[str, set] = {}
+        self._by_owner_uid: Dict[str, set] = {}
         self._rv = 0
         self._watchers: List[Tuple[str, Optional[str], Callable]] = []
         # exec handler: fn(namespace, pod_name, container, command) -> str
@@ -50,6 +57,24 @@ class FakeKubeClient(KubeClient):
     def _next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
+
+    def _index_locked(self, key: Tuple[str, str, str], obj: dict) -> None:
+        self._by_kind.setdefault(key[0], set()).add(key)
+        for ref in obj.get("metadata", {}).get("ownerReferences") or []:
+            uid = ref.get("uid")
+            if uid:
+                self._by_owner_uid.setdefault(uid, set()).add(key)
+
+    def _unindex_locked(self, key: Tuple[str, str, str], obj: dict) -> None:
+        kinds = self._by_kind.get(key[0])
+        if kinds is not None:
+            kinds.discard(key)
+        for ref in obj.get("metadata", {}).get("ownerReferences") or []:
+            members = self._by_owner_uid.get(ref.get("uid"))
+            if members is not None:
+                members.discard(key)
+                if not members:
+                    self._by_owner_uid.pop(ref.get("uid"), None)
 
     def _key(self, obj: dict) -> Tuple[str, str, str]:
         m = obj.get("metadata", {})
@@ -113,13 +138,41 @@ class FakeKubeClient(KubeClient):
     def list(self, kind, namespace=None, label_selector=None):
         with self._lock:
             out = []
-            for (k, ns, _), o in sorted(self._store.items()):
-                if k != kind:
+            for key in sorted(self._by_kind.get(kind, ())):
+                # tolerate index entries whose object was vanished out
+                # from under us (tests simulate silently-missed deletes
+                # by popping _store directly)
+                o = self._store.get(key)
+                if o is None:
                     continue
-                if namespace and ns != namespace:
+                if namespace and key[1] != namespace:
                     continue
                 if not match_labels(o, label_selector):
                     continue
+                out.append(deep_copy(o))
+            return out
+
+    def list_owned(self, kind, owner, namespace=None):
+        """Owner-index lookup: O(children) via the ownerReference-uid
+        index instead of the base class's list-everything-and-filter.
+        Falls back to the generic path when the owner carries no uid
+        (a hand-built dict rather than a stored object)."""
+        uid = owner.get("metadata", {}).get("uid")
+        if not uid:
+            return super().list_owned(kind, owner, namespace)
+        ns = namespace or owner.get("metadata", {}).get(
+            "namespace", "default")
+        with self._lock:
+            out = []
+            for key in sorted(self._by_owner_uid.get(uid, ())):
+                if key[0] != kind or key[1] != ns:
+                    continue
+                o = self._store.get(key)
+                if o is None:
+                    continue
+                ref = get_controller_of(o)
+                if ref is None or ref.get("uid") != uid:
+                    continue  # owned, but not the controller owner
                 out.append(deep_copy(o))
             return out
 
@@ -149,6 +202,7 @@ class FakeKubeClient(KubeClient):
             m.setdefault("creationTimestamp", now_iso())
             m.setdefault("generation", 1)
             self._store[key] = obj
+            self._index_locked(key, obj)
             self._notify("ADDED", obj)
             return deep_copy(obj)
 
@@ -185,7 +239,10 @@ class FakeKubeClient(KubeClient):
                     "creationTimestamp"
                 )
             merged["metadata"]["resourceVersion"] = self._next_rv()
+            # an update may add/remove ownerReferences: re-index
+            self._unindex_locked(key, current)
             self._store[key] = merged
+            self._index_locked(key, merged)
             # finalizer removal on a deleting object may complete the delete
             if merged["metadata"].get("deletionTimestamp") and not merged[
                 "metadata"
@@ -228,19 +285,17 @@ class FakeKubeClient(KubeClient):
         gone = self._store.pop(key, None)
         if gone is None:
             return
+        self._unindex_locked(key, gone)
         self._notify("DELETED", gone)
-        # ownerReference cascade GC (background propagation)
+        # ownerReference cascade GC (background propagation) — via the
+        # owner-uid index, not a whole-store scan
         uid = gone["metadata"].get("uid")
-        children = [
-            k
-            for k, o in list(self._store.items())
-            if any(
-                r.get("uid") == uid
-                for r in o.get("metadata", {}).get("ownerReferences", []) or []
-            )
-        ]
+        children = [k for k in sorted(self._by_owner_uid.get(uid, ()))
+                    if k in self._store]
         for child_key in children:
-            child = self._store[child_key]
+            child = self._store.get(child_key)
+            if child is None:
+                continue  # removed by a nested cascade
             if child["metadata"].get("finalizers"):
                 child["metadata"].setdefault("deletionTimestamp", now_iso())
                 self._notify("MODIFIED", child)

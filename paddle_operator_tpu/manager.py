@@ -149,6 +149,13 @@ def main(argv=None):
                     help="seconds to wait for the cert pair to appear in "
                          "--webhook-cert-dir before exiting (cert-manager "
                          "may still be issuing at first boot)")
+    ap.add_argument("--reconcile-workers", type=int, default=1,
+                    help="parallel reconcile workers per controller "
+                         "(the sharded workqueue: per-key ordering is "
+                         "preserved — a key is never reconciled by two "
+                         "workers at once; >1 overlaps apiserver round "
+                         "trips at fleet scale, see docs/design.md "
+                         "'Control-plane scale')")
     ap.add_argument("--fleet-sched", action="store_true",
                     help="enable the fleet capacity arbiter (sched/): "
                          "priority + weighted fair-share admission over "
@@ -318,12 +325,18 @@ def main(argv=None):
         leader_identity=os.environ.get("POD_NAME", ""),
         on_lost_lease=lost_lease,
         cache=cache,
+        reconcile_workers=args.reconcile_workers,
     )
+    from .controllers import helper
+
     ctrl = mgr.add_controller(
         "tpujob", reconciler.reconcile,
         for_kind=api.KIND,
         owns=[k for k in kinds if k != api.KIND],
         owner_api_version=api.API_VERSION, owner_kind=api.KIND,
+        # deletes / drain notices / arbiter evictions ride the high-
+        # priority workqueue lane, ahead of routine resync traffic
+        lane_for=helper.event_lane,
     )
     ctrl.backoff_provider = reconciler.current_backoff
     mgr.add_metrics_provider(job_metrics.metrics_block)

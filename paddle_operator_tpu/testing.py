@@ -34,6 +34,7 @@ class OperatorHarness:
         http_coordination: bool = False,
         client_middleware=None,
         arbiter_factory=None,
+        reconcile_workers: int = 1,
     ):
         self.client = FakeKubeClient()
         self.client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
@@ -50,6 +51,9 @@ class OperatorHarness:
         self._namespace = namespace
         self._http_coordination = http_coordination
         self._client_middleware = client_middleware
+        # threaded-mode worker threads (Manager.start); drain() callers
+        # pass workers= per call instead
+        self._reconcile_workers = reconcile_workers
         # optional fleet arbiter (sched.FleetArbiter): factory(client,
         # job_metrics) — a factory, not an instance, because the arbiter
         # is operator memory and must be rebuilt by restart_operator()
@@ -109,7 +113,8 @@ class OperatorHarness:
             arbiter=self.arbiter,
         )
         self.manager = Manager(self.cached_client, namespace=self._namespace,
-                               cache=self.cache)
+                               cache=self.cache,
+                               reconcile_workers=self._reconcile_workers)
         self.manager.add_metrics_provider(self.job_metrics.metrics_block)
         if self.arbiter is not None:
             self.manager.add_metrics_provider(self.arbiter.metrics_block)
@@ -120,6 +125,9 @@ class OperatorHarness:
             owns=[k for k in kinds if k != api.KIND],
             owner_api_version=api.API_VERSION,
             owner_kind=api.KIND,
+            # production lane wiring (manager.py uses the same): deletes
+            # and drain incidents beat routine resyncs in the workqueue
+            lane_for=helper.event_lane,
         )
         self.controller.backoff_provider = self.reconciler.current_backoff
         # Under TPUJOB_RACE_DETECT (make race) declare the shared fields
@@ -143,6 +151,20 @@ class OperatorHarness:
                     "_preempts", "_shrinks", "_written_np"])
             racedetect.guard_fields(self.reconciler, "_err_lock",
                                     ["_err_streak", "_err_hit"])
+            racedetect.guard_fields(self.reconciler, "_warn_lock",
+                                    ["_sched_queued",
+                                     "_exec_release_warned",
+                                     "_preempt_handled"])
+            # the parallel workqueue's whole state is lock-owned: with
+            # reconcile_workers > 1 an unlocked touch of the lane maps or
+            # the active/dirty sets is exactly the key-loss class of bug
+            # the PR 2 wedge was
+            racedetect.guard_fields(self.controller.queue, "_lock", [
+                "_lanes", "_lane_of", "_deferred", "_active", "_dirty",
+                "_high_streak", "_pops", "_max_high_depth",
+                "_max_normal_behind_high"])
+            racedetect.guard_fields(self.controller, "_mlock", [
+                "_hist", "_hist_sum", "_hist_count", "_failures"])
             if self.coord_server is not None:
                 racedetect.guard_fields(self.coord_server, "_barrier_lock",
                                         ["_first_denied", "_released_pods"])

@@ -13,6 +13,9 @@ Flags:
   --base-seed S    first seed (default 0); seed k is S+k
   --scenario NAME  restrict to one scenario (repeatable; default: all)
   --quick          short horizons / small stalls (the CI lane)
+  --heavy-seeds N  seed cap for fleet-scale scenarios (default 5):
+                   control_plane_storm runs a 500-job operator per seed,
+                   so the sweep caps it unless explicitly raised
   --no-recheck     skip the same-seed replay determinism check (halves work)
   -v               also print each violation as it is found
 """
@@ -29,6 +32,10 @@ import logging
 
 from paddle_operator_tpu.chaos import SCENARIOS, run_scenario
 
+#: scenarios whose single run is itself fleet-scale (hundreds of jobs):
+#: swept at --heavy-seeds instead of --seeds
+HEAVY_SCENARIOS = ("control_plane_storm",)
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
@@ -38,6 +45,9 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", action="append", choices=SCENARIOS,
                     help="repeatable; default = all scenarios")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--heavy-seeds", type=int, default=5,
+                    help="seed cap for fleet-scale scenarios (%s)"
+                         % ", ".join(HEAVY_SCENARIOS))
     ap.add_argument("--no-recheck", action="store_true",
                     help="skip the same-seed replay determinism check")
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -49,7 +59,10 @@ def main(argv=None) -> int:
     scenarios = args.scenario or list(SCENARIOS)
     total = bad = 0
     for scenario in scenarios:
-        for k in range(args.seeds):
+        seeds = args.seeds
+        if scenario in HEAVY_SCENARIOS and not args.scenario:
+            seeds = min(seeds, args.heavy_seeds)
+        for k in range(seeds):
             seed = args.base_seed + k
             total += 1
             report = run_scenario(scenario, seed, quick=args.quick)
@@ -77,8 +90,11 @@ def main(argv=None) -> int:
             elif args.verbose:
                 for viol in report.violations:
                     print("  - %s" % viol)
-    print("\n%d/%d runs clean (%d scenario(s) x %d seed(s))"
-          % (total - bad, total, len(scenarios), args.seeds))
+    print("\n%d/%d runs clean (%d scenario(s), %d seed(s) each%s)"
+          % (total - bad, total, len(scenarios), args.seeds,
+             ", heavy capped at %d" % args.heavy_seeds
+             if any(s in HEAVY_SCENARIOS for s in scenarios)
+             and not args.scenario else ""))
     return 1 if bad else 0
 
 

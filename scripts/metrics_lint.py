@@ -97,9 +97,16 @@ def selftest_text() -> str:
     # loudly here, not ship an unlinted family
     for fam in ("tpujob_sched_tenant_share",
                 "tpujob_sched_preempt_decisions_total",
-                "tpujob_sched_shrink_decisions_total"):
+                "tpujob_sched_shrink_decisions_total",
+                # the parallel-workqueue families (ISSUE 7): per-lane
+                # depth, keys held by workers, and the reconcile-latency
+                # histogram split by outcome
+                "tpujob_workqueue_lane_depth",
+                "tpujob_workqueue_active",
+                "tpujob_reconcile_seconds"):
         assert "# TYPE %s" % fam in text, "selftest lost %s" % fam
     assert 'tenant="evil' in text, "adversarial tenant label missing"
+    assert 'outcome="done"' in text, "reconcile histogram lost its outcomes"
     return text
 
 

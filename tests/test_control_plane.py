@@ -1,0 +1,590 @@
+"""Parallel control-plane contracts (ISSUE 7): the sharded workqueue's
+client-go semantics under N consumers — FIFO, dedup-while-queued, per-key
+exclusivity, requeue-after promotion, no key loss — plus priority lanes,
+the multi-worker manager, the new workqueue/latency metric families, and
+the no-op status-write suppression."""
+
+import threading
+import time
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import helper
+from paddle_operator_tpu.k8s.fake import FakeKubeClient
+from paddle_operator_tpu.k8s.runtime import (
+    LANE_HIGH, LANE_NORMAL, Controller, Manager, WorkQueue)
+from paddle_operator_tpu.obs import parse_exposition
+from paddle_operator_tpu.testing import OperatorHarness
+
+
+def role_spec(replicas):
+    return {"replicas": replicas,
+            "template": {"spec": {"containers": [{"name": "m",
+                                                  "image": "i"}]}}}
+
+
+# ---------------------------------------------------------------------------
+# WorkQueue contract, single consumer
+# ---------------------------------------------------------------------------
+
+def test_fifo_order_within_a_lane():
+    q = WorkQueue()
+    keys = [("ns", "k%d" % i) for i in range(5)]
+    for k in keys:
+        q.add(k)
+    popped = []
+    while True:
+        k = q.pop()
+        if k is None:
+            break
+        popped.append(k)
+        q.done(k)
+    assert popped == keys
+
+
+def test_dedup_while_queued_and_requeue_after_done():
+    q = WorkQueue()
+    q.add(("ns", "a"))
+    q.add(("ns", "a"))
+    assert len(q) == 1
+    key = q.pop()
+    assert key == ("ns", "a") and len(q) == 0 and q.active == 1
+    # re-adds while active park in the dirty set, not the queue
+    q.add(key)
+    q.add(key)
+    assert len(q) == 0
+    q.done(key)  # releases exclusivity AND requeues the parked add once
+    assert len(q) == 1 and q.active == 0
+    assert q.pop() == key
+    q.done(key)
+    assert q.pop() is None
+
+
+def test_per_key_exclusivity_second_pop_never_returns_active_key():
+    q = WorkQueue()
+    q.add(("ns", "a"))
+    assert q.pop() == ("ns", "a")
+    q.add(("ns", "a"))       # parked dirty: a is active
+    assert q.pop() is None   # a second worker must NOT receive "a"
+    q.done(("ns", "a"))
+    assert q.pop() == ("ns", "a")
+
+
+def test_add_after_earliest_due_wins_and_promotes():
+    q = WorkQueue()
+    q.add_after(("ns", "b"), 30.0)
+    q.add_after(("ns", "b"), 0.0)     # sooner signal wins
+    assert q.pending_deferred == 1
+    q.promote_due()                   # 0.0 is already due — no force
+    assert len(q) == 1 and q.pending_deferred == 0
+    assert q.pop() == ("ns", "b")
+
+
+def test_add_after_on_active_key_promotes_into_dirty_not_queue():
+    q = WorkQueue()
+    q.add(("ns", "a"))
+    q.pop()
+    q.add_after(("ns", "a"), 0.0)
+    q.promote_due(force=True)
+    assert len(q) == 0            # a is active: parked dirty instead
+    q.done(("ns", "a"))
+    assert q.pop() == ("ns", "a")  # ... and surfaced at done()
+
+
+# ---------------------------------------------------------------------------
+# priority lanes
+# ---------------------------------------------------------------------------
+
+def test_high_lane_beats_normal_and_promotes_queued_key():
+    q = WorkQueue()
+    q.add(("ns", "n1"))
+    q.add(("ns", "n2"))
+    q.add(("ns", "h1"), lane=LANE_HIGH)
+    q.add(("ns", "n2"), lane=LANE_HIGH)   # promotion of a queued key
+    assert q.depth(LANE_HIGH) == 2 and q.depth(LANE_NORMAL) == 1
+    assert q.pop() == ("ns", "h1")
+    assert q.pop() == ("ns", "n2")        # promoted ahead of n1
+    assert q.pop() == ("ns", "n1")
+
+
+def test_normal_lane_is_bounded_starved_not_forgotten():
+    q = WorkQueue(normal_share=3)
+    q.add(("ns", "slow"))
+    for i in range(10):
+        q.add(("ns", "h%d" % i), lane=LANE_HIGH)
+    order = []
+    for _ in range(11):
+        k = q.pop()
+        order.append(k)
+        q.done(k)
+    # the normal key was served after exactly normal_share high pops
+    assert order.index(("ns", "slow")) == 3
+    stats = q.stats()
+    assert stats["high_pops"] == 10 and stats["normal_pops"] == 1
+    # no high key waited behind more than the policy bound of normal pops
+    assert stats["max_normal_behind_high"] <= \
+        stats["max_high_depth"] // q.normal_share + 2
+
+
+def test_add_after_escalates_lane_of_already_queued_key():
+    """A high add_after on a normal-queued key must promote it (same as
+    add()): the sooner signal wins on timing, never on priority."""
+    q = WorkQueue()
+    q.add(("ns", "k"))
+    q.add(("ns", "other"))
+    q.add_after(("ns", "k"), 5.0, lane=LANE_HIGH)
+    assert q.depth(LANE_HIGH) == 1 and q.depth(LANE_NORMAL) == 1
+    assert q.pop() == ("ns", "k")
+
+
+def test_add_does_not_demote_parked_high_retry():
+    """A routine normal add (resync, the job's own status-write MODIFIED
+    event) racing a parked high-lane retry (an incident's requeue_after /
+    error backoff) must keep the key high — lanes merge, never demote."""
+    q = WorkQueue()
+    q.add_after(("ns", "k"), 5.0, lane=LANE_HIGH)
+    q.add(("ns", "k"))
+    assert q.depth(LANE_HIGH) == 1 and q.depth(LANE_NORMAL) == 0
+    assert q.pending_deferred == 0
+
+
+def test_consumer_requeue_reenters_popped_lane():
+    """Lane classification runs only at watch-event ingress, so an
+    in-flight high-priority incident (a drain whose grace window ticks
+    between passes with NO fresh pod event) must keep its lane across its
+    own requeues — through the dirty set, the deferred set, and the
+    error-backoff path — or its next pass waits behind the whole normal
+    resync backlog and the graceful drain degrades to a hard kill."""
+    from paddle_operator_tpu.controllers.reconciler import Result
+
+    # Result.requeue while active: parks dirty, requeues at done() as high
+    c = Controller("t", lambda ns, n: Result(requeue=True))
+    c.queue.add(("ns", "hot"), lane=LANE_HIGH)
+    key = c.queue.pop()
+    c.process_one(key)
+    c.queue.done(key)
+    assert c.queue.depth(LANE_HIGH) == 1 and c.queue.depth(LANE_NORMAL) == 0
+
+    # Result.requeue_after: the deferred entry carries the lane
+    c2 = Controller("t2", lambda ns, n: Result(requeue_after=0.01))
+    c2.queue.add(("ns", "drain"), lane=LANE_HIGH)
+    key = c2.queue.pop()
+    c2.process_one(key)
+    c2.queue.done(key)
+    c2.queue.promote_due(force=True)
+    assert c2.queue.depth(LANE_HIGH) == 1
+
+    # error backoff: a panicking high-lane reconcile retries as high
+    def boom(ns, n):
+        raise RuntimeError("injected")
+
+    c3 = Controller("t3", boom)
+    c3.queue.add(("ns", "err"), lane=LANE_HIGH)
+    key = c3.queue.pop()
+    c3.process_one(key)
+    c3.queue.done(key)
+    c3.queue.promote_due(force=True)
+    assert c3.queue.depth(LANE_HIGH) == 1
+
+
+def test_event_lane_classifier():
+    pod = {"kind": "Pod", "metadata": {"name": "p"},
+           "status": {"phase": "Running"}}
+    assert helper.event_lane("MODIFIED", pod) == LANE_NORMAL
+    assert helper.event_lane("DELETED", pod) == LANE_HIGH
+    terminating = {"kind": "Pod",
+                   "metadata": {"deletionTimestamp": "now"}}
+    assert helper.event_lane("MODIFIED", terminating) == LANE_HIGH
+    failed = {"kind": "Pod", "metadata": {},
+              "status": {"phase": "Failed"}}
+    assert helper.event_lane("MODIFIED", failed) == LANE_HIGH
+    evicted = {"kind": api.KIND, "metadata": {
+        "annotations": {helper.ANNOT_SCHED_EVICT: "1"}}}
+    assert helper.event_lane("MODIFIED", evicted) == LANE_HIGH
+    job = {"kind": api.KIND, "metadata": {"name": "j"}}
+    assert helper.event_lane("ADDED", job) == LANE_NORMAL
+
+
+# ---------------------------------------------------------------------------
+# N concurrent consumers: exclusivity + no key loss
+# ---------------------------------------------------------------------------
+
+def test_n_consumers_no_key_loss_no_same_key_overlap():
+    q = WorkQueue()
+    keys = [("ns", "k%02d" % i) for i in range(40)]
+    processed = {k: 0 for k in keys}
+    in_flight = {k: 0 for k in keys}
+    overlap = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def consumer():
+        while not stop.is_set():
+            k = q.pop(timeout=0.05)
+            if k is None:
+                continue
+            with lock:
+                in_flight[k] += 1
+                if in_flight[k] > 1:
+                    overlap.append(k)
+            time.sleep(0.0005)
+            with lock:
+                in_flight[k] -= 1
+                processed[k] += 1
+            q.done(k)
+
+    threads = [threading.Thread(target=consumer, name="cons-%d" % i)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    # racing producers: every key added 5 times from 2 threads while
+    # consumers churn — dedup + dirty-requeue must lose nothing
+    def producer():
+        for _round in range(5):
+            for k in keys:
+                q.add(k)
+            time.sleep(0.002)
+
+    producers = [threading.Thread(target=producer, name="prod-%d" % i)
+                 for i in range(2)]
+    for t in producers:
+        t.start()
+    for t in producers:
+        t.join()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if len(q) == 0 and q.active == 0:
+            break
+        time.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert overlap == [], "same key reconciled concurrently: %r" % overlap
+    assert all(processed[k] >= 1 for k in keys), "keys lost"
+    assert len(q) == 0 and q.active == 0
+
+
+def test_failing_key_never_dropped_with_parallel_consumers():
+    """The PR 2 key-drop wedge as a regression test, at N consumers: a
+    key whose reconcile keeps raising must stay in the retry loop (capped
+    backoff) and eventually converge once the fault clears."""
+    client = FakeKubeClient()
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    calls = []
+    lock = threading.Lock()
+
+    def flaky(ns, name):
+        with lock:
+            calls.append(name)
+            n = len([c for c in calls if c == name])
+        if name == "wedge" and n <= 4:
+            raise RuntimeError("boom %d" % n)
+        return None
+
+    mgr = Manager(client, reconcile_workers=3)
+    mgr.add_controller("t", flaky, for_kind=api.KIND)
+    client.create(api.new_tpujob("wedge", spec={"worker": role_spec(1)}))
+    client.create(api.new_tpujob("fine", spec={"worker": role_spec(1)}))
+    mgr.start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            with lock:
+                done = len([c for c in calls if c == "wedge"]) >= 5
+            if done:
+                break
+            time.sleep(0.02)
+        with lock:
+            wedge_calls = len([c for c in calls if c == "wedge"])
+        assert wedge_calls >= 5, "failing key was dropped after %d calls" \
+            % wedge_calls
+    finally:
+        mgr.stop()
+
+
+def test_threaded_manager_parallel_workers_converge_with_exclusivity():
+    h = OperatorHarness(reconcile_workers=4)
+    seen = {}
+    lock = threading.Lock()
+    overlap = []
+    inner = h.controller.reconcile
+
+    def tracked(ns, name):
+        with lock:
+            seen[(ns, name)] = seen.get((ns, name), 0) + 1
+            if seen[(ns, name)] > 0 and (ns, name) in tracked.live:
+                overlap.append((ns, name))
+            tracked.live.add((ns, name))
+        try:
+            return inner(ns, name)
+        finally:
+            with lock:
+                tracked.live.discard((ns, name))
+
+    tracked.live = set()
+    h.controller.reconcile = tracked
+    h.manager.start()
+    try:
+        for i in range(12):
+            h.create_job(api.new_tpujob("par-%d" % i,
+                                        spec={"worker": role_spec(1)}))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            h.sim.step()
+            phases = [(o.get("status") or {}).get("phase")
+                      for o in h.client.all_objects(api.KIND)]
+            if len(phases) == 12 and all(p == "Running" for p in phases):
+                break
+            time.sleep(0.02)
+        assert all((o.get("status") or {}).get("phase") == "Running"
+                   for o in h.client.all_objects(api.KIND))
+        assert overlap == [], "per-key exclusivity violated: %r" % overlap
+    finally:
+        h.manager.stop()
+        h.close()
+
+
+def test_drain_workers_batch_mode_matches_serial_result():
+    """drain(workers=N) models the parallel queue deterministically: the
+    end state must match a serial drain of the same workload."""
+    def build():
+        h = OperatorHarness()
+        for i in range(6):
+            h.create_job(api.new_tpujob("d-%d" % i,
+                                        spec={"worker": role_spec(1)}))
+        return h
+
+    states = []
+    for workers in (1, 4):
+        h = build()
+        for _ in range(40):
+            h.manager.drain(workers=workers)
+            if not h.sim.step() and all(
+                    len(c.queue) == 0 for c in h.manager.controllers):
+                break
+        states.append(sorted(
+            (o["metadata"]["name"], (o.get("status") or {}).get("phase"))
+            for o in h.client.all_objects(api.KIND)))
+        h.close()
+    assert states[0] == states[1]
+    assert all(p == "Running" for _, p in states[0])
+
+
+def test_manager_start_is_restartable_after_clean_stop():
+    client = FakeKubeClient()
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    seen = []
+    mgr = Manager(client)
+    mgr.add_controller("t", lambda ns, n: seen.append(n) or None,
+                       for_kind=api.KIND)
+    client.create(api.new_tpujob("x", spec={"worker": role_spec(1)}))
+    mgr.start()
+    deadline = time.time() + 5
+    while "x" not in seen and time.time() < deadline:
+        time.sleep(0.02)
+    mgr.stop()
+    assert "x" in seen
+    client.create(api.new_tpujob("y", spec={"worker": role_spec(1)}))
+    mgr.start()   # restart gate: clean stop + all workers exited
+    try:
+        deadline = time.time() + 5
+        while "y" not in seen and time.time() < deadline:
+            time.sleep(0.02)
+        assert "y" in seen
+    finally:
+        mgr.stop()
+
+
+def test_prestart_stop_request_is_honored_not_cleared():
+    """A request_stop() that lands before the first start() (a SIGTERM in
+    main's handler-registration window) must wind the manager down, not be
+    cleared by the restart gate and run until a second signal."""
+    client = FakeKubeClient()
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    seen = []
+    mgr = Manager(client)
+    mgr.add_controller("t", lambda ns, n: seen.append(n) or None,
+                       for_kind=api.KIND)
+    client.create(api.new_tpujob("x", spec={"worker": role_spec(1)}))
+    mgr.request_stop()
+    mgr.start()
+    assert mgr._stop.is_set() and mgr._threads == []
+    assert seen == []
+    mgr.stop()
+
+
+def test_start_refuses_restart_while_prior_worker_still_alive():
+    """stop() joins workers with a timeout and a wedged reconcile can
+    outlive it; a start() then would spawn workers that see _stop and exit
+    instantly — an operator that LOOKS started but reconciles nothing.
+    The restart gate must fail loudly instead."""
+    client = FakeKubeClient()
+    client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    mgr = Manager(client)
+    mgr.add_controller("t", lambda ns, n: None, for_kind=api.KIND)
+    release = threading.Event()
+    stuck = threading.Thread(target=release.wait, name="stuck-worker",
+                             daemon=True)
+    stuck.start()
+    mgr._threads.append(stuck)
+    mgr._stop.set()
+    try:
+        with pytest.raises(RuntimeError, match="stuck-worker"):
+            mgr.start()
+    finally:
+        release.set()
+        stuck.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# metrics + no-op status suppression
+# ---------------------------------------------------------------------------
+
+def test_metrics_text_exposes_lane_depth_active_and_latency_histogram():
+    h = OperatorHarness()
+    h.create_job(api.new_tpujob("m", spec={"worker": role_spec(1)}))
+    h.converge()
+    text = h.manager.metrics_text()
+    assert 'tpujob_workqueue_lane_depth{controller="tpujob",lane="high"}' \
+        in text
+    assert 'tpujob_workqueue_lane_depth{controller="tpujob",lane="normal"}' \
+        in text
+    assert 'tpujob_workqueue_active{controller="tpujob"}' in text
+    assert 'tpujob_reconcile_seconds_bucket{controller="tpujob",' \
+        'outcome="done",le="+Inf"}' in text
+    assert "tpujob_reconcile_seconds_count" in text
+    assert parse_exposition(text) == [], parse_exposition(text)
+    h.close()
+
+
+def test_controller_histogram_observes_every_outcome():
+    from paddle_operator_tpu.controllers.reconciler import Result
+
+    outcomes = iter([Result(), Result(requeue=True),
+                     Result(requeue_after=5.0)])
+
+    def fn(ns, name):
+        try:
+            return next(outcomes)
+        except StopIteration:
+            raise RuntimeError("boom")
+
+    c = Controller("t", fn)
+    for _ in range(4):
+        c.process_one(("default", "x"))
+    snap = c.snapshot()
+    assert set(snap["hist"]) == {"done", "requeue", "requeue_after",
+                                 "error"}
+    assert snap["duration_count"] == 4
+    assert all(h[-1] == 1 for h in snap["hist"].values())  # +Inf buckets
+
+
+def test_steady_state_pass_writes_no_status():
+    """The no-op suppression satellite as a regression test: a converged
+    job's reconcile pass must not touch the apiserver (an unconditional
+    status write would re-enqueue the key via its own MODIFIED event and
+    the queue would never drain)."""
+    h = OperatorHarness()
+    h.create_job(api.new_tpujob("quiet", spec={"worker": role_spec(1)}))
+    h.converge()
+    assert h.get_job("quiet").phase == api.Phase.RUNNING
+    rv0 = h.client.resource_version
+    for _ in range(3):
+        h.reconciler.reconcile("default", "quiet")
+    assert h.client.resource_version == rv0
+    h.close()
+
+
+def test_drifted_status_repaired_with_single_write():
+    h = OperatorHarness()
+    h.create_job(api.new_tpujob("drift", spec={"worker": role_spec(1)}))
+    h.converge()
+    h.client.patch_status(api.KIND, "default", "drift", {})
+    rv0 = int(h.client.resource_version)
+    h.reconciler.reconcile("default", "drift")
+    assert h.get_job("drift").phase == api.Phase.RUNNING
+    assert int(h.client.resource_version) == rv0 + 1  # exactly one write
+    h.close()
+
+
+def test_hard_preemption_not_double_counted_under_stale_cache():
+    """Found by the control_plane_storm scenario (seed 3): with the pod
+    watch dropped, the informer cache keeps serving a Failed pod the
+    reconciler already deleted — every pass then re-counted the SAME
+    incident until one injected kill burned the whole restart budget.
+    The incident dedup now keys on pod uid, which a stale replay cannot
+    forge and a legitimate recreate-then-rekill always refreshes."""
+    h = OperatorHarness()
+    h.create_job(api.new_tpujob("stale", spec={
+        "device": "tpu", "elastic": 1,
+        "tpu": {"accelerator": "v5e", "topology": "2x4", "chipsPerHost": 4},
+        "worker": role_spec(2)}))
+    h.converge()
+    assert h.get_job("stale").phase == api.Phase.RUNNING
+
+    h.sim.finish("stale-worker-1", succeeded=False, reason="Evicted")
+    h.sim.step()                      # kubelet reports the eviction
+    h.client.suspend_watch("Pod")     # ... and THEN the watch drops
+    for _ in range(6):                # stale passes re-serve the Failed pod
+        h.reconciler.reconcile("default", "stale")
+    job = h.get_job("stale")
+    assert int(job.status.get("preemptionRestarts") or 0) == 1, \
+        "one kill must count exactly one incident, got %r" % job.status
+
+    h.client.resume_watch("Pod")
+    h.sim.clear("stale-worker-1")
+    for k in h.cache.kinds():
+        h.cache.resync(k)             # the informer heal after reconnect
+    h.converge()
+    job = h.get_job("stale")
+    assert job.phase == api.Phase.RUNNING
+    assert int(job.status.get("preemptionRestarts") or 0) == 1
+    h.close()
+
+
+# ---------------------------------------------------------------------------
+# FakeKubeClient secondary indexes
+# ---------------------------------------------------------------------------
+
+def test_fake_owner_uid_index_matches_scan_and_survives_cascade():
+    h = OperatorHarness()
+    for i in range(3):
+        h.create_job(api.new_tpujob("own-%d" % i,
+                                    spec={"worker": role_spec(2)}))
+    h.converge()
+    for i in range(3):
+        owner = h.client.get(api.KIND, "default", "own-%d" % i)
+        via_index = h.client.list_owned("Pod", owner)
+        # the generic scan path (no uid -> base-class list+filter)
+        stripped = {"apiVersion": owner["apiVersion"],
+                    "kind": owner["kind"],
+                    "metadata": {"name": owner["metadata"]["name"],
+                                 "namespace": "default"}}
+        via_scan = h.client.list_owned("Pod", stripped)
+        assert [p["metadata"]["name"] for p in via_index] == \
+            [p["metadata"]["name"] for p in via_scan]
+        assert len(via_index) == 2
+    # cascade GC through the uid index: deleting the job removes its pods
+    h.client.delete(api.KIND, "default", "own-1")
+    h.converge()
+    assert all(not p["metadata"]["name"].startswith("own-1-")
+               for p in h.pods())
+    assert len([p for p in h.pods()]) == 4
+    h.close()
+
+
+def test_fake_list_kind_index_is_equivalent():
+    c = FakeKubeClient()
+    c.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
+    for i in range(4):
+        c.create(api.new_tpujob("k-%d" % i, spec={"worker": role_spec(1)}))
+    c.create({"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"name": "cm", "namespace": "default"}})
+    jobs = c.list(api.KIND)
+    assert [j["metadata"]["name"] for j in jobs] == \
+        ["k-%d" % i for i in range(4)]
+    assert len(c.list("ConfigMap")) == 1
+    assert c.list("Pod") == []
+    c.delete(api.KIND, "default", "k-2")
+    assert len(c.list(api.KIND)) == 3
