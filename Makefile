@@ -4,15 +4,16 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
 # the default pre-merge gate: project lint + the fast suite + the fast
 # suite again under the runtime race detector (docs/static-analysis.md)
 # + one seed of each durable-recovery chaos scenario + the fleet-
-# scheduler fast lane + the quick control-plane load profile
-verify: analyze test-fast race recovery sched loadtest
+# scheduler fast lane + the quick control-plane load profile + the quick
+# cold-vs-warm startup profile
+verify: analyze test-fast race recovery sched loadtest startup
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -102,6 +103,14 @@ metrics-lint:
 #   `python scripts/perf_control_plane.py` with no flags
 loadtest:
 	$(PY) scripts/perf_control_plane.py --quick
+
+# startup-tax profile (docs/design.md "Compilation & startup"):
+#   startup — one cold + one warm fresh-process sample on CPU; asserts
+#             warm init+compile >= 3x faster with bit-identical loss
+#   the full artifact (BENCH_STARTUP.json) is
+#   `python scripts/perf_startup.py` with no flags
+startup:
+	$(PY) scripts/perf_startup.py --quick
 
 bench:
 	$(PY) bench.py

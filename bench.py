@@ -44,6 +44,18 @@ everything left, guaranteeing one probe >= 300 s whenever the budget
 allows; see `_canary_backend_deadline`), and every attempt records
 per-stage elapsed times + the child's last stderr line in the attempts
 log, so even a failed round localizes WHERE init hung.
+
+Round-8 (PR 8) startup attack: (a) the canary probes moved into a WARM
+POOL — a background thread forked at t=0 so probe 0's backend_init wait
+overlaps the CPU bank instead of running after it, and a wedged probe
+burns only its own deadline, never the serial budget (round 5 lost 567 s
+to two dead probes before banking anything); (b) every child routes its
+compiles through `paddle_operator_tpu.compile_cache` (persistent XLA
+cache + serialized AOT executables), and the JSON carries a `startup`
+block (backend_init_s / model_init_s / compile_warmup_s, cache =
+cold|warm|aot, hit/miss counters) plus per-attempt `cache`/`cache_hit`
+fields — so BENCH_r*.json diffs separate the startup tax from
+steady-state throughput.
 """
 
 import json
@@ -198,6 +210,7 @@ def canary_main():
 def child_main():
     _install_sigterm_exit()
     batch = int(os.environ.get("BENCH_BATCH", "256"))
+    t_child = time.perf_counter()
     _stage("backend_init")
     import jax
 
@@ -213,7 +226,16 @@ def child_main():
 
     n_dev = len(jax.devices())
     backend = jax.default_backend()
+    backend_init_s = time.perf_counter() - t_child
     _log("%d device(s), backend=%s" % (n_dev, backend))
+
+    # Anti-cold-start (PR 8): every compile below — canary, calibration,
+    # model init, the train step — goes down the compile-cache ladder
+    # (persistent XLA cache + serialized AOT executables), so a repeated
+    # round pays milliseconds where the first paid ~20 s of compile_warmup.
+    # Enabled BEFORE the first jit: the cache binds its dir on first use.
+    from paddle_operator_tpu import compile_cache
+    compile_cache.enable_persistent_cache()
 
     _stage("canary")
     t0 = time.perf_counter()
@@ -273,7 +295,8 @@ def child_main():
     # host readback, not block_until_ready: init must have REALLY finished,
     # or its tail executes inside compile_warmup's timed window/deadline
     float(params["head"]["fc"]["kernel"].astype(jnp.float32).sum())
-    _log("init in %.1fs" % (time.perf_counter() - t0))
+    model_init_s = time.perf_counter() - t0
+    _log("init in %.1fs" % model_init_s)
 
     opt = optim.sgd(
         optim.cosine_schedule(0.1, 1000, 50), momentum=0.9,
@@ -289,8 +312,9 @@ def child_main():
     for _ in range(WARMUP):
         state, metrics = step(state, batch_data)
     float(metrics["loss"])  # readback: full chain has really executed
-    _log("warmup (%d steps incl. compile) in %.1fs"
-         % (WARMUP, time.perf_counter() - t0))
+    compile_warmup_s = time.perf_counter() - t0
+    _log("warmup (%d steps incl. compile) in %.1fs (step source: %s)"
+         % (WARMUP, compile_warmup_s, getattr(step, "source", "jit")))
 
     _stage("measure")
     # Two windows, best wins. Sync: ONE scalar readback of the LAST step's
@@ -339,6 +363,18 @@ def child_main():
         # against real-hardware MFU only when that holds.
         "mfu": round(images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE
                      / (calib_tflops * 1e12), 4),
+        # Startup-tax ledger (PR 8): per-stage wall next to the cache
+        # ledger, so BENCH_r*.json diffs separate startup regressions from
+        # steady-state ones. `cache` is the rung that served this process
+        # (cold | warm | aot); `step_source` where the headline train step
+        # came from (jit | compiled | aot | memo).
+        "startup": dict(
+            compile_cache.startup_block(),
+            backend_init_s=round(backend_init_s, 1),
+            model_init_s=round(model_init_s, 1),
+            compile_warmup_s=round(compile_warmup_s, 1),
+            step_source=getattr(step, "source", "jit"),
+        ),
     }
     # Emit the core number NOW: extras below can only enrich it, a wedged
     # extra stage loses nothing (the parent keeps the LAST JSON line).
@@ -1105,12 +1141,23 @@ def _stop_child(proc, why):
     proc.wait()
 
 
-def _run_attempt(att, budget_s):
+def _run_attempt(att, budget_s, stop=None):
+    """Launch one child and supervise it to completion.
+
+    ``stop``: optional threading.Event — when set, the child is TERMed
+    and the attempt closed with outcome ``stopped`` (the warm-pool canary
+    thread uses it so an in-flight probe never outlives the parent's
+    interest in the answer).
+    """
     env = os.environ.copy()
     env["BENCH_CHILD"] = "1"
     env["BENCH_MODE"] = att.mode
     env["BENCH_BATCH"] = str(att.batch)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    # the project compile-cache ladder (persistent + AOT) shares the same
+    # volume as JAX's own cache unless explicitly pointed elsewhere
+    env.setdefault("TPUJOB_COMPILE_CACHE_DIR",
+                   env["JAX_COMPILATION_CACHE_DIR"])
     if att.platform:
         env["BENCH_PLATFORM"] = att.platform
         if att.platform == "cpu":
@@ -1162,6 +1209,14 @@ def _run_attempt(att, budget_s):
         rc = proc.poll()
         if rc is not None:
             break
+        if stop is not None and stop.is_set():
+            att.close_stage()
+            _stop_child(proc, "pool stopped")
+            t_err.join(timeout=5)
+            t_out.join(timeout=5)
+            _parse_result(att)
+            att.outcome = "stopped:" + att.stage
+            return att
         now = time.monotonic()
         in_stage = now - att.stage_t
         deadline = (att.deadlines or STAGE_DEADLINES).get(att.stage, 180.0)
@@ -1207,21 +1262,123 @@ def _parse_result(att):
                 pass
 
 
-def parent_main():
-    """Round-4 supervision order (the round-3 verdict's top item):
+class _CanaryPool:
+    """Warm-pool canary probing (PR 8): the TPU liveness probes run in a
+    BACKGROUND thread, concurrently with whatever the parent is doing on
+    the main thread — banking the CPU fallback, or nothing but waiting.
 
-    1. BANK the CPU fallback number FIRST (~90 s, touches no TPU state,
-       cannot wedge anything) and print it — the driver keeps the LAST
-       JSON line, so this guarantees a real number exists no matter what
-       happens to the TPU for the rest of the budget.
-    2. Spend the ENTIRE remaining budget probing the TPU with tiny canary
-       children on a backoff loop. Round 3 retried backend_init exactly
-       once, fell back to CPU with ~8 minutes left, and the artifact
-       recorded 0.41 img/s while the chip did 2,479 in-session.
+    Round 5 ran the same probes SERIALLY: the CPU bank first (~90 s), then
+    probe after probe, and two wedged ``backend_init`` children ate 567 s
+    of the 840 s budget before any useful overlap could happen. Now probe
+    0 forks the moment the parent starts, the CPU bank overlaps it
+    entirely, each probe still burns only its own escalating deadline
+    (the per-probe watchdog is unchanged), and ``stop()`` TERMs an
+    in-flight probe the instant the budget is needed elsewhere — so one
+    wedged probe can cost its deadline, never the whole ``BENCH_TIMEOUT``.
+
+    Terminal states (``wait()``): ``alive`` — a canary proved real TPU
+    work; ``no_plugin`` — the child env has no TPU backend at all (decided
+    statically, re-probing is moot); ``gave_up`` — budget exhausted.
+    """
+
+    def __init__(self, remaining, backoff, fixed_cost, attempts, alock):
+        self._remaining = remaining  # () -> seconds left in the budget
+        self._backoff = backoff
+        self._fixed = fixed_cost
+        self._attempts = attempts
+        self._alock = alock
+        self.alive = threading.Event()
+        self.no_plugin = None
+        self.n_probes = 0
+        self._done = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="canary-pool", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                deadline = _canary_backend_deadline(
+                    self.n_probes, self._remaining(), self._fixed,
+                    self._backoff)
+                if deadline is None:
+                    break  # not even the base probe fits the budget now
+                deadlines = dict(CANARY_DEADLINES, backend_init=deadline)
+                _log("canary probe %d: backend_init deadline %.0fs "
+                     "(%.0fs budget left)"
+                     % (self.n_probes + 1, deadline, self._remaining()))
+                att = _Attempt(0, mode="canary", deadlines=deadlines)
+                with self._alock:
+                    self._attempts.append(att)
+                _run_attempt(att, self._remaining() - 10, stop=self._stop)
+                self.n_probes += 1
+                if self._stop.is_set():
+                    break
+                if (att.outcome == "ok" and att.result is not None
+                        and att.result.get("backend") not in (None, "tpu")):
+                    # No TPU plugin registered in the child env at all:
+                    # decided by the static environment, not relay state.
+                    self.no_plugin = att.result.get("backend")
+                    _log("canary reports backend=%r: no TPU plugin in "
+                         "child env; not re-probing" % self.no_plugin)
+                    break
+                if (att.outcome == "ok" and att.result is not None
+                        and att.result.get("canary") == "ok"
+                        and att.result.get("backend") == "tpu"):
+                    _log("TPU canary ok in %.0fs (%.0fs budget left)"
+                         % (att.result.get("seconds", -1),
+                            self._remaining()))
+                    self.alive.set()
+                    break
+                att.relay_tcp = _relay_tcp_probe()
+                _log("TPU canary failed (%s); relay tcp %s; %.0fs budget "
+                     "left" % (att.outcome, att.relay_tcp,
+                               self._remaining()))
+                min_next = self._fixed + CANARY_MIN_BACKEND
+                if self._remaining() > min_next + self._backoff:
+                    self._stop.wait(self._backoff)
+        finally:
+            self._done.set()
+
+    def wait(self, timeout):
+        """Block until a terminal state or `timeout` seconds. Returns
+        'alive' | 'no_plugin' | 'gave_up' | 'timeout'."""
+        self._done.wait(timeout=max(0.0, timeout))
+        if self.alive.is_set():
+            return "alive"
+        if self.no_plugin:
+            return "no_plugin"
+        if self._done.is_set():
+            return "gave_up"
+        return "timeout"
+
+    def stop(self):
+        """TERM any in-flight probe and join the thread. Idempotent."""
+        self._stop.set()
+        # the probe child honors the stop event within one poll tick; the
+        # TERM-grace + join is bounded, not budget-scale
+        self._thread.join(
+            timeout=float(os.environ.get("BENCH_TERM_GRACE", "10")) + 20)
+
+
+def parent_main():
+    """Round-4 supervision order, round-8 overlap:
+
+    1. FORK the canary warm pool immediately: TPU probes run in a
+       background thread from t=0 (escalating backend_init deadlines,
+       backoff loop — see _CanaryPool).
+    2. BANK the CPU fallback number on the main thread CONCURRENTLY
+       (~90 s, touches no TPU state, cannot wedge anything) and print it —
+       the driver keeps the LAST JSON line, so a real number exists no
+       matter what happens to the TPU for the rest of the budget.
     3. The moment a canary executes real work, run the full measurement
        and re-emit — the TPU line replaces the banked CPU line. Pre-compute
-       failures return to the canary loop (the relay re-wedged); compute
-       failures walk down the batch ladder.
+       failures re-arm the pool (the relay re-wedged); compute failures
+       walk down the batch ladder.
     """
     total_budget = float(os.environ.get("BENCH_TIMEOUT", "840"))
     t_start = time.monotonic()
@@ -1232,6 +1389,7 @@ def parent_main():
     ladder = sorted(set(ladder), reverse=True)
 
     attempts = []
+    alock = threading.Lock()  # the pool thread appends probe attempts
 
     def remaining():
         return total_budget - (time.monotonic() - t_start)
@@ -1246,93 +1404,88 @@ def parent_main():
             _Attempt(int(os.environ.get("BENCH_CPU_BATCH", "8")),
                      platform="cpu", steps=1, warmup=1),
             min(remaining() - 10, 300))
-        attempts.append(att)
+        with alock:
+            attempts.append(att)
         if att.outcome.startswith("ok"):
             res = dict(att.result)
             res["note"] = note
-            _emit(res, attempts)
+            _emit(res, attempts, alock)
             return res
         return None
 
-    # ---- Phase 1: bank the CPU number first. Cheap, relay-independent
-    # (the CPU child strips the axon sitecustomize entirely), and printed
-    # immediately so even a parent killed at the driver's deadline leaves
-    # a parseable artifact behind.
-    want_cpu_bank = os.environ.get("BENCH_CPU_FALLBACK", "1") == "1"
-    if want_cpu_bank and remaining() > 90:
-        _log("phase 1: banking CPU fallback number")
-        banked = bank_cpu("CPU fallback banked first; TPU probing follows "
-                          "with the remaining budget")
-
-    # ---- Phases 2+3: canary-probe until the relay answers, then measure.
     probe_backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "20"))
     # a full canary cycle can legitimately take every stage deadline in
     # sequence; only launch one if the whole worst case fits, or the final
     # canary gets TERM->KILLed mid-TPU-claim — the exact kill that wedges
-    # this relay. Computed per-probe below because deadlines escalate.
+    # this relay. Computed per-probe inside the pool (deadlines escalate).
     fixed_canary_cost = (CANARY_DEADLINES["child_up"]
                          + CANARY_DEADLINES["canary"] + 15)
+
+    # ---- Phase 1: fork the warm pool NOW (probe 0's backend_init wait
+    # overlaps the CPU bank below instead of running after it).
+    want_probe = os.environ.get("BENCH_TPU_PROBE", "1") == "1"
+    pool = None
+    pools = []  # every pool ever armed: final accounting sums over them
+    if want_probe and remaining() > fixed_canary_cost + CANARY_MIN_BACKEND:
+        _log("phase 1: forking canary warm pool (concurrent with CPU bank)")
+        pool = _CanaryPool(remaining, probe_backoff, fixed_canary_cost,
+                           attempts, alock).start()
+        pools.append(pool)
+
+    # ---- Phase 2 (concurrent with the pool): bank the CPU number. Cheap,
+    # relay-independent (the CPU child strips the axon sitecustomize
+    # entirely), and printed immediately so even a parent killed at the
+    # driver's deadline leaves a parseable artifact behind.
+    want_cpu_bank = os.environ.get("BENCH_CPU_FALLBACK", "1") == "1"
+    if want_cpu_bank and remaining() > 90:
+        _log("phase 2: banking CPU fallback number")
+        banked = bank_cpu("CPU fallback banked first; TPU probing runs "
+                          "concurrently with the remaining budget")
+
+    # ---- Phase 3: wait for the pool, then measure.
     i = 0  # ladder index survives re-probing: a batch that failed at a
     #        compute stage is not retried after the relay recovers
-    tpu_seen = False   # any canary succeeded: changes the final label
-    n_probes = 0       # canaries launched: the final label must not claim
-    #                    probing that never happened
-    no_plugin = None   # canary ran on a non-TPU backend: probing is moot
-    while i < len(ladder):
-        backend_deadline = _canary_backend_deadline(
-            n_probes, remaining(), fixed_canary_cost, probe_backoff)
-        if backend_deadline is None:
-            break  # not even the base probe fits the budget now
-        deadlines = dict(CANARY_DEADLINES, backend_init=backend_deadline)
-        _log("canary probe %d: backend_init deadline %.0fs (%.0fs budget "
-             "left)" % (n_probes + 1, backend_deadline, remaining()))
-        att = _run_attempt(_Attempt(0, mode="canary", deadlines=deadlines),
-                           remaining() - 10)
-        attempts.append(att)
-        n_probes += 1
-        if (att.outcome == "ok" and att.result is not None
-                and att.result.get("backend") not in (None, "tpu")):
-            # The child env has no TPU plugin registered at all (canary
-            # ran fine on another backend). That is decided by the child's
-            # static environment, not relay state — re-probing cannot
-            # change the answer, so stop burning budget on it.
-            no_plugin = att.result.get("backend")
-            _log("canary reports backend=%r: no TPU plugin in child env; "
-                 "not re-probing" % no_plugin)
+    while pool is not None and i < len(ladder) and remaining() > 60:
+        status = pool.wait(remaining() - 30)
+        if status != "alive":
             break
-        alive = (att.outcome == "ok" and att.result is not None
-                 and att.result.get("canary") == "ok"
-                 and att.result.get("backend") == "tpu")
-        if not alive:
-            att.relay_tcp = _relay_tcp_probe()
-            _log("TPU canary failed (%s); relay tcp %s; %.0fs budget left"
-                 % (att.outcome, att.relay_tcp, remaining()))
-            min_next = fixed_canary_cost + CANARY_MIN_BACKEND
-            if remaining() > min_next + probe_backoff:
-                time.sleep(probe_backoff)
-            continue
-        tpu_seen = True
-        _log("TPU canary ok in %.0fs; starting full measurement (%.0fs "
-             "budget left)" % (att.result.get("seconds", -1), remaining()))
+        _log("starting full measurement (%.0fs budget left)" % remaining())
+        rearm = False
         while i < len(ladder) and remaining() > 60:
             att = _run_attempt(_Attempt(ladder[i]),
                                min(remaining() - 10, 600))
-            attempts.append(att)
+            with alock:
+                attempts.append(att)
             if att.outcome.startswith("ok"):
                 res = dict(att.result)
                 if att.outcome != "ok":
                     res["note"] = ("extras interrupted (%s); core "
                                    "measurement complete" % att.outcome)
-                _emit(res, attempts)
+                _emit(res, attempts, alock)
                 return
             _log("attempt failed: %s (batch=%d)" % (att.outcome, att.batch))
             # Classify by the stage reached: batch size is irrelevant to a
             # backend that won't even initialize — that's the relay
-            # re-wedging, so go back to the canary loop without burning a
-            # ladder rung.
+            # re-wedging, so re-arm the pool without burning a ladder rung.
             if att.stage in ("child_up", "backend_init"):
+                rearm = True
                 break
             i += 1  # compute-side trouble: smaller batch
+        if not rearm:
+            break
+        pool = None
+        if remaining() > fixed_canary_cost + CANARY_MIN_BACKEND:
+            _log("re-arming canary pool after backend-stage failure")
+            pool = _CanaryPool(remaining, probe_backoff, fixed_canary_cost,
+                               attempts, alock).start()
+            pools.append(pool)
+    if pool is not None:
+        pool.stop()  # TERMs any in-flight probe; no orphaned children
+    # Final accounting over every pool armed this run: the closing label
+    # must not claim probing that never happened (or miss one that did).
+    tpu_seen = any(p.alive.is_set() for p in pools)
+    n_probes = sum(p.n_probes for p in pools)
+    no_plugin = next((p.no_plugin for p in pools if p.no_plugin), None)
 
     # ---- Out of budget or ladder. The label must match the evidence:
     # reachable-but-unmeasured, ladder exhausted, unreachable-probed,
@@ -1360,18 +1513,19 @@ def parent_main():
         banked = bank_cpu(note)
     if banked is not None:
         banked["note"] = note
-        _emit(banked, attempts)
+        _emit(banked, attempts, alock)
         return
 
     # Total failure: still emit one parseable JSON line localizing the hang.
-    last = attempts[-1] if attempts else None
+    with alock:
+        last = attempts[-1] if attempts else None
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",
         "value": 0,
         "unit": "images/sec",
         "vs_baseline": 0.0,
         "stage_reached": last.stage if last else "none",
-        "attempts": _attempt_log(attempts),
+        "attempts": _attempt_log(attempts, alock),
     }))
 
 
@@ -1438,8 +1592,11 @@ def _canary_backend_deadline(n_probes, remaining_s, fixed_cost, backoff=0.0):
     return deadline
 
 
-def _attempt_log(attempts):
+def _attempt_log(attempts, alock=None):
     out = []
+    if alock is not None:
+        with alock:
+            attempts = list(attempts)
     for a in attempts:
         rec = {"batch": a.batch, "platform": a.platform or "tpu",
                "mode": a.mode, "outcome": a.outcome,
@@ -1449,6 +1606,13 @@ def _attempt_log(attempts):
         if a.mode == "canary" and a.deadlines is not None:
             rec["backend_init_deadline"] = round(
                 a.deadlines.get("backend_init", 0))
+        # compile-cache provenance per attempt: BENCH_r*.json diffs can
+        # tell a cold-compile round from a warm one without cross-
+        # referencing the headline startup block
+        startup = (a.result or {}).get("startup")
+        if isinstance(startup, dict):
+            rec["cache"] = startup.get("cache")
+            rec["cache_hit"] = startup.get("cache") in ("warm", "aot")
         if a.last_stderr:
             rec["last_stderr"] = a.last_stderr
         if a.relay_tcp is not None:
@@ -1457,9 +1621,9 @@ def _attempt_log(attempts):
     return out
 
 
-def _emit(result, attempts):
+def _emit(result, attempts, alock=None):
     result = dict(result)
-    result["attempts"] = _attempt_log(attempts)
+    result["attempts"] = _attempt_log(attempts, alock)
     print(json.dumps(result))
     sys.stdout.flush()
 

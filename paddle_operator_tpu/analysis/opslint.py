@@ -58,6 +58,19 @@ RULES: Dict[str, Tuple[str, str]] = {
         "raw HTTP (urllib.request/http.client/requests) in reconcile "
         "code: k8s mutations must go through the client wrapper",
     ),
+    "OPS501": (
+        "recompile-hazard",
+        "jax.jit(...) call on a per-step path (inside a loop body, or in "
+        "a function reachable from one): every invocation builds a NEW "
+        "jit wrapper whose compile cache dies with it — hoist it out of "
+        "the loop or route it through compile_cache.cached_jit",
+    ),
+    "OPS502": (
+        "jit-nonhashable-static",
+        "argument at a jit static_argnums position is a list/dict/set "
+        "(unhashable): every call raises or, with a tuple-coerced "
+        "workaround, silently recompiles per distinct value",
+    ),
     "OPS401": (
         "metric-undeclared",
         "emitted metric family has no # TYPE declaration or registry "
@@ -593,6 +606,152 @@ class ReconcilePurityPass(_Pass):
         return findings
 
 
+_JIT_NAMES = ("jax.jit", "jit", "jax.pjit", "pjit")
+
+
+class RecompileHazardPass(_Pass):
+    """OPS501/OPS502: the cold-start work (PR 8) makes compilation a
+    managed resource — a stray ``jax.jit(...)`` executed per step defeats
+    it silently. Every ``jax.jit`` call builds a NEW wrapper object with
+    its own in-memory compile cache; constructed inside a per-step or
+    per-reconcile path (a loop body, or any module-local function
+    reachable from one through the module's call graph) it re-traces —
+    and without the persistent cache re-COMPILES — on every iteration.
+    OPS502 flags call sites that pass a list/dict/set at a declared
+    ``static_argnums`` position: unhashable statics raise at best and
+    recompile per distinct value at worst.
+
+    Purely module-local by design: a loop calling an imported builder
+    (``build_train_step``) is the sanctioned pattern — the builder's own
+    module is linted in its own right.
+    """
+
+    rule_ids = ("OPS501", "OPS502")
+
+    @staticmethod
+    def _called_names(node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                callee = _call_name(sub)
+                if callee:
+                    out.add(callee.rsplit(".", 1)[-1])
+        return out
+
+    def run(self, path: str, tree: ast.Module,
+            source: str) -> List[Finding]:
+        findings: List[Finding] = []
+        funcs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs[node.name] = node
+
+        # seeds: names called from any For/While body (the loop statement
+        # itself, not its else clause — else runs once)
+        seeds: Set[str] = set()
+        loop_bodies: List[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                loop_bodies.extend(node.body)
+        for stmt in loop_bodies:
+            seeds |= self._called_names(stmt)
+
+        # transitive closure over the module-local call graph
+        reachable: Set[str] = set()
+        frontier = [n for n in seeds if n in funcs]
+        while frontier:
+            name = frontier.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            frontier.extend(n for n in self._called_names(funcs[name])
+                            if n in funcs and n not in reachable)
+
+        def flag_jits(scope: ast.AST, where: str) -> None:
+            for sub in ast.walk(scope):
+                if (isinstance(sub, ast.Call)
+                        and _call_name(sub) in _JIT_NAMES):
+                    findings.append(Finding(
+                        "OPS501", path, sub.lineno,
+                        "jax.jit constructed on a per-step path (%s): "
+                        "hoist it above the loop or use "
+                        "compile_cache.cached_jit" % where,
+                        symbol="%s.jit" % where))
+
+        for stmt in loop_bodies:
+            flag_jits(stmt, "loop body")
+        for name in sorted(reachable):
+            flag_jits(funcs[name], name)
+
+        findings.extend(self._nonhashable_statics(path, tree))
+        return findings
+
+    @staticmethod
+    def _static_positions(call: ast.Call) -> Tuple[int, ...]:
+        """Declared static_argnums of a jax.jit(...) call, when literal."""
+        for kw in call.keywords:
+            if kw.arg != "static_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if (isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)):
+                        out.append(e.value)
+                return tuple(out)
+        return ()
+
+    _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                   ast.SetComp, ast.GeneratorExp)
+
+    def _nonhashable_statics(self, path: str,
+                             tree: ast.Module) -> List[Finding]:
+        findings: List[Finding] = []
+        # jitted-name -> static positions (adjusted for the wrapped fn's
+        # signature: static_argnums counts the ORIGINAL args, which map
+        # 1:1 onto the wrapper's)
+        jitted: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Call)
+                    and _call_name(node.value) in _JIT_NAMES):
+                continue
+            statics = self._static_positions(node.value)
+            if not statics:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    jitted[tgt.id] = statics
+
+        def check_call(call: ast.Call, statics: Tuple[int, ...],
+                       sym: str) -> None:
+            for pos in statics:
+                if pos < len(call.args) and isinstance(
+                        call.args[pos], self._UNHASHABLE):
+                    findings.append(Finding(
+                        "OPS502", path, call.args[pos].lineno,
+                        "unhashable literal passed at static_argnums "
+                        "position %d of jitted %s" % (pos, sym),
+                        symbol="%s.static%d" % (sym, pos)))
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id in jitted:
+                check_call(node, jitted[node.func.id], node.func.id)
+            # immediate form: jax.jit(f, static_argnums=...)(args)
+            elif (isinstance(node.func, ast.Call)
+                  and _call_name(node.func) in _JIT_NAMES):
+                statics = self._static_positions(node.func)
+                if statics:
+                    check_call(node, statics, "<inline jit>")
+        return findings
+
+
 def _string_constants(tree: ast.Module) -> List[Tuple[int, str]]:
     out = []
     for node in ast.walk(tree):
@@ -731,7 +890,7 @@ class MetricsConventionsPass(_Pass):
 
 
 _AST_PASSES = (LockDisciplinePass(), ThreadHygienePass(),
-               ReconcilePurityPass())
+               ReconcilePurityPass(), RecompileHazardPass())
 _METRICS_PASS = MetricsConventionsPass()
 
 

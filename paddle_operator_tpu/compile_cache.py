@@ -1,0 +1,657 @@
+"""Compilation cache & AOT step-function layer — the anti-cold-start tax.
+
+Every elastic resize, arbiter preemption, and operator-driven restart
+re-enters :func:`parallel.build_train_step` in a fresh process and pays
+full XLA compilation again (~20 s for the bench ResNet step on CPU, more
+on TPU pods). Singularity (arXiv 2202.07848) makes the point structurally:
+transparent preemption is only cheap if resume is cheap. This module makes
+resume cheap on three rungs, each falling back transparently to the next:
+
+1. **AOT serialized executables** (`aot` rung): ``jax.jit(...).lower(...)
+   .compile()`` keyed by a :func:`step_fingerprint` of (function identity,
+   model/batch avals, mesh shape, sharding + donation signature). The
+   compiled executable is serialized via
+   ``jax.experimental.serialize_executable`` into the cache directory; a
+   warm process deserializes it and skips tracing, lowering AND XLA —
+   milliseconds instead of tens of seconds.
+2. **JAX persistent compilation cache** (`warm` rung): enabled
+   process-wide with a project-managed directory, so even paths that
+   cannot AOT (shape-polymorphic callers, multi-host wrappers) skip the
+   XLA optimization pipeline on recompile. Hit/miss counts are surfaced
+   via ``jax._src.monitoring`` where available.
+3. **Plain ``jax.jit``** (`cold` rung): always correct, always available.
+
+Consistency bar (EasyScale, arXiv 2208.14228): a cached or AOT-compiled
+step must produce bit-identical losses to the fresh-compile reference —
+the executable bytes ARE the reference's bytes (rung 2) or a serialized
+copy of them (rung 1), so this holds by construction and is asserted by
+``tests/test_compile_cache.py``.
+
+Knobs:
+
+* ``TPUJOB_COMPILE_CACHE_DIR`` — cache directory (default
+  ``~/.cache/tpujob/compile``; ``/tmp/tpujob_compile_cache`` fallback).
+* ``TPUJOB_COMPILE_CACHE=0`` — disable both persistent and AOT layers.
+* ``TPUJOB_COMPILE_CACHE_AOT=0`` — disable only executable serialization.
+
+Thread-safety: all mutable module state (stats, the in-process executable
+memo) is guarded by ``_lock``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+log = logging.getLogger("tpujob.compile_cache")
+
+_lock = threading.Lock()
+# fingerprint -> callable (in-process memo: a resumed cycle in the SAME
+# process — elastic restart without pod loss — pays nothing at all)
+_memo: Dict[str, Callable] = {}
+_stats = {
+    "persistent_enabled": False,
+    "persistent_dir": "",
+    # jax persistent-cache events (monitoring hook; -1 = not observable)
+    "persistent_hits": 0,
+    "persistent_misses": 0,
+    # this module's own ladder accounting
+    "memo_hits": 0,
+    "aot_hits": 0,          # deserialized a saved executable from disk
+    "aot_misses": 0,        # compiled AOT fresh (and tried to save)
+    "aot_saves": 0,         # executables serialized to disk
+    "jit_fallbacks": 0,     # AOT unavailable -> plain jax.jit
+    "compile_seconds": 0.0,  # wall spent in lower+compile / jit warmup
+}
+_monitoring_hooked = False
+_enabled_dir: Optional[str] = None
+
+
+def cache_enabled() -> bool:
+    return os.environ.get("TPUJOB_COMPILE_CACHE", "1") != "0"
+
+
+def aot_enabled() -> bool:
+    return cache_enabled() and os.environ.get(
+        "TPUJOB_COMPILE_CACHE_AOT", "1") != "0"
+
+
+def default_cache_dir() -> str:
+    env = os.environ.get("TPUJOB_COMPILE_CACHE_DIR", "")
+    if env:
+        return env
+    home = os.path.expanduser("~")
+    if home and home != "/" and os.path.isdir(home):
+        return os.path.join(home, ".cache", "tpujob", "compile")
+    # no usable $HOME: uid-scoped fallback — AOT entries are pickles, and
+    # a world-shared predictable path would let another local user plant
+    # a payload under a computable fingerprint name
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return "/tmp/tpujob_compile_cache_%d" % uid
+
+
+def _writable_dir(path: str) -> bool:
+    """True iff ``path`` exists (or can be created), accepts writes, and
+    is OWNED by this user. A read-only cache volume must degrade to cold
+    compiles, never crash the training job; a foreign-owned directory
+    must never be trusted at all — `.aotx` entries are pickles, so
+    loading someone else's files is code execution."""
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        if hasattr(os, "getuid") and os.stat(path).st_uid != os.getuid():
+            log.warning("compile cache dir %s is owned by uid %d, not us; "
+                        "refusing to use it", path, os.stat(path).st_uid)
+            return False
+        probe = os.path.join(path, ".wprobe.%d" % os.getpid())
+        with open(probe, "w") as fh:
+            fh.write("ok")
+        os.remove(probe)
+        return True
+    except OSError:
+        return False
+
+
+def _hook_monitoring() -> None:
+    """Count the persistent cache's own hit/miss events. Internal JAX API
+    — version-gated, and its absence only costs observability."""
+    global _monitoring_hooked
+    if _monitoring_hooked:
+        return
+    _monitoring_hooked = True
+    try:
+        from jax._src import monitoring
+
+        def _listener(name, **kwargs):
+            if name.endswith("/compilation_cache/cache_hits"):
+                with _lock:
+                    _stats["persistent_hits"] += 1
+            elif name.endswith("/compilation_cache/cache_misses"):
+                with _lock:
+                    _stats["persistent_misses"] += 1
+
+        monitoring.register_event_listener(_listener)
+    except Exception:  # pragma: no cover - jax internals moved
+        with _lock:
+            _stats["persistent_hits"] = -1
+            _stats["persistent_misses"] = -1
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
+    """Point JAX's persistent compilation cache at the project directory.
+
+    Idempotent; safe to call before or after backend init. Returns True
+    iff the cache is active. Read-only/unwritable directories disable the
+    layer with one warning (the AOT layer checks writability separately).
+    """
+    global _enabled_dir
+    if not cache_enabled():
+        return False
+    path = cache_dir or default_cache_dir()
+    with _lock:
+        if _enabled_dir == path:
+            return _stats["persistent_enabled"]
+    ok = _writable_dir(path)
+    if ok:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", path)
+            # cache everything: the fleet's restart tax is dominated by
+            # many medium programs, not a few giant ones
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            # the cache binds its directory lazily at FIRST compile and the
+            # decision is sticky: a process that already jitted something
+            # (model init, a probe matmul) before this call would silently
+            # keep running uncached — force a re-bind against the new dir
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # pragma: no cover - internal API drift
+                pass
+        except Exception as e:  # config knob missing on this jax
+            log.warning("persistent compilation cache unavailable: %s", e)
+            ok = False
+    else:
+        log.warning("compile cache dir %s not writable; persistent "
+                    "cache disabled", path)
+    with _lock:
+        _enabled_dir = path
+        _stats["persistent_enabled"] = ok
+        _stats["persistent_dir"] = path if ok else ""
+    if ok:
+        _hook_monitoring()
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+_SMALL_ARRAY_HASH_ELEMS = 4096
+
+
+def _describe_code(code) -> str:
+    """Digest of a code object: bytecode + scalar constants (nested code
+    objects recurse). Catches 'same qualname, edited body' collisions
+    without ever repr-ing objects whose repr embeds a memory address."""
+    h = hashlib.sha1(code.co_code)
+    for const in code.co_consts:
+        if isinstance(const, (str, bytes, int, float, bool, complex,
+                              type(None))):
+            h.update(repr(const).encode())
+        elif hasattr(const, "co_code"):
+            h.update(_describe_code(const).encode())
+    return h.hexdigest()[:12]
+
+
+def _describe_fn(fn: Callable, depth: int) -> str:
+    """Function identity INCLUDING its closed-over hyper-parameters.
+
+    A step function closes over the optimizer, which closes over lr /
+    momentum / weight-decay — two optimizers differing only in lr must
+    not share an executable. Closure cells are described recursively
+    (scalars by value, arrays by shape+dtype+small-value digest,
+    functions by code digest + their own closures). Objects with no
+    stable description fall back to default ``repr`` — which embeds a
+    memory address, making the key UNSTABLE across processes: a safe
+    failure (cache miss, fresh compile), never a collision.
+    """
+    import functools
+
+    if depth <= 0:
+        return "fn:depth-capped"
+    if isinstance(fn, functools.partial):
+        return "partial(%s,args=[%s],kw={%s})" % (
+            _describe_fn(fn.func, depth - 1),
+            ",".join(_describe(a, depth - 1) for a in fn.args),
+            ",".join("%s=%s" % (k, _describe(v, depth - 1))
+                     for k, v in sorted(fn.keywords.items())))
+    inner = getattr(fn, "__func__", fn)  # bound method -> function
+    name = "%s.%s" % (getattr(inner, "__module__", "?"),
+                      getattr(inner, "__qualname__",
+                              getattr(inner, "__name__", "?")))
+    code = getattr(inner, "__code__", None)
+    code_d = _describe_code(code) if code is not None else "nocode"
+    cells = getattr(inner, "__closure__", None) or ()
+    closed = []
+    for cell in cells:
+        try:
+            closed.append(_describe(cell.cell_contents, depth - 1))
+        except ValueError:  # empty cell
+            closed.append("emptycell")
+    defaults = getattr(inner, "__defaults__", None) or ()
+    return "fn:%s@%s(%s)(d=%s)" % (
+        name, code_d, ",".join(closed),
+        ",".join(_describe(d, depth - 1) for d in defaults))
+
+
+def _describe(obj: Any, depth: int = 8) -> str:
+    """Stable, cross-process description of one fingerprint component.
+
+    Arrays/avals collapse to shape+dtype (plus a value digest for small
+    arrays); meshes to their (axis, size) items; shardings to their spec
+    repr; pytrees recurse in deterministic key order; callables to code
+    digest + closure contents (see :func:`_describe_fn`). ``id()`` of
+    live objects never leaks in — the key must be identical when a
+    different process rebuilds the same step.
+    """
+    import jax
+
+    import types
+
+    if depth <= 0:
+        return "depth-capped"
+    if obj is None:
+        return "none"
+    if isinstance(obj, (bool, int, float, str, bytes)):
+        return "%s:%r" % (type(obj).__name__, obj)
+    if isinstance(obj, types.ModuleType):
+        # closures routinely capture `np`/`jnp`; the module NAME is the
+        # stable identity (its repr embeds a filesystem path)
+        return "mod:%s" % getattr(obj, "__name__", "?")
+    if isinstance(obj, dict):
+        return "{%s}" % ",".join(
+            "%r=%s" % (k, _describe(obj[k], depth - 1))
+            for k in sorted(obj, key=repr))
+    if isinstance(obj, (list, tuple)):
+        return "[%s]" % ",".join(_describe(x, depth - 1) for x in obj)
+    mesh_cls = getattr(jax.sharding, "Mesh", ())
+    if isinstance(obj, mesh_cls):
+        return "mesh(%s)" % ",".join(
+            "%s=%d" % (a, s) for a, s in obj.shape.items())
+    if isinstance(obj, jax.sharding.Sharding):
+        spec = getattr(obj, "spec", None)
+        return "sharding(%r)" % (spec,)
+    shape = getattr(obj, "shape", None)
+    dtype = getattr(obj, "dtype", None)
+    try:
+        # array-LIKE means an iterable-of-ints shape: a module (np.shape
+        # is a function) or duck-typed object must not take this branch
+        shape = tuple(int(d) for d in shape) if shape is not None else None
+    except (TypeError, ValueError):
+        shape = None
+    if shape is not None and dtype is not None:
+        desc = "%s%r" % (dtype, shape)
+        size = getattr(obj, "size", _SMALL_ARRAY_HASH_ELEMS + 1)
+        if size <= _SMALL_ARRAY_HASH_ELEMS:
+            # closed-over small arrays (masks, tables) are hyper-params:
+            # hash their VALUES or two configs would collide
+            try:
+                import numpy as np
+
+                desc += "#" + hashlib.sha1(
+                    np.asarray(obj).tobytes()).hexdigest()[:10]
+            except Exception:
+                pass  # non-materializable (abstract leaf): shape is enough
+        return desc
+    if callable(obj):
+        return _describe_fn(obj, depth)
+    return "%s:%r" % (type(obj).__name__, obj)
+
+
+def step_fingerprint(fn: Callable, example_args: Tuple,
+                     config: Any = None,
+                     mesh: Any = None,
+                     in_shardings: Any = None,
+                     out_shardings: Any = None,
+                     donate_argnums: Tuple[int, ...] = ()) -> str:
+    """Cache key for one compiled step function.
+
+    Components: jax version + backend (an executable never crosses
+    either), the function identity, the abstract shapes/dtypes of the
+    example args (pytree-flattened WITH structure), the mesh shape, the
+    sharding signature, and the donation signature. ``config`` carries
+    anything the function closes over (model config dict, optimizer
+    hyper-parameters) that the avals alone cannot see.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(example_args)
+    parts = [
+        "jax=%s" % jax.__version__,
+        "backend=%s" % jax.default_backend(),
+        "ndev=%d" % len(jax.devices()),
+        _describe(fn),
+        "tree=%s" % str(treedef),
+        # example args contribute their AVALS only (shape+dtype): they are
+        # data, not config — live values must never destabilize the key
+        "args=%s" % ",".join(
+            "%s%r" % (getattr(l, "dtype", type(l).__name__),
+                      tuple(getattr(l, "shape", ())))
+            for l in leaves),
+        "config=%s" % _describe(config),
+        "mesh=%s" % _describe(mesh),
+        "in_sh=%s" % _describe(in_shardings),
+        "out_sh=%s" % _describe(out_shardings),
+        "donate=%r" % (tuple(donate_argnums),),
+    ]
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# the cached/AOT builder
+# ---------------------------------------------------------------------------
+
+_UNSPEC = object()
+# public alias: "leave this sharding argument off the jit call entirely"
+UNSPECIFIED = _UNSPEC
+
+
+def _abstractify(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype)
+        if hasattr(l, "shape") and hasattr(l, "dtype")
+        else l, tree)
+
+
+def _aot_path(fingerprint: str) -> Optional[str]:
+    with _lock:
+        base = _stats["persistent_dir"]
+    if not base:
+        base = default_cache_dir()
+        if not _writable_dir(base):
+            return None
+    d = os.path.join(base, "aot")
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return None
+    return os.path.join(d, fingerprint + ".aotx")
+
+
+def _try_load_aot(path: str) -> Optional[Callable]:
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load)
+
+        with open(path, "rb") as fh:
+            payload, in_tree, out_tree = pickle.load(fh)
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as e:
+        # stale jax version, torn write, foreign topology: treat as miss
+        # and let the fresh compile overwrite it
+        log.info("discarding unloadable AOT executable %s: %s", path, e)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+
+
+def _try_save_aot(path: str, compiled) -> bool:
+    if not path:
+        return False
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "wb") as fh:
+            pickle.dump((payload, in_tree, out_tree), fh)
+        os.replace(tmp, path)  # atomic publish: readers never see a torn file
+        return True
+    except Exception as e:
+        log.info("AOT executable not serializable on this backend: %s", e)
+        return False
+
+
+class CachedStep:
+    """A compiled step function plus where it came from.
+
+    Callable exactly like the ``jax.jit`` result it replaces. ``source``
+    is one of ``memo`` | ``aot`` | ``compiled`` | ``jit`` — what the
+    bench's ``startup.cache`` field and the runner's result block report.
+
+    An AOT executable is stricter than ``jit`` at the call boundary (no
+    weak-type promotion, exact sharding match): if the FIRST call fails
+    we rebuild once with plain ``jit`` and stay there — a stale or
+    mismatched executable costs one recompile, never the run. After the
+    first success the fallback is disarmed: a mid-training failure is a
+    real error and must surface, not silently re-trace.
+    """
+
+    def __init__(self, fn: Callable, source: str, fingerprint: str,
+                 compile_seconds: float,
+                 fallback: Optional[Callable[[], Callable]] = None,
+                 aot_path: Optional[str] = None):
+        self._fn = fn
+        self._fallback = fallback
+        self._called_ok = False
+        self._aot_path = aot_path
+        self.source = source
+        self.fingerprint = fingerprint
+        self.compile_seconds = compile_seconds
+
+    def __call__(self, *args):
+        if self._called_ok or self._fallback is None:
+            return self._fn(*args)
+        try:
+            out = self._fn(*args)
+        except Exception as e:
+            log.warning("cached executable rejected its first call "
+                        "(%s); rebuilding with plain jit: %s",
+                        self.fingerprint[:12], e)
+            if self._aot_path:
+                # the entry is persistently incompatible with this
+                # process (sharding/weak-type boundary mismatch): leave
+                # it and every future restart pays deserialize + fail +
+                # recompile — delete so the next miss re-saves a good one
+                try:
+                    os.remove(self._aot_path)
+                except OSError:
+                    pass
+            self._fn = self._fallback()
+            self.source = "jit"
+            with _lock:
+                _stats["jit_fallbacks"] += 1
+                _memo[self.fingerprint] = self._fn
+            out = self._fn(*args)
+        self._called_ok = True
+        self._fallback = None
+        return out
+
+
+def cached_jit(fn: Callable, example_args: Tuple,
+               config: Any = None,
+               mesh: Any = None,
+               in_shardings: Any = _UNSPEC,
+               out_shardings: Any = _UNSPEC,
+               donate_argnums: Tuple[int, ...] = (),
+               label: str = "") -> CachedStep:
+    """Build a compiled function down the cache ladder.
+
+    ``example_args`` are live arrays or ShapeDtypeStructs matching the
+    call signature — only shapes/dtypes are read. The returned callable
+    accepts exactly the jit calling convention. On any AOT failure the
+    ladder degrades to plain ``jax.jit`` (with the persistent cache still
+    shaving the XLA pipeline), never raises.
+    """
+    import jax
+
+    jit_kwargs: Dict[str, Any] = {}
+    if in_shardings is not _UNSPEC:
+        jit_kwargs["in_shardings"] = in_shardings
+    if out_shardings is not _UNSPEC:
+        jit_kwargs["out_shardings"] = out_shardings
+    if donate_argnums:
+        jit_kwargs["donate_argnums"] = donate_argnums
+
+    if not cache_enabled():
+        return CachedStep(jax.jit(fn, **jit_kwargs), "jit", "", 0.0)
+
+    enable_persistent_cache()
+    fp = step_fingerprint(
+        fn, example_args, config=config, mesh=mesh,
+        in_shardings=None if in_shardings is _UNSPEC else in_shardings,
+        out_shardings=None if out_shardings is _UNSPEC else out_shardings,
+        donate_argnums=donate_argnums)
+
+    def rebuild():
+        return jax.jit(fn, **jit_kwargs)
+
+    with _lock:
+        hit = _memo.get(fp)
+        if hit is not None:
+            _stats["memo_hits"] += 1
+            return CachedStep(hit, "memo", fp, 0.0)
+
+    abstract = _abstractify(example_args)
+    # DONATING functions never take the AOT rung AT ALL — neither
+    # serialized reuse nor in-process `.lower().compile()`. Calling a
+    # `jax.stages.Compiled` object directly bypasses the donation safety
+    # the jit wrapper enforces (copy-before-donate for buffers it does
+    # not own), so a donated input that aliases externally owned memory —
+    # exactly the checkpoint-restore `device_put`-from-numpy path — gets
+    # SILENTLY overwritten mid-chain: wrong losses, no exception, and
+    # alignment-dependent nondeterminism (found by the resume
+    # bit-identity tests in tests/test_recovery.py). Donating steps go
+    # plain `jax.jit`, which still hits the persistent XLA cache — a warm
+    # process skips the compile pipeline either way; the AOT rung only
+    # ever added the trace+lower shave, worthless against corruption.
+    use_aot = aot_enabled() and not donate_argnums
+    path = _aot_path(fp) if use_aot else None
+
+    if use_aot:
+        loaded = _try_load_aot(path)
+        if loaded is not None:
+            with _lock:
+                _stats["aot_hits"] += 1
+                _memo[fp] = loaded
+            log.info("AOT executable reused for %s (%s)",
+                     label or "step", fp[:12])
+            return CachedStep(loaded, "aot", fp, 0.0, fallback=rebuild,
+                              aot_path=path)
+
+    t0 = time.perf_counter()
+    jitted = jax.jit(fn, **jit_kwargs)
+    compiled: Optional[Callable] = None
+    source = "jit"
+    if use_aot:
+        try:
+            compiled = jitted.lower(*abstract).compile()
+            source = "compiled"
+        except Exception as e:
+            # shape-polymorphic / backend quirks: stay on plain jit — the
+            # persistent cache still applies to its first real call
+            log.info("AOT lowering unavailable for %s, plain jit: %s",
+                     label or "step", e)
+    dt = time.perf_counter() - t0
+    out_fn = compiled if compiled is not None else jitted
+    with _lock:
+        _stats["compile_seconds"] += dt
+        if compiled is not None:
+            _stats["aot_misses"] += 1
+        else:
+            _stats["jit_fallbacks"] += 1
+        _memo[fp] = out_fn
+    if compiled is not None and _try_save_aot(path, compiled):
+        with _lock:
+            _stats["aot_saves"] += 1
+    return CachedStep(out_fn, source, fp, dt,
+                      fallback=rebuild if compiled is not None else None)
+
+
+# ---------------------------------------------------------------------------
+# stats / observability
+# ---------------------------------------------------------------------------
+
+def stats() -> Dict[str, Any]:
+    with _lock:
+        return dict(_stats)
+
+
+def reset_stats_for_tests() -> None:
+    global _enabled_dir
+    with _lock:
+        _memo.clear()
+        _enabled_dir = None
+        _stats.update(persistent_enabled=False, persistent_dir="",
+                      persistent_hits=0, persistent_misses=0, memo_hits=0,
+                      aot_hits=0, aot_misses=0, aot_saves=0,
+                      jit_fallbacks=0, compile_seconds=0.0)
+
+
+def startup_block() -> Dict[str, Any]:
+    """The compact summary bench.py embeds as the ``startup.compile_cache``
+    block and the runner as ``result["compile_cache"]``: which rung served
+    this process, plus the hit/miss ledger."""
+    s = stats()
+    if s["aot_hits"]:
+        cache = "aot"
+    elif s["persistent_hits"] > 0:
+        cache = "warm"
+    else:
+        cache = "cold"
+    return {
+        "cache": cache,
+        "dir": s["persistent_dir"],
+        "persistent_hits": s["persistent_hits"],
+        "persistent_misses": s["persistent_misses"],
+        "aot_hits": s["aot_hits"],
+        "aot_misses": s["aot_misses"],
+        "memo_hits": s["memo_hits"],
+        "jit_fallbacks": s["jit_fallbacks"],
+        "compile_seconds": round(s["compile_seconds"], 2),
+    }
+
+
+def metrics_text() -> str:
+    """Prometheus exposition block — registered into a Manager via
+    ``add_metrics_provider(compile_cache.metrics_text)`` or scraped from
+    the worker endpoint. Families are declared here (opslint OPS401)."""
+    s = stats()
+    lines = [
+        "# HELP tpujob_compile_cache_hits_total compile cache hits by "
+        "layer (persistent XLA cache, serialized AOT executable, "
+        "in-process memo)",
+        "# TYPE tpujob_compile_cache_hits_total counter",
+        'tpujob_compile_cache_hits_total{layer="persistent"} %d'
+        % max(0, s["persistent_hits"]),
+        'tpujob_compile_cache_hits_total{layer="aot"} %d' % s["aot_hits"],
+        'tpujob_compile_cache_hits_total{layer="memo"} %d' % s["memo_hits"],
+        "# HELP tpujob_compile_cache_misses_total compile cache misses "
+        "by layer",
+        "# TYPE tpujob_compile_cache_misses_total counter",
+        'tpujob_compile_cache_misses_total{layer="persistent"} %d'
+        % max(0, s["persistent_misses"]),
+        'tpujob_compile_cache_misses_total{layer="aot"} %d'
+        % s["aot_misses"],
+        "# HELP tpujob_compile_seconds total wall seconds spent "
+        "lowering/compiling step functions in this process",
+        "# TYPE tpujob_compile_seconds gauge",
+        "tpujob_compile_seconds %.3f" % s["compile_seconds"],
+    ]
+    return "\n".join(lines) + "\n"

@@ -19,8 +19,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from . import compile_cache
 from .data import DeferredMetrics, ShardedLoader, job_window_source
 from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distributed
 from .ops.optim import Optimizer
@@ -116,6 +118,34 @@ def _cycle_mesh(axes, elastic=False):
     return make_mesh(axes)
 
 
+def _materialize_state(state):
+    """Fresh, runtime-owned, per-device buffers for a restored state tree.
+
+    ``device_put`` of host (np.load) arrays can alias the numpy memory
+    zero-copy on CPU — a replicated leaf's replicas all sharing one
+    buffer — and feeding such aliases into a DONATING step function makes
+    the runtime overwrite shared memory in place (racing across replicas:
+    silently wrong numerics, nondeterministic by buffer alignment). The
+    copy runs through jit WITHOUT donation, so XLA must allocate fresh
+    output buffers per device; the ops are exact identities per dtype
+    (``x | False`` for bools, ``x * 1`` preserves -0.0/NaN for floats)
+    and `optimization_barrier` keeps XLA from folding them into a
+    parameter pass-through that could re-alias.
+    """
+    def copy_leaf(x):
+        if hasattr(x, "dtype") and x.dtype == jnp.bool_:
+            y = jnp.logical_or(x, False)
+        else:
+            y = x * jnp.ones((), getattr(x, "dtype", None))
+        try:
+            return jax.lax.optimization_barrier(y)
+        except AttributeError:  # older jax: barrier unavailable
+            return y
+
+    return jax.jit(
+        lambda t: jax.tree_util.tree_map(copy_leaf, t))(state)
+
+
 @dataclass
 class TrainJob:
     """Everything the runner needs to train one model."""
@@ -190,6 +220,11 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
     cfg = cfg or detect_env()
     if init_distributed:
         initialize_distributed(cfg)
+
+    # anti-cold-start: every step build below goes down the compile-cache
+    # ladder (AOT executable -> persistent XLA cache -> fresh jit), so a
+    # preempted/resized job's restart pays milliseconds, not a recompile
+    compile_cache.enable_persistent_cache()
 
     result: Dict[str, Any] = {"cycles": 0}
     ckpt_writer = AsyncCheckpointer() if job.async_checkpoint else None
@@ -334,6 +369,10 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             host_local_batches=job.host_local_batches,
         )
         step_fn, state = build(steps_per_call=K)
+        # provenance per cycle: which cache rung served this compile
+        # (memo/aot/compiled/jit) — the resume-cost story in one field
+        result.setdefault("compile_sources", []).append(
+            getattr(step_fn, "source", "jit"))
         single_fn = None  # tail windows shorter than K, built lazily
 
         def make_single_fn():
@@ -366,6 +405,18 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                     restored,
                     jax.tree_util.tree_map(lambda leaf: leaf.sharding, state),
                 )
+            # Materialize into RUNTIME-OWNED, PER-DEVICE buffers before
+            # the state enters the donating step function. `device_put`
+            # of numpy (np.load) arrays can alias the host memory
+            # zero-copy on CPU — every replica of a replicated leaf
+            # sharing ONE buffer — and a later donating call turns that
+            # into racing in-place writes: wrong losses, no exception,
+            # alignment-dependent nondeterminism (bit-identity tests in
+            # tests/test_recovery.py caught it once the persistent
+            # compilation cache started serving reloaded executables).
+            # _materialize_state computes a fresh copy per leaf through
+            # jit WITHOUT donation, so outputs can never alias inputs.
+            state = _materialize_state(state)
             start_step = manifest["step"]
             result.setdefault("resume_steps", []).append(start_step)
             log.info("restored checkpoint step=%d (epoch %s)",
@@ -566,4 +617,5 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
     if goodput_acc["wall"] > 0:
         result["goodput"] = round(
             min(1.0, goodput_acc["step"] / goodput_acc["wall"]), 4)
+    result["compile_cache"] = compile_cache.startup_block()
     return result

@@ -89,6 +89,26 @@ def _leaf_crc(arr: Any) -> int:
     return zlib.crc32(a.tobytes())
 
 
+def _owned_host(arr: Any) -> np.ndarray:
+    """Host snapshot that OWNS its memory.
+
+    ``np.asarray``/``device_get`` of a CPU-backend jax array returns a
+    zero-copy VIEW of the device buffer. If the training loop has already
+    dispatched the next step and that step DONATES the state, the runtime
+    overwrites the viewed memory while the checkpoint writer is still
+    serializing it — the manifest's CRC then hashes different bytes than
+    the npz receives (self-corrupting checkpoints, found by the recovery
+    bit-identity tests once cache-reloaded executables started honoring
+    donation in place). An owned copy pins the snapshot; accelerator
+    backends already return owned host arrays (OWNDATA), so the copy
+    costs nothing there.
+    """
+    a = np.asarray(arr)
+    if not a.flags["OWNDATA"]:
+        a = np.array(a)
+    return a
+
+
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
     out = {}
     if isinstance(tree, dict):
@@ -134,7 +154,9 @@ def save_checkpoint(ckpt_dir: str, step: int, state: Any,
     uncommitted.
     """
     flat = _flatten(state)
-    arrays = {k: np.asarray(v) for k, v in flat.items()}
+    # owned snapshots: a zero-copy view of a donated device buffer would
+    # let in-flight training overwrite the bytes mid-serialization
+    arrays = {k: _owned_host(v) for k, v in flat.items()}
 
     os.makedirs(ckpt_dir, exist_ok=True)
     final = os.path.join(ckpt_dir, "step_%012d" % step)
@@ -214,7 +236,10 @@ class AsyncCheckpointer:
         if self._last_accepted == (ckpt_dir, step):
             _notify("duplicate_save_skipped", dir=ckpt_dir, step=step)
             return
-        host_state = jax.device_get(state)  # snapshot before returning
+        # snapshot before returning — OWNED host copies, not zero-copy
+        # views (the loop keeps training while the writer serializes;
+        # donated device buffers mutate under a view — see _owned_host)
+        host_state = jax.tree_util.tree_map(_owned_host, state)
 
         def write():
             try:
@@ -531,8 +556,9 @@ def save_checkpoint_sharded(ckpt_dir: str, step: int, state: Any,
             fname = "%s.s%d.npy" % (safe, shard.device.id)
             # ONE device->host transfer feeds both the .npy write and the
             # CRC (np.asarray(shard.data) twice would move every shard's
-            # bytes off-device twice, doubling save-path transfer time)
-            host = np.asarray(shard.data)
+            # bytes off-device twice, doubling save-path transfer time);
+            # owned (not a view) so in-flight donation can't mutate it
+            host = _owned_host(shard.data)
             _save_arr(os.path.join(staging, fname), host)
             entries.append({
                 "file": fname,

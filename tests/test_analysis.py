@@ -269,6 +269,121 @@ def test_ops3xx_quiet_on_pure_reconciler():
 
 
 # ---------------------------------------------------------------------------
+# OPS501/OPS502 recompile hazards
+# ---------------------------------------------------------------------------
+
+JIT_IN_LOOP = '''
+import jax
+
+def train(batches):
+    out = []
+    for b in batches:
+        step = jax.jit(lambda x: x * 2)   # planted: new wrapper per step
+        out.append(step(b))
+    return out
+'''
+
+JIT_REACHABLE_FROM_LOOP = '''
+import jax
+
+def _build_step(cfg):
+    return jax.jit(lambda y: y + cfg)    # planted: reachable from a loop
+
+def run(batches):
+    out = []
+    while batches:
+        b = batches.pop()
+        out.append(_build_step(1)(b))
+    return out
+'''
+
+JIT_HOISTED_CLEAN = '''
+import jax
+from paddle_operator_tpu.parallel import build_train_step
+
+step = jax.jit(lambda x: x * 2)          # hoisted: built once, reused
+
+def _consume(state, b):
+    return step(b) + state
+
+def train(batches, state):
+    fn, st = build_train_step()          # imported builder: sanctioned
+    for b in batches:
+        state = _consume(state, b)
+        st, _ = fn(st, b)
+    return state
+'''
+
+NONHASHABLE_STATIC = '''
+import jax
+
+def compute(x, dims):
+    return x.reshape(dims)
+
+step = jax.jit(compute, static_argnums=(1,))
+
+def run(x):
+    return step(x, [4, 8])               # planted: list at static pos
+'''
+
+NONHASHABLE_STATIC_INLINE = '''
+import jax
+
+def run(f, x):
+    return jax.jit(f, static_argnums=1)(x, {"k": 1})  # planted: dict
+'''
+
+HASHABLE_STATIC_CLEAN = '''
+import jax
+
+def compute(x, dims):
+    return x.reshape(dims)
+
+step = jax.jit(compute, static_argnums=(1,))
+
+def run(x):
+    return step(x, (4, 8))               # tuple: hashable, cache-stable
+'''
+
+
+def test_ops501_catches_jit_in_loop_body():
+    findings = opslint.lint_source(JIT_IN_LOOP, "fixture_jit_loop.py")
+    assert rules_of(findings) == {"OPS501"}
+
+
+def test_ops501_catches_jit_reachable_from_loop():
+    """The hazard hides one call deep: a module-local builder invoked
+    from a while body constructs a fresh jit wrapper per iteration."""
+    findings = opslint.lint_source(
+        JIT_REACHABLE_FROM_LOOP, "fixture_jit_reach.py")
+    assert rules_of(findings) == {"OPS501"}
+    assert any("_build_step" in (f.symbol or "") for f in findings)
+
+
+def test_ops501_quiet_on_hoisted_and_imported_builder():
+    """The two sanctioned patterns: module-scope jit (built once) and a
+    loop calling an IMPORTED builder (linted in its own module)."""
+    assert opslint.lint_source(JIT_HOISTED_CLEAN, "fixture_hoisted.py") == []
+
+
+def test_ops502_catches_list_at_static_position():
+    findings = opslint.lint_source(
+        NONHASHABLE_STATIC, "fixture_static.py")
+    assert rules_of(findings) == {"OPS502"}
+
+
+def test_ops502_catches_inline_jit_call_form():
+    findings = opslint.lint_source(
+        NONHASHABLE_STATIC_INLINE, "fixture_static_inline.py")
+    assert rules_of(findings) == {"OPS502"}
+
+
+def test_ops502_quiet_on_hashable_static():
+    assert opslint.lint_source(
+        HASHABLE_STATIC_CLEAN, "fixture_static_clean.py") == []
+
+
+# ---------------------------------------------------------------------------
 # OPS401-403 metrics conventions
 # ---------------------------------------------------------------------------
 

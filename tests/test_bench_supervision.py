@@ -193,6 +193,100 @@ class TestAttemptEvidence:
         assert "backend_init_deadline" not in rec
 
 
+class TestWarmPoolCanary:
+    """PR 8: TPU probes run in a background warm pool. The property under
+    test is the round-5 failure mode's negation — a wedged probe child
+    must never serialize against (or consume) the rest of the budget."""
+
+    @pytest.fixture
+    def hung_child(self, monkeypatch):
+        """Every spawned bench child becomes a sleeper that ignores its
+        protocol entirely: never prints a stage marker, never exits —
+        the exact shape of a wedged backend_init."""
+        import subprocess
+        import sys as _sys
+
+        real_popen = subprocess.Popen
+
+        def popen_hung(cmd, **kw):
+            return real_popen(
+                [_sys.executable, "-c", "import time; time.sleep(600)"],
+                **kw)
+
+        monkeypatch.setattr(bench.subprocess, "Popen", popen_hung)
+
+    def test_pool_runs_concurrently_and_stop_terms_hung_probe(
+            self, hung_child):
+        import threading
+        import time
+
+        attempts, lock = [], threading.Lock()
+        pool = bench._CanaryPool(lambda: 500.0, 1.0, 165.0,
+                                 attempts, lock).start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with lock:
+                    if attempts:
+                        break
+                time.sleep(0.1)
+            with lock:
+                assert attempts, "pool never launched a probe"
+            # the main thread is NOT blocked while the probe hangs: wait
+            # returns 'timeout' promptly instead of riding the deadline
+            t0 = time.monotonic()
+            assert pool.wait(1.0) == "timeout"
+            assert time.monotonic() - t0 < 5
+        finally:
+            t0 = time.monotonic()
+            pool.stop()
+            stop_s = time.monotonic() - t0
+        # stop TERMs the hung child within the grace window — it cannot
+        # ride out its 300+ s backend_init deadline
+        assert stop_s < 30, stop_s
+        assert pool.wait(0) == "gave_up"
+        with lock:
+            assert attempts[0].outcome.startswith("stopped:")
+            assert not attempts[0].result
+
+    def test_wedged_probe_cannot_burn_the_budget(self, hung_child):
+        """Budget-bounded end: with the budget nearly gone, the pool must
+        refuse to launch (deadline None) and reach 'gave_up' on its own —
+        no probe child is ever forked, nothing to wedge."""
+        import threading
+
+        attempts, lock = [], threading.Lock()
+        # 120 s left, fixed cost 100: not even the base probe fits
+        pool = bench._CanaryPool(lambda: 120.0, 1.0, 100.0,
+                                 attempts, lock).start()
+        assert pool.wait(10) == "gave_up"
+        assert pool.n_probes == 0
+        with lock:
+            assert attempts == []
+        pool.stop()  # idempotent on an already-done pool
+
+    def test_attempt_log_carries_cache_provenance(self):
+        att = bench._Attempt(256)
+        att.outcome = "ok"
+        att.result = {"value": 1.0,
+                      "startup": {"cache": "aot", "aot_hits": 2}}
+        (rec,) = bench._attempt_log([att])
+        assert rec["cache"] == "aot" and rec["cache_hit"] is True
+        att.result = {"value": 1.0, "startup": {"cache": "cold"}}
+        (rec,) = bench._attempt_log([att])
+        assert rec["cache"] == "cold" and rec["cache_hit"] is False
+
+    def test_attempt_log_thread_safe_snapshot(self):
+        import threading
+
+        lock = threading.Lock()
+        att = bench._Attempt(0, mode="canary")
+        att.outcome = "stopped:child_up"
+        out = bench._attempt_log([att], lock)
+        assert out[0]["outcome"] == "stopped:child_up"
+        assert "cache" not in out[0]  # no result: no provenance fields
+
+
 @pytest.mark.slow
 class TestCanaryChildOnCpu:
     def test_cpu_canary_records_stage_evidence(self):

@@ -1,0 +1,345 @@
+"""The compile-cache ladder tested end to end on CPU: fingerprint
+stability (in- and cross-process), hit/miss accounting, AOT-vs-jit loss
+bit-identity (the EasyScale consistency bar), and graceful degradation
+when the cache volume is unwritable.
+
+Every test isolates module state via `reset_stats_for_tests` + a tmp
+cache dir — the module is process-global by design (one ladder per
+training process), which a shared pytest process must unwind.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_operator_tpu import compile_cache
+from paddle_operator_tpu.ops import optim
+from paddle_operator_tpu.parallel import build_train_step
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "compile")
+    monkeypatch.setenv("TPUJOB_COMPILE_CACHE_DIR", d)
+    compile_cache.reset_stats_for_tests()
+    yield d
+    compile_cache.reset_stats_for_tests()
+
+
+def _mlp_loss(params, batch):
+    h = jnp.tanh(batch["x"] @ params["w1"])
+    out = h @ params["w2"]
+    return ((out - batch["y"]) ** 2).mean(), {}
+
+
+def _mlp_setup(seed=0):
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(seed), 4)
+    params = {"w1": jax.random.normal(k1, (16, 32), jnp.float32) * 0.1,
+              "w2": jax.random.normal(k2, (32, 4), jnp.float32) * 0.1}
+    batch = {"x": jax.random.normal(k3, (8, 16), jnp.float32),
+             "y": jax.random.normal(k4, (8, 4), jnp.float32)}
+    return params, batch
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_stable_within_process(self, cache_dir):
+        params, batch = _mlp_setup()
+        fp1 = compile_cache.step_fingerprint(_mlp_loss, (params, batch))
+        fp2 = compile_cache.step_fingerprint(_mlp_loss, (params, batch))
+        assert fp1 == fp2
+
+    def test_values_do_not_destabilize_key(self, cache_dir):
+        """Example args contribute avals only: a DIFFERENT random params
+        tree with the same shapes/dtypes must produce the SAME key (this
+        is what makes warm-process reuse possible at all)."""
+        p1, b1 = _mlp_setup(seed=0)
+        p2, b2 = _mlp_setup(seed=7)
+        assert (compile_cache.step_fingerprint(_mlp_loss, (p1, b1))
+                == compile_cache.step_fingerprint(_mlp_loss, (p2, b2)))
+
+    def test_shape_changes_key(self, cache_dir):
+        p, b = _mlp_setup()
+        b2 = {"x": jnp.zeros((16, 16), jnp.float32),
+              "y": jnp.zeros((16, 4), jnp.float32)}
+        assert (compile_cache.step_fingerprint(_mlp_loss, (p, b))
+                != compile_cache.step_fingerprint(_mlp_loss, (p, b2)))
+
+    def test_closure_hyperparams_change_key(self, cache_dir):
+        """Two optimizers differing only in a closed-over scalar (lr)
+        must not share an executable."""
+        def make(lr):
+            def upd(p):
+                return jax.tree_util.tree_map(lambda l: l - lr * l, p)
+            return upd
+
+        p, _ = _mlp_setup()
+        assert (compile_cache.step_fingerprint(make(0.1), (p,))
+                != compile_cache.step_fingerprint(make(0.2), (p,)))
+
+    def test_donation_and_config_change_key(self, cache_dir):
+        p, b = _mlp_setup()
+        base = compile_cache.step_fingerprint(_mlp_loss, (p, b))
+        assert base != compile_cache.step_fingerprint(
+            _mlp_loss, (p, b), donate_argnums=(0,))
+        assert base != compile_cache.step_fingerprint(
+            _mlp_loss, (p, b), config={"accum": 4})
+
+    @pytest.mark.slow
+    def test_stable_across_processes(self, cache_dir):
+        """The key a fresh process computes for the same (function, avals,
+        config) must match this process's — otherwise a restarted job can
+        never hit the cache. Two fresh interpreters, same snippet."""
+        snippet = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import jax, jax.numpy as jnp\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from paddle_operator_tpu import compile_cache\n"
+            "from tests.test_compile_cache import _mlp_loss, _mlp_setup\n"
+            "p, b = _mlp_setup(seed=int(sys.argv[1]))\n"
+            "print(compile_cache.step_fingerprint(\n"
+            "    _mlp_loss, (p, b), config={'accum': 2}))\n" % REPO)
+        outs = []
+        for seed in ("0", "5"):  # different VALUES, same avals
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            out = subprocess.run(
+                [sys.executable, "-c", snippet, seed], check=True,
+                capture_output=True, text=True, env=env, cwd=REPO,
+                timeout=240).stdout.strip()
+            outs.append(out.splitlines()[-1])
+        assert outs[0] == outs[1]
+        assert len(outs[0]) == 32
+
+
+# ---------------------------------------------------------------------------
+# the ladder: memo / aot / persistent / fallback
+# ---------------------------------------------------------------------------
+
+class TestCachedJit:
+    def test_cold_compile_then_memo_hit(self, cache_dir):
+        p, b = _mlp_setup()
+        f1 = compile_cache.cached_jit(_mlp_loss, (p, b))
+        assert f1.source in ("compiled", "jit")
+        loss1, _ = f1(p, b)
+        f2 = compile_cache.cached_jit(_mlp_loss, (p, b))
+        assert f2.source == "memo"
+        loss2, _ = f2(p, b)
+        assert float(loss1) == float(loss2)
+        s = compile_cache.stats()
+        assert s["memo_hits"] == 1
+        assert s["aot_misses"] + s["jit_fallbacks"] == 1
+        assert s["compile_seconds"] > 0
+
+    def test_aot_hit_after_simulated_restart(self, cache_dir):
+        """reset_stats_for_tests clears the in-process memo — the next
+        build must find the serialized executable on disk (what a real
+        restarted process does) and skip compilation entirely."""
+        p, b = _mlp_setup()
+        f1 = compile_cache.cached_jit(_mlp_loss, (p, b))
+        if f1.source != "compiled":
+            pytest.skip("backend cannot serialize executables")
+        loss_cold, _ = f1(p, b)
+
+        compile_cache.reset_stats_for_tests()
+        os.environ["TPUJOB_COMPILE_CACHE_DIR"] = cache_dir  # fixture env
+        f2 = compile_cache.cached_jit(_mlp_loss, (p, b))
+        assert f2.source == "aot"
+        loss_warm, _ = f2(p, b)
+        # EasyScale consistency bar: the deserialized executable IS the
+        # reference's bytes — losses bit-identical, not merely close
+        assert float(loss_cold) == float(loss_warm)
+        s = compile_cache.stats()
+        assert s["aot_hits"] == 1 and s["compile_seconds"] == 0.0
+
+    def test_aot_and_jit_losses_bit_identical_multi_step(self, cache_dir):
+        """Full train-step equivalence: N steps through the cache ladder
+        vs N steps through plain jit — losses bit-identical at every step
+        (same executable bytes, EasyScale bar)."""
+        params, batch = _mlp_setup()
+        opt = optim.sgd(0.1, momentum=0.9)
+
+        step_c, state_c = build_train_step(
+            _mlp_loss, opt, params, batch, cache=True)
+        step_j, state_j = build_train_step(
+            _mlp_loss, opt, params, batch, cache=False)
+        for _ in range(4):
+            state_c, mc = step_c(state_c, batch)
+            state_j, mj = step_j(state_j, batch)
+            assert float(mc["loss"]) == float(mj["loss"])
+
+    def test_disable_switch(self, cache_dir, monkeypatch):
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE", "0")
+        p, b = _mlp_setup()
+        f = compile_cache.cached_jit(_mlp_loss, (p, b))
+        assert f.source == "jit"
+        f(p, b)
+        assert os.listdir(cache_dir) == [] if os.path.isdir(cache_dir) \
+            else True  # nothing written anywhere
+
+    def test_unwritable_cache_dir_degrades_not_crashes(self, monkeypatch,
+                                                       tmp_path):
+        """A read-only cache volume must cost the caching, never the job.
+        (The dir is placed under a regular FILE so even root's permission
+        bypass can't create it.)"""
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a directory")
+        bad = str(blocker / "cache")
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_DIR", bad)
+        compile_cache.reset_stats_for_tests()
+        try:
+            assert compile_cache.enable_persistent_cache() is False
+            s = compile_cache.stats()
+            assert s["persistent_enabled"] is False
+            p, b = _mlp_setup()
+            f = compile_cache.cached_jit(_mlp_loss, (p, b))
+            loss, _ = f(p, b)  # still computes
+            assert np.isfinite(float(loss))
+        finally:
+            compile_cache.reset_stats_for_tests()
+
+    def test_corrupt_aot_file_is_discarded(self, cache_dir):
+        p, b = _mlp_setup()
+        f1 = compile_cache.cached_jit(_mlp_loss, (p, b))
+        if f1.source != "compiled":
+            pytest.skip("backend cannot serialize executables")
+        aot_dir = os.path.join(cache_dir, "aot")
+        (entry,) = os.listdir(aot_dir)
+        path = os.path.join(aot_dir, entry)
+        with open(path, "wb") as fh:
+            fh.write(b"torn write garbage")
+        compile_cache.reset_stats_for_tests()
+        os.environ["TPUJOB_COMPILE_CACHE_DIR"] = cache_dir
+        f2 = compile_cache.cached_jit(_mlp_loss, (p, b))
+        assert f2.source in ("compiled", "jit")  # treated as a miss
+        assert not os.path.exists(path) or f2.source == "compiled"
+        loss, _ = f2(p, b)
+        assert np.isfinite(float(loss))
+
+    def test_startup_block_reports_rung(self, cache_dir):
+        p, b = _mlp_setup()
+        compile_cache.enable_persistent_cache()
+        blk = compile_cache.startup_block()
+        assert blk["cache"] == "cold"
+        f1 = compile_cache.cached_jit(_mlp_loss, (p, b))
+        if f1.source != "compiled":
+            pytest.skip("backend cannot serialize executables")
+        compile_cache.reset_stats_for_tests()
+        os.environ["TPUJOB_COMPILE_CACHE_DIR"] = cache_dir
+        compile_cache.cached_jit(_mlp_loss, (p, b))
+        blk = compile_cache.startup_block()
+        assert blk["cache"] == "aot" and blk["aot_hits"] == 1
+
+    def test_metrics_text_is_valid_exposition(self, cache_dir):
+        from paddle_operator_tpu import obs
+
+        p, b = _mlp_setup()
+        compile_cache.cached_jit(_mlp_loss, (p, b))
+        text = compile_cache.metrics_text()
+        assert obs.parse_exposition(text) == []  # strictly valid
+        for family in ("tpujob_compile_cache_hits_total",
+                       "tpujob_compile_cache_misses_total",
+                       "tpujob_compile_seconds"):
+            assert "# TYPE %s " % family in text
+
+
+@pytest.mark.slow
+class TestWarmCacheResumeIdentity:
+    """Regression for the nastiest failure this layer can produce:
+    executables RELOADED from the persistent compilation cache honor
+    donation with in-place buffer writes, and combined with zero-copy
+    host views on the restore (`device_put` of np.load arrays) and save
+    (`np.asarray` of device buffers) paths, resumed training silently
+    diverged — wrong losses, no exception, alignment-dependent
+    nondeterminism. Fixed by `runner._materialize_state` (restore side),
+    `checkpoint._owned_host` (save side), and the AOT rung refusing
+    donating functions. This test replays the full scenario across real
+    processes: train cold, resume WARM (cache hits), resume with the
+    cache disabled (truth) — bit-identical final losses required."""
+
+    SNIPPET = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from paddle_operator_tpu.chaos.recovery import (\n"
+        "    tiny_linear_job, linear_batch_source)\n"
+        "from paddle_operator_tpu.launch import LaunchConfig\n"
+        "from paddle_operator_tpu.runner import run_training\n"
+        "out = run_training(\n"
+        "    tiny_linear_job(sys.argv[1], linear_batch_source(),\n"
+        "                    total_steps=int(sys.argv[2])),\n"
+        "    cfg=LaunchConfig(worker_id=0, num_workers=1),\n"
+        "    init_distributed=False)\n"
+        "print('LOSS', float(out['loss']).hex(),\n"
+        "      out.get('resume_steps'), out.get('compile_sources'))\n"
+        % REPO)
+
+    def _run(self, ckpt_dir, steps, cache_dir, cache="1"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPUJOB_COMPILE_CACHE=cache,
+                   TPUJOB_COMPILE_CACHE_DIR=cache_dir)
+        out = subprocess.run(
+            [sys.executable, "-c", self.SNIPPET, str(ckpt_dir), str(steps)],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        line = [l for l in out.stdout.splitlines() if l.startswith("LOSS")][-1]
+        return line.split()[1], line
+
+    def test_warm_cache_resume_bit_identical(self, tmp_path):
+        import shutil
+
+        cache = str(tmp_path / "cache")
+        train_dir = tmp_path / "ckpt"
+        # process A: cold train to 10 (writes checkpoints + warms cache)
+        self._run(train_dir, 10, cache)
+        # identical checkpoint dirs for the two resume legs
+        warm_dir, truth_dir = tmp_path / "warm", tmp_path / "truth"
+        shutil.copytree(train_dir, warm_dir)
+        shutil.copytree(train_dir, truth_dir)
+        # process B: resume + continue WARM (cache-served executables)
+        warm_loss, warm_line = self._run(warm_dir, 16, cache)
+        # process C: same resume with the whole ladder disabled (truth)
+        truth_loss, truth_line = self._run(truth_dir, 16, cache, cache="0")
+        # really resumed (newest periodic boundary = step 8 of 10)
+        assert "[8]" in warm_line, warm_line
+        assert "[8]" in truth_line, truth_line
+        assert warm_loss == truth_loss, (warm_line, truth_line)
+
+
+# ---------------------------------------------------------------------------
+# runner integration: the resume path pays no second compile
+# ---------------------------------------------------------------------------
+
+class TestTrainStepIntegration:
+    def test_make_state_goes_through_cache(self, cache_dir):
+        """Satellite fix: the optimizer-state builder compiles through
+        the ladder too, so a preempt->resume cycle reuses it."""
+        params, batch = _mlp_setup()
+        opt = optim.sgd(0.1, momentum=0.9)
+        build_train_step(_mlp_loss, opt, params, batch, cache=True)
+        s = compile_cache.stats()
+        # two cached builds happened: make_state + the step function
+        assert s["aot_misses"] + s["jit_fallbacks"] + s["aot_hits"] >= 2
+
+    def test_rebuild_in_process_hits_memo(self, cache_dir):
+        params, batch = _mlp_setup()
+        opt = optim.sgd(0.1, momentum=0.9)
+        step1, state = build_train_step(
+            _mlp_loss, opt, params, batch, cache=True)
+        before = compile_cache.stats()["memo_hits"]
+        step2, _ = build_train_step(
+            _mlp_loss, opt, params, batch, cache=True)
+        assert compile_cache.stats()["memo_hits"] >= before + 2
+        assert step2.source == "memo"
+        # the memo'd step still trains
+        state, m = step2(state, batch)
+        assert np.isfinite(float(m["loss"]))
