@@ -327,7 +327,9 @@ def child_main():
         t0 = time.perf_counter()
         for _ in range(STEPS):
             state, metrics = step(state, batch_data)
-        float(metrics["loss"])
+        # ONE amortized sync per STEPS-step window — the measurement
+        # barrier itself, not a per-step stall
+        float(metrics["loss"])  # opslint: disable=OPS801
         dt = time.perf_counter() - t0
         window_rates.append(batch * STEPS / dt)
     images_per_sec = max(window_rates)
@@ -566,7 +568,8 @@ def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
     for _ in range(2):
         t0 = time.perf_counter()
         state, m = step(state, window)
-        float(m["loss"][-1])  # real completion of all K steps
+        # the timing barrier: one sync per K-step fused window
+        float(m["loss"][-1])  # opslint: disable=OPS801
         dt = (time.perf_counter() - t0) / K
         best = dt if best is None else min(best, dt)
     ips = batch / best
@@ -845,7 +848,8 @@ def _gang_latency_bench():
                 time.sleep(0.05)
             time.sleep(0.005)
 
-    kt = threading.Thread(target=kubelet, daemon=True)
+    kt = threading.Thread(target=kubelet, name="bench-kubelet",
+                          daemon=True)
     n_jobs = int(os.environ.get("BENCH_GANG_JOBS", "7"))
     lats, timed_out = [], 0
     try:
@@ -1199,8 +1203,10 @@ def _run_attempt(att, budget_s, stop=None):
         for line in proc.stdout:
             att.stdout_lines.append(line.strip())
 
-    t_err = threading.Thread(target=read_stderr, daemon=True)
-    t_out = threading.Thread(target=read_stdout, daemon=True)
+    t_err = threading.Thread(target=read_stderr, name="child-stderr",
+                             daemon=True)
+    t_out = threading.Thread(target=read_stdout, name="child-stdout",
+                             daemon=True)
     t_err.start()
     t_out.start()
 
@@ -1287,6 +1293,12 @@ class _CanaryPool:
         self._fixed = fixed_cost
         self._attempts = attempts
         self._alock = alock
+        # make race: the attempt log is shared between the pool thread
+        # and the parent's measurement path — every touch must hold
+        # _alock (no-op when the detector is off)
+        from paddle_operator_tpu.analysis import racedetect
+
+        racedetect.guard_fields(self, "_alock", ["_attempts"])
         self.alive = threading.Event()
         self.no_plugin = None
         self.n_probes = 0
@@ -1555,7 +1567,8 @@ def _relay_tcp_probe():
 
     # concurrent: a SYN-dropping host would otherwise cost 2 serial
     # timeouts of canary-probing budget per failed attempt
-    threads = [threading.Thread(target=check, args=(p,), daemon=True)
+    threads = [threading.Thread(target=check, args=(p,), daemon=True,
+                                name="relay-probe-%d" % p)
                for p in (8082, 8083)]
     for t in threads:
         t.start()

@@ -1,18 +1,27 @@
 """Project-specific static analysis + runtime race/deadlock detection.
 
-Two halves (see docs/static-analysis.md for the rule catalog):
+Three halves (see docs/static-analysis.md for the rule catalog):
 
-* :mod:`.opslint` — AST lint passes encoding the operator's own
-  concurrency and reconcile contracts: lock discipline (OPS1xx), thread
-  hygiene (OPS2xx), reconcile purity (OPS3xx), and metrics conventions
-  (OPS4xx). Run via ``scripts/opslint.py`` / ``make analyze``.
+* :mod:`.opslint` — per-function AST lint passes encoding the operator's
+  own concurrency and reconcile contracts: lock discipline (OPS1xx),
+  thread hygiene (OPS2xx), reconcile purity (OPS3xx), metrics
+  conventions (OPS4xx), recompile hazards (OPS5xx), and the OPS001
+  stale-suppression audit.
+* :mod:`.dataflow` + :mod:`.ops6xx`/:mod:`.ops7xx`/:mod:`.ops8xx` — an
+  interprocedural dataflow core (project-wide call graph, buffer
+  provenance / mesh-axis / device-residency abstract values, function
+  summaries) carrying the TPU-correctness families: buffer ownership &
+  donation (OPS6xx — the PR 8 donation-aliasing corruption, statically),
+  mesh/collective consistency (OPS7xx), and blocking-transfer hot-path
+  checks (OPS8xx). :mod:`.engine` runs every family over one shared
+  parse; ``scripts/analyze_all.py`` / ``make analyze`` drive it.
 * :mod:`.racedetect` — instrumented ``threading`` lock wrappers that
   record the lock-acquisition-order graph across threads, detect
   order-inversion cycles (potential deadlocks) and long-hold outliers,
   plus a happens-before checker for declared shared fields. Switched on
   over the whole test suite with ``TPUJOB_RACE_DETECT=1`` (``make race``).
 
-Both are stdlib-only; nothing here imports jax or the k8s stack, so the
+All stdlib-only; nothing here imports jax or the k8s stack, so the
 tooling lints the operator without executing it.
 """
 

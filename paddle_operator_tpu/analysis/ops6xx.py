@@ -1,0 +1,118 @@
+"""OPS6xx — buffer ownership & donation (the PR 8 corruption, statically).
+
+The bug class these rules exist for produced *silently wrong losses with
+no exception*: reloaded (persistent-cache / AOT) executables honor
+``donate_argnums`` with real in-place writes, and two zero-copy
+conveniences hand them buffers the runtime does not own —
+``device_put`` of an ``np.load``/mmap array aliases the host memory on
+CPU backends (every replica of a replicated leaf sharing ONE buffer),
+and ``np.asarray``/``device_get`` of a device buffer is a host view the
+next donating step overwrites mid-serialization. PR 8 found both at
+runtime via bit-identity tests; these rules find the *flow* —
+``np.load → device_put → donating call site`` — across function
+boundaries, before anything runs.
+
+Rules:
+
+* **OPS601 donated-alias** — a value carrying zero-copy provenance
+  (host view, or device-aliasing-host) reaches a ``donate_argnums``
+  position of a donating callable. The fix is an owned copy on the way
+  in (``runner._materialize_state``; ``np.array``; a fresh non-donating
+  jit identity).
+* **OPS602 use-after-donate** — a variable whose tree was donated to a
+  step call is used again without reassignment. Donated buffers are
+  dead; XLA may already have overwritten them.
+* **OPS603 unowned-snapshot** — a host *view* of device bytes
+  (``np.asarray``/``device_get`` of a jax array) reaches a persist sink
+  (``np.save``/``np.savez``/``pickle.dump`` or a function that forwards
+  to one). Snapshot with ``checkpoint._owned_host`` / ``np.array``
+  instead, or the next donating step rewrites the bytes under the
+  serializer (checkpoint CRC != payload).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .dataflow import (
+    DEVICE_ALIAS, DONATED, HOST_OF_DEVICE, HOST_VIEW,
+    AbstractValue, DataflowPass, FnContext,
+)
+from . import opslint
+from .opslint import Finding
+
+RULES: Dict[str, Tuple[str, str]] = {
+    "OPS601": (
+        "donated-alias",
+        "zero-copy host-view buffer (np.load/mmap via device_put, or a "
+        "raw host array) reaches a donate_argnums call site: donation "
+        "writes in place through the alias — silent numeric corruption",
+    ),
+    "OPS602": (
+        "use-after-donate",
+        "value used after its tree was donated to a step call: donated "
+        "buffers are dead and may already be overwritten",
+    ),
+    "OPS603": (
+        "unowned-snapshot",
+        "checkpoint/persist of a zero-copy host VIEW of device bytes "
+        "(np.asarray/device_get of a jax array): a later donating step "
+        "mutates the bytes mid-serialization — take an owned copy",
+    ),
+}
+opslint.RULES.update(RULES)  # findings render through the shared catalog
+
+
+class BufferOwnershipPass(DataflowPass):
+    rule_ids = ("OPS601", "OPS602", "OPS603")
+
+    def on_donating_call(self, ctx: FnContext, call: ast.Call,
+                         pos: int, value: AbstractValue,
+                         label: str, out: List[Finding]) -> None:
+        if DEVICE_ALIAS in value.tags:
+            out.append(Finding(
+                "OPS601", ctx.path, call.lineno,
+                "argument %d of donating call %s may alias externally "
+                "owned host memory%s: donation writes through the alias "
+                "in place — materialize an owned copy first"
+                % (pos, label, value.origin_note()),
+                symbol="%s.donate%d" % (label, pos)))
+        elif HOST_VIEW in value.tags:
+            out.append(Finding(
+                "OPS601", ctx.path, call.lineno,
+                "argument %d of donating call %s is a zero-copy host "
+                "view%s: the runtime device_puts and may donate the "
+                "aliased memory — pass an owned copy"
+                % (pos, label, value.origin_note()),
+                symbol="%s.donate%d.hostview" % (label, pos)))
+
+    def on_use(self, ctx: FnContext, node: ast.AST, name: str,
+               value: AbstractValue, out: List[Finding]) -> None:
+        if DONATED not in value.tags:
+            return
+        line = getattr(node, "lineno", 0)
+        out.append(Finding(
+            "OPS602", ctx.path, line,
+            "%r is used after its tree was donated%s: donated buffers "
+            "are dead — rebind the variable to the step's returned "
+            "state" % (name, value.origin_note()),
+            symbol="%s.%s.use_after_donate"
+            % (ctx.fn.simple_name, name)))
+
+    def on_persist(self, ctx: FnContext, call: ast.Call,
+                   value: AbstractValue, label: str,
+                   out: List[Finding]) -> None:
+        if HOST_OF_DEVICE in value.tags:
+            out.append(Finding(
+                "OPS603", ctx.path, call.lineno,
+                "%s persists a zero-copy host view of device bytes%s: "
+                "an in-flight donating step can overwrite them "
+                "mid-serialization — snapshot with an owned copy "
+                "(checkpoint._owned_host / np.array)"
+                % (label, value.origin_note()),
+                symbol="%s.unowned_snapshot" % label))
+
+
+def make_passes() -> List[DataflowPass]:
+    return [BufferOwnershipPass()]

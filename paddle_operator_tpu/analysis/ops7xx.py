@@ -1,0 +1,327 @@
+"""OPS7xx — mesh / collective consistency.
+
+The reshard-on-resize arc (ROADMAP #2) multiplies mesh-axis mistakes:
+a ``psum`` over an axis the mesh does not have, a ``PartitionSpec``
+naming a typo'd axis, a ``shard_map`` whose in_specs don't match the
+wrapped function. At runtime these surface as deep XLA errors (or —
+for specs silently dropped — as *no sharding at all*); statically they
+are name/arity checks against the meshes the project actually builds.
+
+The **axis universe** is collected by :class:`dataflow.Project` from
+every statically visible mesh construction (``make_mesh({'dp': 2})``,
+``make_hybrid_mesh``, ``Mesh(arr, ('dp', 'tp'))``, ``mesh_axes={...}``)
+plus the axis vocabulary declared by ``axis``/``*_axis`` parameter
+defaults — over the analyzed tree *and* the tests/examples that build
+the exotic meshes (``axis_paths``).
+
+Rules:
+
+* **OPS701 collective-axis-unknown** — a collective
+  (``psum``/``all_gather``/``ppermute``/…) names a literal axis that no
+  mesh in the project defines.
+* **OPS702 pspec-axis-unknown** — a ``PartitionSpec``/``P`` literal
+  names an axis outside the universe, at a *strict* site
+  (``NamedSharding``, ``in_specs``/``out_specs``,
+  ``in_shardings``/``out_shardings``, or a variable feeding one).
+  Rule-table specs — ``(regex, P(...))`` pairs in a list literal — are
+  exempt by contract: ``sharding.named()`` drops axes the target mesh
+  lacks so one table serves many meshes. The dataflow hook additionally
+  checks specs against the *specific* mesh when it is statically known
+  (``NamedSharding(mesh, P('ep'))`` where ``mesh`` was built without
+  ``ep``).
+* **OPS703 spec-arity-mismatch** — ``shard_map``/``jit`` whose
+  ``in_specs``/``in_shardings`` tuple length differs from the wrapped
+  function's positional arity (decorator and direct forms).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .dataflow import (
+    AbstractValue, DataflowPass, FnContext, ModuleInfo, Project, _dotted,
+)
+from . import opslint
+from .opslint import Finding
+
+RULES: Dict[str, Tuple[str, str]] = {
+    "OPS701": (
+        "collective-axis-unknown",
+        "collective (psum/all_gather/ppermute/...) names a mesh axis "
+        "no statically visible mesh defines — a typo here is a runtime "
+        "'unbound axis name' crash inside the compiled step",
+    ),
+    "OPS702": (
+        "pspec-axis-unknown",
+        "PartitionSpec names an axis no mesh defines (or not the mesh "
+        "it is applied to): GSPMD either errors or silently drops the "
+        "sharding",
+    ),
+    "OPS703": (
+        "spec-arity-mismatch",
+        "shard_map/jit in_specs/in_shardings tuple length differs from "
+        "the wrapped function's positional arity",
+    ),
+}
+opslint.RULES.update(RULES)  # findings render through the shared catalog
+
+# collective name -> index of the positional axis-name argument
+_COLLECTIVES: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "all_gather": 1, "ppermute": 1, "all_to_all": 1,
+    "psum_scatter": 1, "pswapaxes": 1, "axis_index": 0, "pbroadcast": 1,
+}
+
+_SPEC_KWARGS = ("in_specs", "out_specs", "in_shardings", "out_shardings")
+
+_P_NAMES = ("P", "PartitionSpec")
+
+
+def _axis_literals(node: ast.AST) -> List[Tuple[str, int]]:
+    """(axis, line) string literals inside an axis argument — a bare
+    string or a tuple/list of strings."""
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append((node.value, node.lineno))
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e.lineno))
+    return out
+
+
+def _p_literal_axes(call: ast.Call) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for arg in call.args:
+        out.extend(_axis_literals(arg))
+    return out
+
+
+def _name_feeds_spec(mod: ModuleInfo, name: str) -> bool:
+    """Does the variable ``name`` appear inside a strict spec position
+    (a spec kwarg or a NamedSharding argument) anywhere in the module?"""
+    for node in ast.walk(mod.tree):
+        holders: List[ast.AST] = []
+        if isinstance(node, ast.keyword) and node.arg in _SPEC_KWARGS:
+            holders.append(node.value)
+        elif isinstance(node, ast.Call) and \
+                _dotted(node.func).rsplit(".", 1)[-1] == "NamedSharding":
+            holders.extend(node.args)
+        for holder in holders:
+            for sub in ast.walk(holder):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+    return False
+
+
+class MeshConsistencyPass(DataflowPass):
+    rule_ids = ("OPS701", "OPS702", "OPS703")
+
+    # -- dataflow hook: specific-mesh checks ----------------------------
+
+    def on_call(self, ctx: FnContext, call: ast.Call, callee: str,
+                arg_vals: List[AbstractValue],
+                kw_vals: Dict[Optional[str], AbstractValue],
+                out: List[Finding]) -> None:
+        short = callee.rsplit(".", 1)[-1] if callee else ""
+        mesh_axes = None
+        spec_nodes: List[ast.AST] = []
+        if short == "NamedSharding" and len(call.args) >= 2:
+            mesh_axes = arg_vals[0].axes if arg_vals else None
+            spec_nodes = [call.args[1]]
+        elif short == "shard_map":
+            for kw in call.keywords:
+                if kw.arg == "mesh":
+                    mesh_axes = kw_vals.get("mesh", AbstractValue()).axes
+                elif kw.arg in ("in_specs", "out_specs"):
+                    spec_nodes.append(kw.value)
+        if mesh_axes is None or not spec_nodes:
+            return
+        universe = ctx.project.mesh_axes
+        for spec_node in spec_nodes:
+            for sub in ast.walk(spec_node):
+                if isinstance(sub, ast.Call) and \
+                        _dotted(sub.func).rsplit(".", 1)[-1] in _P_NAMES:
+                    for axis, line in _p_literal_axes(sub):
+                        if axis not in mesh_axes and axis in universe:
+                            # outside the universe the module sweep
+                            # already reports it; here: right name,
+                            # wrong mesh
+                            out.append(Finding(
+                                "OPS702", ctx.path, line,
+                                "PartitionSpec axis %r is not an axis "
+                                "of the mesh it is applied to (mesh "
+                                "axes: %s)" % (
+                                    axis,
+                                    ",".join(sorted(mesh_axes))),
+                                symbol="pspec.%s.wrong_mesh" % axis))
+
+    # -- module sweep: universe + arity checks --------------------------
+
+    def sweep_module(self, project: Project,
+                     mod: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        universe = project.mesh_axes
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        def strictness(pcall: ast.Call) -> Optional[str]:
+            """Is this P(...) literal at a strict site? Returns a label,
+            or None (rule-table / unknown context: exempt)."""
+            cur: ast.AST = pcall
+            hops = 0
+            while hops < 8:
+                parent = parents.get(id(cur))
+                if parent is None:
+                    return None
+                if isinstance(parent, ast.List) and isinstance(
+                        cur, ast.Tuple):
+                    return None  # (regex, P(...)) rule table: tolerant
+                if isinstance(parent, ast.keyword) and \
+                        parent.arg in _SPEC_KWARGS:
+                    return parent.arg
+                if isinstance(parent, ast.Call):
+                    name = _dotted(parent.func).rsplit(".", 1)[-1]
+                    if name == "NamedSharding":
+                        return "NamedSharding"
+                    if name in _P_NAMES and parent is not pcall:
+                        pass  # nested P? keep climbing
+                    else:
+                        return None  # argument of something else: unknown
+                if isinstance(parent, (ast.Assign, ast.Return)):
+                    # spec variable: strict only when the name feeds a
+                    # strict kwarg somewhere in this module
+                    if isinstance(parent, ast.Assign):
+                        for tgt in parent.targets:
+                            if isinstance(tgt, ast.Name) and \
+                                    _name_feeds_spec(mod, tgt.id):
+                                return "spec variable %r" % tgt.id
+                    return None
+                cur, hops = parent, hops + 1
+            return None
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            short = callee.rsplit(".", 1)[-1] if callee else ""
+            # OPS701: collectives
+            if short in _COLLECTIVES and callee and (
+                    "." in callee or short == callee):
+                pos = _COLLECTIVES[short]
+                cand: List[Tuple[str, int]] = []
+                if pos < len(node.args):
+                    cand = _axis_literals(node.args[pos])
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis"):
+                        cand.extend(_axis_literals(kw.value))
+                for axis, line in cand:
+                    if axis not in universe:
+                        findings.append(Finding(
+                            "OPS701", mod.path, line,
+                            "collective %s over axis %r, which no mesh "
+                            "built in this project defines (known axes: "
+                            "%s)" % (short, axis,
+                                     ",".join(sorted(universe)) or "none"),
+                            symbol="%s.%s" % (short, axis)))
+            # OPS702: P literals at strict sites vs the universe
+            elif short in _P_NAMES:
+                axes = _p_literal_axes(node)
+                if not axes:
+                    continue
+                site = strictness(node)
+                if site is None:
+                    continue
+                for axis, line in axes:
+                    if axis not in universe:
+                        findings.append(Finding(
+                            "OPS702", mod.path, line,
+                            "PartitionSpec axis %r (at %s) matches no "
+                            "mesh axis this project ever builds (known: "
+                            "%s)" % (axis, site,
+                                     ",".join(sorted(universe)) or "none"),
+                            symbol="pspec.%s" % axis))
+            # OPS703: arity
+            findings.extend(self._arity(mod, node, parents))
+        return findings
+
+    # -- arity ----------------------------------------------------------
+
+    @staticmethod
+    def _fn_arity(mod: ModuleInfo, node: ast.AST) -> Optional[int]:
+        """Positional arity of a directly given def/lambda (None when
+        not statically known or when *args makes it variadic)."""
+        if isinstance(node, ast.Lambda):
+            a = node.args
+            if a.vararg is not None:
+                return None
+            return len(a.posonlyargs) + len(a.args)
+        if isinstance(node, ast.Name):
+            for sub in ast.walk(mod.tree):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub.name == node.id:
+                    if sub.args.vararg is not None:
+                        return None
+                    return (len(sub.args.posonlyargs)
+                            + len(sub.args.args))
+        return None
+
+    def _arity(self, mod: ModuleInfo, node: ast.Call,
+               parents: Dict[int, ast.AST]) -> List[Finding]:
+        callee = _dotted(node.func)
+        short = callee.rsplit(".", 1)[-1] if callee else ""
+        specs: Optional[ast.AST] = None
+        kwarg = ""
+        for kw in node.keywords:
+            if kw.arg in ("in_specs", "in_shardings") and isinstance(
+                    kw.value, ast.Tuple):
+                specs, kwarg = kw.value, kw.arg
+        if specs is None:
+            return []
+        n_specs = len(specs.elts)
+        target: Optional[ast.AST] = None
+        label = ""
+        if short in ("shard_map", "jit", "pjit") and node.args:
+            target = node.args[0]
+            label = short
+        elif short == "partial" and node.args:
+            inner = _dotted(node.args[0]).rsplit(".", 1)[-1]
+            if inner in ("shard_map", "jit", "pjit"):
+                # decorator form: @partial(shard_map, in_specs=...) above
+                # a def — the decorated function is the target
+                parent = parents.get(id(node))
+                if isinstance(parent, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) and \
+                        node in parent.decorator_list:
+                    # arity straight off the decorated def
+                    if parent.args.vararg is not None:
+                        return []
+                    arity = (len(parent.args.posonlyargs)
+                             + len(parent.args.args))
+                    if arity != n_specs:
+                        return [Finding(
+                            "OPS703", mod.path, node.lineno,
+                            "%s %s has %d specs but %r takes %d "
+                            "positional argument(s)"
+                            % (inner, kwarg, n_specs, parent.name, arity),
+                            symbol="%s.%s.arity" % (inner, parent.name))]
+                    return []
+        if target is None:
+            return []
+        arity = self._fn_arity(mod, target)
+        if arity is None or arity == n_specs:
+            return []
+        name = _dotted(target) or "<lambda>"
+        return [Finding(
+            "OPS703", mod.path, node.lineno,
+            "%s %s has %d specs but %r takes %d positional argument(s)"
+            % (label, kwarg, n_specs, name, arity),
+            symbol="%s.%s.arity" % (label, name))]
+
+
+def make_passes() -> List[DataflowPass]:
+    return [MeshConsistencyPass()]

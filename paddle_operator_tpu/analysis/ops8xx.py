@@ -1,0 +1,67 @@
+"""OPS8xx — blocking device→host transfers on the hot path.
+
+PR 1 established the deferred-metrics contract: the training loop never
+forces a device value to host between dispatches — ``float(loss)`` at a
+step boundary stalls the dispatch pipeline for a full device round-trip
+(the dominant cost on a dispatch-latency-bound link), which is why
+``data.DeferredMetrics`` exists. The contract was prose; this pass makes
+it machine-checked.
+
+**OPS801 blocking-d2h-in-step-loop** — an implicit device→host coercion
+(``float()``/``int()``/``bool()``, ``np.asarray``/``device_get``,
+``.item()``/``.tolist()``, truth-testing a device value) applied to a
+device-resident value *inside a loop that dispatches device work* (a
+loop whose body calls a jit/step function or a jnp/lax op). Exemptions,
+both structural:
+
+* the coercion sits in a block that unconditionally leaves the loop
+  (``return``/``break``/``raise`` follows it) — the run is over, the
+  forced readback stalls nothing; this is the runner's drain-exit shape;
+* explicit synchronization (``jax.block_until_ready``) is never flagged
+  — a benchmark loop that *means* to sync says so.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .dataflow import (
+    DEVICE, DEVICE_ALIAS, AbstractValue, DataflowPass, FnContext,
+)
+from . import opslint
+from .opslint import Finding
+
+RULES: Dict[str, Tuple[str, str]] = {
+    "OPS801": (
+        "blocking-d2h-in-step-loop",
+        "implicit device->host transfer (float()/np.asarray/.item()/"
+        "bool coercion) on a device value inside a device-dispatching "
+        "loop: stalls the dispatch pipeline — defer the readback "
+        "(data.DeferredMetrics) or move it past the loop",
+    ),
+}
+opslint.RULES.update(RULES)  # findings render through the shared catalog
+
+
+class BlockingTransferPass(DataflowPass):
+    rule_ids = ("OPS801",)
+
+    def on_d2h(self, ctx: FnContext, node: ast.AST,
+               value: AbstractValue, what: str, hot_loop: bool,
+               loop_exiting: bool, out: List[Finding]) -> None:
+        if not hot_loop or loop_exiting:
+            return
+        if not (value.tags & frozenset((DEVICE, DEVICE_ALIAS))):
+            return
+        out.append(Finding(
+            "OPS801", ctx.path, getattr(node, "lineno", 0),
+            "%s forces a blocking device->host transfer inside a "
+            "device-dispatching loop%s: defer the readback "
+            "(DeferredMetrics) or hoist it out of the loop"
+            % (what, value.origin_note()),
+            symbol="%s.d2h.%s" % (ctx.fn.simple_name, what)))
+
+
+def make_passes() -> List[DataflowPass]:
+    return [BlockingTransferPass()]

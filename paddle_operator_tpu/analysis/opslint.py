@@ -34,6 +34,13 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 # rule id -> (name, one-line description)
 RULES: Dict[str, Tuple[str, str]] = {
+    "OPS001": (
+        "stale-suppression",
+        "an `# opslint: disable=...` comment (or a baseline fingerprint) "
+        "that no longer matches any finding: suppressions must shrink "
+        "with the findings they silence — delete the comment, or "
+        "--prune-baseline",
+    ),
     "OPS101": (
         "lock-discipline",
         "attribute written under a lock is read/written outside any "
@@ -122,16 +129,37 @@ class Finding:
             self.message)
 
 
+def suppression_sites(source: str) -> List[Tuple[int, Set[str]]]:
+    """(comment line, rule ids) for every disable pragma — the raw
+    sites, for the OPS001 stale-suppression audit. Only real COMMENT
+    tokens count: a docstring *describing* the pragma syntax is neither
+    a suppression nor a stale one."""
+    import io
+    import tokenize
+
+    out: List[Tuple[int, Set[str]]] = []
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.append((tok.start[0], rules))
+    return out
+
+
 def _suppressed_lines(source: str) -> Dict[int, Set[str]]:
     """line number -> rule ids disabled on that line (a disable comment
     also covers the line directly below it, for statements too long to
     share a line with the pragma)."""
     out: Dict[int, Set[str]] = {}
-    for i, line in enumerate(source.splitlines(), 1):
-        m = _DISABLE_RE.search(line)
-        if not m:
-            continue
-        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    for i, rules in suppression_sites(source):
         out.setdefault(i, set()).update(rules)
         out.setdefault(i + 1, set()).update(rules)
     return out

@@ -35,7 +35,8 @@ Knobs:
 * ``TPUJOB_COMPILE_CACHE_AOT=0`` — disable only executable serialization.
 
 Thread-safety: all mutable module state (stats, the in-process executable
-memo) is guarded by ``_lock``.
+memo) lives in :class:`_CacheState` under its ``_lock``; the shape is
+declared to ``racedetect.guard_fields`` so ``make race`` enforces it.
 """
 
 from __future__ import annotations
@@ -50,26 +51,50 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 log = logging.getLogger("tpujob.compile_cache")
 
-_lock = threading.Lock()
-# fingerprint -> callable (in-process memo: a resumed cycle in the SAME
-# process — elastic restart without pod loss — pays nothing at all)
-_memo: Dict[str, Callable] = {}
-_stats = {
-    "persistent_enabled": False,
-    "persistent_dir": "",
-    # jax persistent-cache events (monitoring hook; -1 = not observable)
-    "persistent_hits": 0,
-    "persistent_misses": 0,
-    # this module's own ladder accounting
-    "memo_hits": 0,
-    "aot_hits": 0,          # deserialized a saved executable from disk
-    "aot_misses": 0,        # compiled AOT fresh (and tried to save)
-    "aot_saves": 0,         # executables serialized to disk
-    "jit_fallbacks": 0,     # AOT unavailable -> plain jax.jit
-    "compile_seconds": 0.0,  # wall spent in lower+compile / jit warmup
-}
+class _CacheState:
+    """All of the ladder's mutable state under ONE lock.
+
+    A holder class (not bare module globals) so the shape is declared
+    once and ``racedetect.guard_fields`` can watch it under ``make
+    race``: any touch of the memo / stats / sticky-dir bookkeeping
+    without holding ``_lock`` fails the race session — the in-process
+    memo is exactly what a parallel-reconciler worker and a training
+    thread could race on a shared-process harness.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # fingerprint -> callable (in-process memo: a resumed cycle in
+        # the SAME process — elastic restart without pod loss — pays
+        # nothing at all)
+        self.memo: Dict[str, Callable] = {}
+        self.stats: Dict[str, Any] = {
+            "persistent_enabled": False,
+            "persistent_dir": "",
+            # jax persistent-cache events (monitoring hook; -1 = not
+            # observable)
+            "persistent_hits": 0,
+            "persistent_misses": 0,
+            # this module's own ladder accounting
+            "memo_hits": 0,
+            "aot_hits": 0,       # deserialized a saved executable
+            "aot_misses": 0,     # compiled AOT fresh (and tried to save)
+            "aot_saves": 0,      # executables serialized to disk
+            "jit_fallbacks": 0,  # AOT unavailable -> plain jax.jit
+            "compile_seconds": 0.0,  # wall in lower+compile / jit warmup
+        }
+        self.enabled_dir: Optional[str] = None
+
+
+_state = _CacheState()
 _monitoring_hooked = False
-_enabled_dir: Optional[str] = None
+
+# make race (TPUJOB_RACE_DETECT=1): every access of the guarded fields
+# must hold _lock; no-op with the detector off (see analysis/racedetect)
+from .analysis import racedetect as _racedetect  # noqa: E402
+
+_racedetect.guard_fields(_state, "_lock",
+                         ["memo", "stats", "enabled_dir"])
 
 
 def cache_enabled() -> bool:
@@ -128,17 +153,17 @@ def _hook_monitoring() -> None:
 
         def _listener(name, **kwargs):
             if name.endswith("/compilation_cache/cache_hits"):
-                with _lock:
-                    _stats["persistent_hits"] += 1
+                with _state._lock:
+                    _state.stats["persistent_hits"] += 1
             elif name.endswith("/compilation_cache/cache_misses"):
-                with _lock:
-                    _stats["persistent_misses"] += 1
+                with _state._lock:
+                    _state.stats["persistent_misses"] += 1
 
         monitoring.register_event_listener(_listener)
     except Exception:  # pragma: no cover - jax internals moved
-        with _lock:
-            _stats["persistent_hits"] = -1
-            _stats["persistent_misses"] = -1
+        with _state._lock:
+            _state.stats["persistent_hits"] = -1
+            _state.stats["persistent_misses"] = -1
 
 
 def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
@@ -148,13 +173,12 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
     iff the cache is active. Read-only/unwritable directories disable the
     layer with one warning (the AOT layer checks writability separately).
     """
-    global _enabled_dir
     if not cache_enabled():
         return False
     path = cache_dir or default_cache_dir()
-    with _lock:
-        if _enabled_dir == path:
-            return _stats["persistent_enabled"]
+    with _state._lock:
+        if _state.enabled_dir == path:
+            return bool(_state.stats["persistent_enabled"])
     ok = _writable_dir(path)
     if ok:
         try:
@@ -183,10 +207,10 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
     else:
         log.warning("compile cache dir %s not writable; persistent "
                     "cache disabled", path)
-    with _lock:
-        _enabled_dir = path
-        _stats["persistent_enabled"] = ok
-        _stats["persistent_dir"] = path if ok else ""
+    with _state._lock:
+        _state.enabled_dir = path
+        _state.stats["persistent_enabled"] = ok
+        _state.stats["persistent_dir"] = path if ok else ""
     if ok:
         _hook_monitoring()
     return ok
@@ -376,8 +400,8 @@ def _abstractify(tree):
 
 
 def _aot_path(fingerprint: str) -> Optional[str]:
-    with _lock:
-        base = _stats["persistent_dir"]
+    with _state._lock:
+        base = _state.stats["persistent_dir"]
     if not base:
         base = default_cache_dir()
         if not _writable_dir(base):
@@ -475,9 +499,9 @@ class CachedStep:
                     pass
             self._fn = self._fallback()
             self.source = "jit"
-            with _lock:
-                _stats["jit_fallbacks"] += 1
-                _memo[self.fingerprint] = self._fn
+            with _state._lock:
+                _state.stats["jit_fallbacks"] += 1
+                _state.memo[self.fingerprint] = self._fn
             out = self._fn(*args)
         self._called_ok = True
         self._fallback = None
@@ -522,10 +546,10 @@ def cached_jit(fn: Callable, example_args: Tuple,
     def rebuild():
         return jax.jit(fn, **jit_kwargs)
 
-    with _lock:
-        hit = _memo.get(fp)
+    with _state._lock:
+        hit = _state.memo.get(fp)
         if hit is not None:
-            _stats["memo_hits"] += 1
+            _state.stats["memo_hits"] += 1
             return CachedStep(hit, "memo", fp, 0.0)
 
     abstract = _abstractify(example_args)
@@ -547,9 +571,9 @@ def cached_jit(fn: Callable, example_args: Tuple,
     if use_aot:
         loaded = _try_load_aot(path)
         if loaded is not None:
-            with _lock:
-                _stats["aot_hits"] += 1
-                _memo[fp] = loaded
+            with _state._lock:
+                _state.stats["aot_hits"] += 1
+                _state.memo[fp] = loaded
             log.info("AOT executable reused for %s (%s)",
                      label or "step", fp[:12])
             return CachedStep(loaded, "aot", fp, 0.0, fallback=rebuild,
@@ -570,16 +594,16 @@ def cached_jit(fn: Callable, example_args: Tuple,
                      label or "step", e)
     dt = time.perf_counter() - t0
     out_fn = compiled if compiled is not None else jitted
-    with _lock:
-        _stats["compile_seconds"] += dt
+    with _state._lock:
+        _state.stats["compile_seconds"] += dt
         if compiled is not None:
-            _stats["aot_misses"] += 1
+            _state.stats["aot_misses"] += 1
         else:
-            _stats["jit_fallbacks"] += 1
-        _memo[fp] = out_fn
+            _state.stats["jit_fallbacks"] += 1
+        _state.memo[fp] = out_fn
     if compiled is not None and _try_save_aot(path, compiled):
-        with _lock:
-            _stats["aot_saves"] += 1
+        with _state._lock:
+            _state.stats["aot_saves"] += 1
     return CachedStep(out_fn, source, fp, dt,
                       fallback=rebuild if compiled is not None else None)
 
@@ -589,19 +613,19 @@ def cached_jit(fn: Callable, example_args: Tuple,
 # ---------------------------------------------------------------------------
 
 def stats() -> Dict[str, Any]:
-    with _lock:
-        return dict(_stats)
+    with _state._lock:
+        return dict(_state.stats)
 
 
 def reset_stats_for_tests() -> None:
-    global _enabled_dir
-    with _lock:
-        _memo.clear()
-        _enabled_dir = None
-        _stats.update(persistent_enabled=False, persistent_dir="",
-                      persistent_hits=0, persistent_misses=0, memo_hits=0,
-                      aot_hits=0, aot_misses=0, aot_saves=0,
-                      jit_fallbacks=0, compile_seconds=0.0)
+    with _state._lock:
+        _state.memo.clear()
+        _state.enabled_dir = None
+        _state.stats.update(
+            persistent_enabled=False, persistent_dir="",
+            persistent_hits=0, persistent_misses=0, memo_hits=0,
+            aot_hits=0, aot_misses=0, aot_saves=0,
+            jit_fallbacks=0, compile_seconds=0.0)
 
 
 def startup_block() -> Dict[str, Any]:

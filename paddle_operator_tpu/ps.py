@@ -868,7 +868,10 @@ def run_ps_training(job: PsTrainJob, cfg, bind_host: str = "",
         params = unflatten_params(vec, treedef, shapes)
         batch = job.make_batch(jax.random.fold_in(rng, step), step)
         loss, grads = vg_fn(params, batch)
-        losses.append(float(loss))
+        # PS-mode BSP rounds are host-synchronous by protocol: the push
+        # below transfers the full gradient vector to the server every
+        # round — one scalar readback alongside it stalls nothing
+        losses.append(float(loss))  # opslint: disable=OPS801
         gvec, _, _ = flatten_params(grads)
         while not client.push(gvec, version):
             # stale: another BSP round completed while we computed —
@@ -936,7 +939,9 @@ def _train_sparse(job: PsTrainJob, client: PsClient, treedef,
             loss, (gparams, grows) = vg_fn(
                 params, jnp.asarray(rows), jnp.asarray(inv), batch)
             gvec, _, _ = flatten_params(gparams)
-            grows_n = np.asarray(grows)[:n]
+            # the sparse push IS a host transfer: the embedding-row
+            # gradients must be host bytes this round, by protocol
+            grows_n = np.asarray(grows)[:n]  # opslint: disable=OPS801
             ok_dense = client.push(gvec, version)
             ok_sparse = client.sparse_push(uids, grows_n, sver)
             if ok_dense and ok_sparse:
@@ -949,7 +954,8 @@ def _train_sparse(job: PsTrainJob, client: PsClient, treedef,
             # keeps both planes advancing one round per loop iteration.
             vec, version = client.pull(after=version)
             rows_real, sver = client.sparse_pull(uids, after=sver, dim=dim)
-        losses.append(float(loss))
+        # host-synchronous by protocol, like the dense loop above
+        losses.append(float(loss))  # opslint: disable=OPS801
         # barrier: dense plane applied; this pull is next round's fetch.
         # The sparse barrier is implicit in the NEXT round's sparse_pull
         # (after=sver long-polls until the round applies) — no extra trip.

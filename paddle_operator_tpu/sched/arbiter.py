@@ -40,7 +40,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ..api import types as api
 from ..controllers.helper import ANNOT_SCHED_EVICT, ANNOT_SCHED_RESTORE_NP
@@ -81,7 +81,9 @@ def annotation_ckpt_info(job: api.TpuJob) -> Optional[dict]:
     return {"step": step, "progress": progress}
 
 
-def checkpoint_staleness(job: api.TpuJob, ckpt_info) -> int:
+def checkpoint_staleness(
+        job: api.TpuJob,
+        ckpt_info: Optional[Callable[[api.TpuJob], Optional[dict]]]) -> int:
     """Steps of work at risk if this job is preempted right now (0 = a
     checkpoint covers everything it has done)."""
     info = ckpt_info(job) if ckpt_info is not None else None
@@ -137,14 +139,14 @@ class FleetArbiter:
     shrink, no preemption.
     """
 
-    def __init__(self, client, evictor: Optional[Callable] = None,
-                 job_metrics=None, mode: str = "fair",
+    def __init__(self, client: Any, evictor: Optional[Callable] = None,
+                 job_metrics: Any = None, mode: str = "fair",
                  drain_grace: int = 3,
                  ckpt_info: Callable[[api.TpuJob], Optional[dict]]
                  = annotation_ckpt_info,
                  decision_log_depth: int = 256,
                  replan_interval: float = 0.5,
-                 clock: Callable[[], float] = None):
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self.client = client
         self.capacity = FleetCapacity(client)
         # evictor(pod_dict, grace_seconds): production uses the eviction
@@ -472,7 +474,7 @@ class FleetArbiter:
         (in allocation order) keeps two pending admits from both
         claiming the same free chips."""
 
-        def __init__(self, fleet: int, total_live: int):
+        def __init__(self, fleet: int, total_live: int) -> None:
             self.free = fleet - total_live
 
         def claim(self, target: "_Target", live_self: int) -> None:
@@ -484,8 +486,9 @@ class FleetArbiter:
                 return
             self.free -= need
 
-    def _plan_fifo(self, plan: _Plan, candidates, live_chips,
-                   total_live) -> None:
+    def _plan_fifo(self, plan: _Plan, candidates: List[api.TpuJob],
+                   live_chips: Dict[Tuple[str, str], int],
+                   total_live: int) -> None:
         """The naive baseline: arrival order, gang-or-nothing, stop at
         the first job that does not fit (head-of-line blocking)."""
         fleet = plan.snapshot.fleet_chips
@@ -509,8 +512,10 @@ class FleetArbiter:
                                         "(FIFO order)")
             plan.targets[key] = target
 
-    def _plan_fair(self, plan: _Plan, candidates, live_chips, draining,
-                   total_live) -> None:
+    def _plan_fair(self, plan: _Plan, candidates: List[api.TpuJob],
+                   live_chips: Dict[Tuple[str, str], int],
+                   draining: Dict[Tuple[str, str], bool],
+                   total_live: int) -> None:
         fleet = plan.snapshot.fleet_chips
         remaining = fleet
         # Entries already in plan.targets here are unplaceable parks
@@ -855,7 +860,7 @@ class FleetArbiter:
             pass
 
 
-def _cluster_rv(client) -> Optional[str]:
+def _cluster_rv(client: Any) -> Optional[str]:
     """Walk wrapper chains (CachedKubeClient.inner, ChaosKubeClient.inner)
     to the store that knows the global resourceVersion; None for real
     apiservers (the arbiter then replans on every gate consult)."""

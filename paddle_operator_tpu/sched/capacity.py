@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from ..api import types as api
 from ..controllers import helper
@@ -52,7 +52,7 @@ class FleetCapacity:
     arbiter re-reads per scheduling pass, so node-pool resizes (autoscaler,
     maintenance drains deleting nodes) show up without restarts."""
 
-    def __init__(self, client):
+    def __init__(self, client: Any) -> None:
         self.client = client
         self._last: Optional[FleetSnapshot] = None
         self._list_failing = False

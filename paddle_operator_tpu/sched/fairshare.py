@@ -22,7 +22,7 @@ every positive-weight tenant in the tier.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api import types as api
 
@@ -97,7 +97,7 @@ def tenant_weight(job: api.TpuJob) -> float:
     return w if math.isfinite(w) else 0.0
 
 
-def arrival_key(job: api.TpuJob):
+def arrival_key(job: api.TpuJob) -> Tuple[str, int, str, str]:
     """FIFO ordering key: creationTimestamp, then the explicit arrival
     sequence annotation (sub-second arrivals), then name."""
     meta = job.metadata
@@ -157,7 +157,8 @@ class ShareTable:
 
 
 def fair_order(jobs: List[api.TpuJob], table: ShareTable,
-               demand_of) -> List[api.TpuJob]:
+               demand_of: Callable[[api.TpuJob], int]
+               ) -> List[api.TpuJob]:
     """Interleave queued jobs of one tier by weighted fair share:
     repeatedly serve the min-share tenant's oldest job, charging its
     demand to a SCRATCH copy of the table so the next pick reflects it

@@ -64,8 +64,10 @@ def main():
     for name, ce in (("chunked", chunk), ("dense", 0)):
         loss_fn = partial(gpt.loss_fn, ce_chunk=ce)
         step_fn, state = build_train_step(loss_fn, opt, params, batch_data)
-        # the compiler's own accounting of peak temp buffers
-        lowered = jax.jit(lambda s, b: step_fn(s, b)).lower(
+        # the compiler's own accounting of peak temp buffers — a fresh
+        # compile per config IS the measurement (2-config sweep, not a
+        # step loop)
+        lowered = jax.jit(lambda s, b: step_fn(s, b)).lower(  # opslint: disable=OPS501
             state, batch_data)
         mem = lowered.compile().memory_analysis()
         if mem is not None:

@@ -486,6 +486,125 @@ def test_baseline_roundtrip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# OPS001 stale-suppression audit (+ --prune-baseline)
+# ---------------------------------------------------------------------------
+
+STALE_PRAGMA = '''
+class Quiet:
+    def fine(self):
+        return 1  # opslint: disable=OPS101
+'''
+
+LIVE_PRAGMA = '''
+import threading
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def put(self):
+        with self._lock:
+            self.hits += 1
+
+    def reset(self):
+        self.hits = 0  # opslint: disable=OPS101  (init-style reset)
+'''
+
+
+def _engine_run(tmp_path, name, source):
+    from paddle_operator_tpu.analysis import engine
+
+    p = tmp_path / name
+    p.write_text(source)
+    return engine.run_all([str(p)], root=str(tmp_path))
+
+
+def test_ops001_stale_suppression_is_reported(tmp_path):
+    findings = _engine_run(tmp_path, "stale.py", STALE_PRAGMA)
+    assert rules_of(findings) == {"OPS001"}
+    assert "OPS101" in findings[0].message
+
+
+def test_ops001_quiet_on_live_suppression(tmp_path):
+    assert _engine_run(tmp_path, "live.py", LIVE_PRAGMA) == []
+
+
+def test_ops001_docstring_mention_is_not_a_pragma(tmp_path):
+    doc = '\'\'\'Use `# opslint: disable=OPS101` to silence a line.\'\'\'\n'
+    assert _engine_run(tmp_path, "doc.py", doc) == []
+
+
+def test_stale_baseline_entry_reported_and_pruned(tmp_path):
+    from paddle_operator_tpu.analysis import engine
+
+    findings = opslint.lint_source(UNLOCKED_WRITE, "fixture_unlocked.py")
+    assert findings
+    bpath = str(tmp_path / "baseline.json")
+    opslint.write_baseline(findings, bpath)
+    # the code got fixed: current findings shrink to a subset
+    still = findings[:1]
+    stale = engine.stale_baseline_findings(
+        still, opslint.load_baseline(bpath), bpath)
+    assert stale and all(f.rule == "OPS001" for f in stale)
+    assert len(stale) == len(findings) - 1
+    # prune keeps exactly the still-live entries
+    live = {f.fingerprint(): f for f in still}
+    keep = [live[fp] for fp in sorted(
+        set(opslint.load_baseline(bpath)) & set(live))]
+    opslint.write_baseline(keep, bpath)
+    assert set(opslint.load_baseline(bpath)) == set(live)
+    assert engine.stale_baseline_findings(
+        still, opslint.load_baseline(bpath), bpath) == []
+
+
+def test_partial_scope_run_cannot_judge_foreign_baseline(tmp_path):
+    """Regression: a partial-path run (or a --rules subset) must not
+    report baseline entries for files OUTSIDE its scope as stale, and
+    --prune-baseline must not delete them."""
+    import scripts.opslint as cli
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(UNLOCKED_WRITE)
+    clean = tmp_path / "clean.py"
+    clean.write_text(PURE_RECONCILER)
+    bpath = str(tmp_path / "baseline.json")
+    assert cli.main([str(dirty), "--baseline", bpath,
+                     "--update-baseline"]) == 0
+    before = opslint.load_baseline(bpath)
+    assert before
+    # analyzing ONLY clean.py: dirty.py's entries are out of scope —
+    # no bogus OPS001, and prune keeps them
+    assert cli.main([str(clean), "--baseline", bpath]) == 0
+    assert cli.main([str(clean), "--baseline", bpath,
+                     "--prune-baseline"]) == 0
+    assert opslint.load_baseline(bpath) == before
+    # a --rules subset never judges staleness, even in scope
+    assert cli.main([str(dirty), "--baseline", bpath,
+                     "--rules", "OPS201"]) == 0
+
+
+def test_prune_baseline_cli(tmp_path):
+    import scripts.opslint as cli
+
+    src = tmp_path / "fixture.py"
+    src.write_text(UNLOCKED_WRITE)
+    bpath = str(tmp_path / "baseline.json")
+    assert cli.main([str(src), "--baseline", bpath,
+                     "--update-baseline"]) == 0
+    # accepted: lint is clean against the baseline
+    assert cli.main([str(src), "--baseline", bpath]) == 0
+    # the file gets fixed -> entries go stale -> OPS001 fails the run
+    src.write_text(LOCKED_CLEAN)
+    assert cli.main([str(src), "--baseline", bpath]) == 1
+    # prune empties it; clean again
+    assert cli.main([str(src), "--baseline", bpath,
+                     "--prune-baseline"]) == 0
+    assert opslint.load_baseline(bpath) == {}
+    assert cli.main([str(src), "--baseline", bpath]) == 0
+
+
+# ---------------------------------------------------------------------------
 # runtime detector: lock-order inversion (AB/BA), long holds, guards
 # ---------------------------------------------------------------------------
 
