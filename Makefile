@@ -48,6 +48,7 @@ race:
 	  tests/test_chaos.py tests/test_compile_cache.py \
 	  tests/test_control_plane.py tests/test_coordination.py \
 	  tests/test_data.py tests/test_elastic_e2e.py tests/test_fake_client.py \
+	  tests/test_goodput.py \
 	  tests/test_helper.py tests/test_hostport_elastic_server.py \
 	  tests/test_http_client.py tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
@@ -80,12 +81,17 @@ sched:
 
 # observability lanes (see docs/observability.md):
 #   obs          — rebuild a failure timeline from a recorded chaos run
-#                  (trace + events alone), proving obs_report end-to-end
+#                  (trace + events alone), proving obs_report end-to-end,
+#                  then rebuild the goodput waterfall from a goodput_audit
+#                  run's trace and re-check the conservation invariant
+#                  (wall == goodput + Σ badput) offline
 #   metrics-lint — strict text-exposition validation of a live
-#                  Manager.metrics_text() with every provider registered,
+#                  Manager.metrics_text() AND WorkerMetricsServer
+#                  .metrics_text() with every provider registered,
 #                  so an undeclared/unescaped family can't ship
 obs:
 	$(PY) scripts/obs_report.py --chaos preemption_burst --seed 1
+	$(PY) scripts/obs_report.py --chaos goodput_audit --seed 1
 
 metrics-lint:
 	$(PY) scripts/metrics_lint.py --selftest

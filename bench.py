@@ -378,6 +378,31 @@ def child_main():
             step_source=getattr(step, "source", "jit"),
         ),
     }
+    # Goodput attribution (ISSUE 10): the same wall==goodput+Σbadput
+    # ledger shape the runner and operator report, computed from this
+    # child's own stage walls so BENCH_r*.json trajectory diffs carry
+    # WHERE the seconds went, not just throughput. goodput = the
+    # measured steady-state windows (incl. the legacy dispatch-rate
+    # window); everything else is named badput; the remainder (canary,
+    # calibration, imports, readbacks) is bench_overhead — reported,
+    # never silently dropped, so the block always conserves.
+    measured_s = sum(batch * STEPS / r for r in window_rates) \
+        + batch * STEPS / dispatch_rate
+    child_wall_s = time.perf_counter() - t_child
+    bench_overhead = max(0.0, child_wall_s - measured_s - backend_init_s
+                         - model_init_s - compile_warmup_s)
+    result["goodput"] = {
+        "wall_s": round(child_wall_s, 3),
+        "goodput_s": round(measured_s, 3),
+        "ratio": round(measured_s / child_wall_s, 4)
+        if child_wall_s > 0 else 1.0,
+        "badput_s": {
+            "backend_init": round(backend_init_s, 3),
+            "model_init": round(model_init_s, 3),
+            "compile": round(compile_warmup_s, 3),
+            "bench_overhead": round(bench_overhead, 3),
+        },
+    }
     # Emit the core number NOW: extras below can only enrich it, a wedged
     # extra stage loses nothing (the parent keeps the LAST JSON line).
     print(json.dumps(result))

@@ -109,6 +109,21 @@ class ChaosReport:
                    len(self.violations), extra))
 
 
+class _TickClock:
+    """Deterministic clock for the ``goodput_audit`` ledger: one second
+    per harness tick, advanced by the run loop — so badput seconds are
+    replayable facts, not wall-clock noise."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.now += dt
+
+
 class ChaosHarness:
     """One control-plane chaos run (see :mod:`.plan` for scenarios)."""
 
@@ -126,9 +141,20 @@ class ChaosHarness:
         # bring-up measures the reconcile machinery, not exec churn.
         storm = plan.scenario == "control_plane_storm"
         self.drain_workers = STORM_DRAIN_WORKERS if storm else 1
+        # goodput_audit drives the obs clock tick-wise: ledger segment
+        # durations become deterministic seconds that join the replay
+        # fingerprint, and the conservation audit runs on exact numbers
+        audit = plan.scenario == "goodput_audit"
+        self.clock = _TickClock() if audit else None
+        # remaining ticks of collapsed examples/s (backend_degrade fault)
+        self._degrade_ticks = 0
+        # data_stall seconds the ledger really accepted (charges clamp
+        # to banked goodput; the audit compares against what moved)
+        self._stall_moved = 0.0
         self.h = OperatorHarness(
             init_image="" if storm else "docker.io/library/busybox:1",
-            client_middleware=lambda c: ChaosKubeClient(c, self.injector))
+            client_middleware=lambda c: ChaosKubeClient(c, self.injector),
+            metrics_clock=self.clock)
         self.h.manager.add_metrics_provider(self.injector.metrics_block)
         self.pod_chaos = PodChaos(self.h.sim, self.h.client, self.injector)
         # run-time rng (target picks) — separate stream from plan building,
@@ -182,6 +208,18 @@ class ChaosHarness:
                 "device": "tpu",
                 "tpu": {"accelerator": "v5e", "topology": "4x8"},
                 "worker": self._role(4), "elastic": 1,
+            }))
+        elif s == "goodput_audit":
+            # the attributed job (drains/preempts/stalls/degradation
+            # land here) plus an untouched bystander whose ledger must
+            # stay ~pure goodput
+            self._add_job(api.new_tpujob("audit", spec={
+                "device": "tpu",
+                "tpu": {"accelerator": "v5e", "topology": "4x8"},
+                "worker": self._role(4), "elastic": 1,
+            }))
+            self._add_job(api.new_tpujob("bystander", spec={
+                "worker": self._role(1),
             }))
         elif s == "control_plane_storm":
             for i in range(STORM_PLAIN):
@@ -278,6 +316,20 @@ class ChaosHarness:
             # measured against: every primary key re-enqueued at once
             self.h.manager.enqueue_all()
             self.injector.record("resync_surge")
+        elif ev.kind == "data_stall":
+            # a worker reported input-stall seconds: charged into the
+            # ledger like the runner's data_wait feed would — clamped to
+            # the goodput actually banked (the audit checks the moved sum)
+            moved = self.h.job_metrics.ledger.charge(
+                "default", p["job"], "data_stall", float(p["seconds"]))
+            self._stall_moved += moved
+            self.injector.record("data_stall")
+        elif ev.kind == "backend_degrade":
+            # the silent CPU-fallback model: the job's reported
+            # examples/s collapses for N ticks; the detector must catch
+            # it against the job's own baseline within one sample
+            self._degrade_ticks = int(p.get("ticks", 2))
+            self.injector.record("backend_degrade")
         elif ev.kind == "elastic_resize":
             self.injector.record("elastic_resize")
 
@@ -336,6 +388,8 @@ class ChaosHarness:
             self.h.manager.drain(workers=self.drain_workers)
             sim_changed = self.h.sim.step()
             self.pod_chaos.tick()
+            if self.clock is not None:
+                self._audit_tick()
             # deferred counts as pending work: an error-backoff retry parked
             # by the LAST injected fault must still get its clean pass
             # before the run may call itself quiesced
@@ -355,6 +409,15 @@ class ChaosHarness:
         violations = self.check_invariants(converged, ticks)
         jobs = self._job_states()
         extra = {}
+        if self.plan.scenario == "goodput_audit":
+            # deterministic ledger facts (tick clock): the fingerprint
+            # proves a same-seed replay attributes the SAME seconds to
+            # the SAME causes, not just that it conserves
+            snap = self.h.job_metrics.ledger.snapshot("default", "audit")
+            extra["audit_wall_s"] = round(snap["wall"], 3)
+            extra["audit_goodput_s"] = round(snap["goodput"], 3)
+            for cause, s in sorted(snap["badput"].items()):
+                extra["audit_badput_%s" % cause] = round(s, 3)
         if self.drain_workers > 1:
             # the parallel queue's audit counters join the determinism
             # fingerprint: a same-seed replay must make the same lane
@@ -366,6 +429,26 @@ class ChaosHarness:
                            ticks, dict(self.injector.counts), jobs,
                            violations, time.perf_counter() - t0,
                            extra=extra)
+
+    def _audit_tick(self) -> None:
+        """goodput_audit per-tick work: feed the audit job's reported
+        examples/s into the backend-degradation detector (collapsed
+        while a backend_degrade fault is live, healthy otherwise — only
+        while the job is actually Running, like a worker scrape would
+        be), then advance the deterministic ledger clock one second."""
+        try:
+            running = self.h.get_job("audit").phase == api.Phase.RUNNING
+        except NotFoundError:
+            running = False
+        if running:
+            if self._degrade_ticks > 0:
+                self._degrade_ticks -= 1
+                eps = 0.4  # the r03–r05 CPU-fallback floor
+            else:
+                eps = 1000.0
+            self.h.job_metrics.ledger.observe_throughput(
+                "default", "audit", eps)
+        self.clock.advance(1.0)
 
     def _job_states(self) -> Dict[str, dict]:
         out = {}
@@ -387,11 +470,63 @@ class ChaosHarness:
 
     # -- invariants -------------------------------------------------------
 
+    def _audit_goodput(self) -> List[str]:
+        """goodput_audit: the conservation invariant plus cause-level
+        spot checks, on the deterministic tick clock."""
+        out: List[str] = []
+        ledger = self.h.job_metrics.ledger
+        counts = dict(self.injector.counts)
+        snaps = {}
+        for name in self._jobs:
+            snap = snaps[name] = ledger.snapshot("default", name)
+            if snap["wall"] <= 0:
+                out.append("job %s: ledger observed no wall clock" % name)
+                continue
+            attributed = snap["goodput"] + sum(snap["badput"].values())
+            if abs(attributed - snap["wall"]) > 1e-6:
+                out.append(
+                    "job %s: conservation broken: goodput %.6f + badput "
+                    "%.6f != wall %.6f"
+                    % (name, snap["goodput"],
+                       sum(snap["badput"].values()), snap["wall"]))
+            # the independent first->last clock bound: a dropped segment
+            # (state-machine bug) conserves bucket-wise but not here
+            if abs(snap["wall"] - snap["observed_s"]) > 1e-6:
+                out.append(
+                    "job %s: attributed %.6f s != observed clock span "
+                    "%.6f s (a segment was lost or double-counted)"
+                    % (name, snap["wall"], snap["observed_s"]))
+        bad = snaps.get("audit", {}).get("badput", {})
+        if counts.get("graceful_drain") and bad.get("drain", 0.0) <= 0:
+            out.append("graceful drain injected but no drain badput "
+                       "attributed to audit (%r)" % (bad,))
+        if counts.get("pod_preempt") and \
+                bad.get("restore", 0.0) + bad.get("drain", 0.0) <= 0:
+            out.append("hard preemption injected but no restore/drain "
+                       "badput attributed to audit (%r)" % (bad,))
+        if abs(bad.get("data_stall", 0.0) - self._stall_moved) > 1e-6:
+            out.append("data_stall badput %.6f != accepted charges %.6f"
+                       % (bad.get("data_stall", 0.0), self._stall_moved))
+        if counts.get("backend_degrade"):
+            evs = [e for e in self.h.client.all_objects("Event")
+                   if e.get("reason") == "BackendDegraded"]
+            if not evs:
+                out.append("backend degradation injected but the "
+                           "detector emitted no BackendDegraded Event")
+        by = snaps.get("bystander", {}).get("badput", {})
+        stray = set(by) - {"sched_wait"}
+        if stray:
+            out.append("bystander charged badput it never incurred: %r"
+                       % sorted(stray))
+        return out
+
     def check_invariants(self, converged: bool, ticks: int) -> List[str]:
         v: List[str] = []
         store = self.h.client
         if not converged:
             v.append("did not quiesce within %d ticks" % ticks)
+        if self.plan.scenario == "goodput_audit":
+            v.extend(self._audit_goodput())
 
         # ownership: every controller-owned object has a live owner, and
         # nothing is wedged mid-deletion

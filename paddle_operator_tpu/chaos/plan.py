@@ -31,6 +31,11 @@ Fault taxonomy (``FaultEvent.kind``):
                           replacement against the surviving cluster state
 ``loader_error``          transient source error inside the input pipeline
 ``loader_stall``          producer-side stall inside the input pipeline
+``data_stall``            worker-reported input-stall seconds charged to the
+                          goodput ledger (``goodput_audit``)
+``backend_degrade``       collapse the job's reported examples/s for N ticks —
+                          the silent CPU-fallback model the degradation
+                          detector must catch (``goodput_audit``)
 ========================  ====================================================
 
 ``graceful_drain`` runs a second, training-plane leg after the control-plane
@@ -56,6 +61,7 @@ from typing import Dict, List, Tuple
 CONTROL_SCENARIOS = (
     "preemption_burst", "apiserver_flake", "slice_drain_resize",
     "graceful_drain", "operator_crash", "control_plane_storm",
+    "goodput_audit",
 )
 SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant")
 
@@ -113,6 +119,7 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "graceful_drain": _graceful_drain,
         "operator_crash": _operator_crash,
         "control_plane_storm": _control_plane_storm,
+        "goodput_audit": _goodput_audit,
         "loader_faults": _loader_faults,
         "multi_tenant": _multi_tenant,
     }[scenario]
@@ -308,6 +315,40 @@ def _multi_tenant(rng: random.Random, quick: bool
             {"code": rng.choice([409, 500, 503]),
              "count": rng.randint(1, 2)}))
     return events, 200 if quick else 300
+
+
+def _goodput_audit(rng: random.Random, quick: bool
+                   ) -> Tuple[List[FaultEvent], int]:
+    """The goodput ledger's conservation proof (ISSUE 10): an elastic
+    job takes a graceful drain, a hard preemption, worker-reported data
+    stalls, and (half the seeds) a silent backend degradation — while
+    the harness drives the ledger on a deterministic tick clock. After
+    quiescence the audit asserts per-job
+    ``wall == goodput + Σ badput[cause]`` (and == the independently
+    clocked first→last bound), that every injected cause shows up in
+    its own bucket, and that the degradation detector fired its Event —
+    so the whole attribution plane replays byte-identically from the
+    seed, badput seconds included."""
+    events: List[FaultEvent] = []
+    drain_at = rng.randint(4, 8)
+    events.append(FaultEvent(drain_at, "graceful_drain",
+                             {"job": "audit", "all": rng.random() < 0.5,
+                              "grace": rng.randint(2, 3)}))
+    events.append(FaultEvent(drain_at + rng.randint(6, 10), "pod_preempt",
+                             {"job": "audit"}))
+    for _ in range(rng.randint(2, 4)):
+        events.append(FaultEvent(rng.randint(3, 24), "data_stall",
+                                 {"job": "audit",
+                                  "seconds": rng.randint(1, 3)}))
+    if rng.random() < 0.5:
+        events.append(FaultEvent(
+            drain_at + rng.randint(10, 14), "backend_degrade",
+            {"job": "audit", "ticks": rng.randint(2, 4)}))
+    if rng.random() < 0.5:
+        events.append(FaultEvent(
+            rng.randint(1, 10), "api_error",
+            {"code": rng.choice([409, 500]), "count": rng.randint(1, 2)}))
+    return events, 64 if quick else 128
 
 
 def _control_plane_storm(rng: random.Random, quick: bool

@@ -84,12 +84,18 @@ class TpuJobReconciler:
         # memory is safe (the pod is either really gone or really fresh).
         self._preempt_handled: Dict[Tuple[str, str], set] = {}
         # Per-job observability collector: phase gauges/histograms,
-        # cause-split restart counters, flight recorder. Whoever owns the
-        # Manager registers ``self.obs.metrics_block`` as a provider.
+        # cause-split restart counters, goodput ledger, flight recorder.
+        # Whoever owns the Manager registers ``self.obs.metrics_block``
+        # as a provider.
         self.obs = job_metrics if job_metrics is not None else JobMetrics()
         # every Event also lands in the flight recorder + process trace
         self.recorder = ObservedEventRecorder(
             recorder or EventRecorder(client, "tpujob-controller"), self.obs)
+        # the goodput ledger's alert channel (backend-degradation
+        # detector): alerts surface as Warning Events on the job, exactly
+        # like any other reconciler-emitted incident
+        if self.obs.ledger.on_alert is None:
+            self.obs.ledger.on_alert = self._obs_alert
         self.scheduling = scheduling
         self.init_image = init_image
         self.ports = port_allocator
@@ -115,6 +121,19 @@ class TpuJobReconciler:
         self._err_lock = threading.Lock()
         self._err_streak: Dict[Tuple[str, str], int] = {}
         self._err_hit: set = set()
+
+    def _obs_alert(self, namespace: str, name: str, reason: str,
+                   message: str) -> None:
+        """Detector alerts (obs.GoodputLedger) become Warning Events on
+        the job: a reference object is enough — the EventRecorder only
+        reads kind + metadata for involvedObject."""
+        ref = {"kind": api.KIND, "apiVersion": api.API_VERSION,
+               "metadata": {"namespace": namespace, "name": name}}
+        try:
+            self.recorder.event(ref, "Warning", reason, message)
+        except Exception as e:  # an alert must never take training down
+            log.error("obs alert event failed for %s/%s: %s",
+                      namespace, name, e)
 
     # ------------------------------------------------------------------
     # error-requeue backoff

@@ -26,7 +26,9 @@ from .elastic.store import connect as kv_connect
 from .k8s.client import HttpKubeClient
 from .k8s.informer import CachedKubeClient, InformerCache, cached_kinds
 from .k8s.runtime import Manager
-from .obs import JobMetrics, http_respond
+from .obs import (
+    JobMetrics, SloEvaluator, default_slos, http_respond, parse_slo_spec,
+)
 
 
 def _serve(bind: str, handler_cls, name: str) -> ThreadingHTTPServer:
@@ -156,6 +158,15 @@ def main(argv=None):
                          "workers at once; >1 overlaps apiserver round "
                          "trips at fleet scale, see docs/design.md "
                          "'Control-plane scale')")
+    ap.add_argument("--slo-spec", action="append", default=None,
+                    metavar="SPEC",
+                    help="declarative SLO evaluated with fast/slow "
+                         "burn-rate windows at every /metrics scrape, "
+                         "e.g. 'goodput objective=goodput_ratio "
+                         "target=0.9 budget=0.1 fast=300 slow=3600'; "
+                         "repeatable; 'none' disables; default: the "
+                         "stock goodput / time-to-running / step-latency "
+                         "set (docs/observability.md \"Goodput & SLOs\")")
     ap.add_argument("--fleet-sched", action="store_true",
                     help="enable the fleet capacity arbiter (sched/): "
                          "priority + weighted fair-share admission over "
@@ -342,6 +353,45 @@ def main(argv=None):
     mgr.add_metrics_provider(job_metrics.metrics_block)
     if arbiter is not None:
         mgr.add_metrics_provider(arbiter.metrics_block)
+
+    # SLO burn-rate evaluation at scrape time (obs.slo): goodput +
+    # time-to-running feeds, alerts as flight-recorder entries + Events
+    spec_args = [s.strip() for s in (args.slo_spec or [])]
+    if any(s.lower() == "none" for s in spec_args):
+        # 'none' anywhere disables the evaluator; mixing it with real
+        # specs is contradictory — refuse loudly rather than silently
+        # dropping the explicit ones
+        if len(spec_args) > 1:
+            ap.error("--slo-spec none cannot be combined with other "
+                     "--slo-spec values")
+        slo_specs = []
+    elif spec_args:
+        slo_specs = [parse_slo_spec(s) for s in spec_args]
+    else:
+        slo_specs = default_slos()
+    if slo_specs:
+        def slo_alert(spec, burn_fast, burn_slow, message):
+            log.warning("SLO burn: %s", message)
+            job_metrics.flight.record(
+                "slo", spec.name, "slo_alert",
+                burn_fast=round(burn_fast, 3),
+                burn_slow=round(burn_slow, 3))
+            ref = {"kind": api.KIND, "apiVersion": api.API_VERSION,
+                   "metadata": {"namespace": "slo", "name": spec.name}}
+            try:
+                reconciler.recorder.event(ref, "Warning", "SloBurnRate",
+                                          message)
+            except Exception:
+                pass  # alerting must never take the control plane down
+
+        slo = SloEvaluator(slo_specs, on_alert=slo_alert)
+        slo.add_source(lambda: [
+            ("goodput_ratio", r)
+            for r in job_metrics.ledger.job_ratios().values()])
+        slo.add_source(lambda: [
+            ("time_to_running", s)
+            for s in job_metrics.pop_time_to_running_samples()])
+        mgr.add_metrics_provider(slo.metrics_block)
 
     Probes = probes_handler(cache, mgr, leader_elect=args.leader_elect,
                             standby_ready=args.standby_ready)

@@ -1,0 +1,55 @@
+"""Unified observability plane: per-job metrics, the goodput ledger,
+SLO burn-rate alerting, flight recorder, worker exposition, and the
+text-format tooling shared by both planes.
+
+Grown from the original single-module ``obs.py`` into a package when the
+goodput ledger landed (ISSUE 10); the public surface is re-exported here
+so ``from paddle_operator_tpu.obs import JobMetrics`` keeps working.
+Layout:
+
+* :mod:`.metrics` — :class:`JobMetrics`, :class:`FlightRecorder`,
+  :class:`ObservedEventRecorder`: the reconciler-fed per-job collectors.
+* :mod:`.ledger` — :class:`GoodputLedger`: every second of every job's
+  wall clock attributed to goodput or a named badput cause, with the
+  ``wall == goodput + Σ badput`` conservation invariant proven under
+  chaos, plus the backend-degradation detector (the silent CPU-fallback
+  alarm).
+* :mod:`.slo` — declarative :class:`SloSpec` objects evaluated with
+  fast/slow burn-rate window pairs (:class:`SloEvaluator`), surfaced as
+  Events, flight-recorder entries, and ``tpujob_slo_burn_rate`` gauges.
+* :mod:`.worker` — :class:`WorkerMetricsServer` (the runner's /metrics),
+  :class:`StepProfiler` (bounded per-step phase ring), and
+  :class:`StragglerDetector` (gang-median p50 drift).
+* :mod:`.exposition` — :func:`parse_exposition` (the strict validator
+  both scrape surfaces run through) and formatting helpers.
+
+Everything is stdlib-only and cheap when idle; nothing imports jax.
+"""
+
+from .exposition import (  # noqa: F401
+    format_float, format_value, http_respond, parse_exposition,
+)
+from .ledger import BADPUT_CAUSES, GOODPUT, GoodputLedger  # noqa: F401
+from .metrics import (  # noqa: F401
+    PHASE_BUCKETS, RESTART_CAUSES, FlightRecorder, JobMetrics,
+    ObservedEventRecorder, incident_cause, job_key,
+    wire_checkpoint_observer,
+)
+from .slo import (  # noqa: F401
+    SloEvaluator, SloSpec, default_slos, parse_slo_spec,
+)
+from .worker import (  # noqa: F401
+    STEP_PHASES, STRAGGLER_K, StepProfiler, StragglerDetector,
+    ThroughputBaseline, WorkerMetricsServer, median,
+)
+
+__all__ = [
+    "BADPUT_CAUSES", "GOODPUT", "PHASE_BUCKETS", "RESTART_CAUSES",
+    "STEP_PHASES", "STRAGGLER_K", "FlightRecorder", "GoodputLedger",
+    "JobMetrics", "ObservedEventRecorder", "SloEvaluator", "SloSpec",
+    "StepProfiler", "StragglerDetector", "ThroughputBaseline",
+    "WorkerMetricsServer", "median",
+    "default_slos", "format_float", "format_value", "http_respond",
+    "incident_cause", "job_key", "parse_exposition", "parse_slo_spec",
+    "wire_checkpoint_observer",
+]

@@ -1,0 +1,447 @@
+"""GoodputLedger — attribute every second of every job's wall clock.
+
+The bench trajectory's worst failures were *silent*: runs that lost the
+TPU backend resumed on CPU at 0.4 img/s with nothing alerting, and the
+fleet arbiter (sched/) trades checkpoints and shrinks against goodput it
+previously could not observe. This module closes that loop: from the
+moment a job is first observed, its wall clock is partitioned into
+**goodput** (the gang is up and training) and named **badput** causes
+
+    sched_wait | compile | restore | drain | eviction | data_stall |
+    backend_degraded | straggler
+
+with a conservation invariant that holds by construction and is proven
+under chaos (the ``goodput_audit`` scenario):
+
+    wall == goodput + Σ badput[cause]        (per job, within float eps)
+
+Two attribution channels:
+
+* **segments** — a per-job state machine fed by the reconciler's existing
+  hooks (phase transitions, drain notices, arbiter evictions, restarts):
+  at any instant the job is *in* exactly one bucket, and a transition
+  closes the old segment. Segments partition time, so conservation is
+  structural, not reconciled after the fact.
+* **charges** — additive badput reported from the data plane (a worker's
+  data-stall seconds, compile time, a straggler's lost overlap): moved
+  OUT of the goodput bucket into the named cause, clamped to the goodput
+  actually accumulated so the ledger can never attribute time that did
+  not pass.
+
+Every closed segment and charge is mirrored into the process trace
+(``ledger_segment`` / ``ledger_charge`` events carrying a running
+``total_s``), so ``scripts/obs_report.py`` rebuilds the same waterfall
+from trace alone and re-checks conservation offline.
+
+The **backend-degradation detector** (:meth:`GoodputLedger.
+observe_throughput`) compares observed examples/s against the job's own
+recent healthy baseline: a resumed job silently landing on a slow
+backend (the r03–r05 CPU-fallback class) collapses orders of magnitude
+below its own history and fires within one sample — Warning Event (via
+``on_alert``), flight/trace entry, ``tpujob_backend_degraded_total``,
+and the job's time flips to the ``backend_degraded`` bucket until the
+throughput recovers.
+
+Exposition (rendered by :meth:`metrics_block`, merged into the operator
+scrape through :class:`~.metrics.JobMetrics`):
+
+* ``tpujob_goodput_ratio{job}`` / ``tpujob_fleet_goodput_ratio``
+* ``tpujob_goodput_seconds_total{job}``
+* ``tpujob_badput_seconds_total{job,cause}``
+* ``tpujob_backend_degraded_total{job}``
+
+Everything stdlib-only, clock-injectable (chaos drives a tick clock so
+badput seconds join the determinism fingerprint), and bounded:
+:meth:`forget_job` drops every per-job series on terminal-job GC.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..k8s.runtime import escape_label_value
+from ..utils.trace import tracer
+from .worker import ThroughputBaseline
+
+#: the badput cause taxonomy (docs/observability.md "Goodput & SLOs")
+BADPUT_CAUSES = (
+    "sched_wait",        # admission / arbiter queue / gang bring-up
+    "compile",           # lowering + XLA compile (cache misses)
+    "restore",           # restart-from-checkpoint after a hard preemption
+    "drain",             # graceful-preemption drain + the restart it cues
+    "eviction",          # fleet-arbiter eviction (voluntary, budget-free)
+    "data_stall",        # input pipeline starved the device
+    "backend_degraded",  # silent slow-backend (CPU-fallback) operation
+    "straggler",         # gang blocked on one slow worker
+)
+GOODPUT = "goodput"
+
+#: incident kinds -> the bucket the *next* non-running stretch is charged
+#: to (set by the reconciler hooks; "restore" is the default for a hard
+#: preemption with no richer evidence)
+_PHASE_RUNNING = "Running"
+_PHASE_TERMINAL = ("Completed", "Failed")
+_PHASE_WAITING = ("", "Pending", "Starting")
+
+
+def _job_key(namespace: str, name: str) -> str:
+    return "%s/%s" % (namespace, name)
+
+
+class GoodputLedger:
+    """Per-job wall-clock attribution with a structural conservation
+    invariant. Thread-safe; all mutation under ``self._lock``; trace /
+    flight / alert emission happens outside it."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 on_alert: Optional[Callable[[str, str, str, str],
+                                             None]] = None,
+                 degraded_ratio: float = 0.25,
+                 recovery_ratio: float = 0.5,
+                 baseline_window: int = 5,
+                 baseline_min_samples: int = 3):
+        self._clock = clock
+        # on_alert(namespace, name, reason, message): the Event channel —
+        # the reconciler wires this to its recorder so detector alerts
+        # surface exactly like any other job Warning
+        self.on_alert = on_alert
+        self._degraded_ratio = degraded_ratio
+        self._recovery_ratio = recovery_ratio
+        self._baseline_min = max(1, baseline_min_samples)
+        self._baseline_window = max(self._baseline_min, baseline_window)
+        self._lock = threading.Lock()
+        # job key -> (bucket, since); absent once terminal/forgotten
+        self._state: Dict[str, Tuple[str, float]] = {}
+        # job key -> bucket -> accumulated seconds (closed segments)
+        self._buckets: Dict[str, Dict[str, float]] = {}
+        # job key -> bucket the next non-running stretch belongs to
+        self._pending: Dict[str, str] = {}
+        # jobs that have reached Running at least once (first Pending
+        # stretch is sched_wait; later ones are incident recovery)
+        self._ran: set = set()
+        self._finished: set = set()
+        # independent clock bounds per job: the conservation audit checks
+        # Σ buckets against (last - first), so a dropped segment — a bug
+        # in the state machine — is detectable, not definitionally hidden
+        self._first: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+        # backend-degradation detector state (one baseline per job)
+        self._tput: Dict[str, ThroughputBaseline] = {}
+        self._degraded: set = set()
+        self._degraded_total: Dict[str, int] = {}
+
+    # -- segment machine (reconciler hooks) ------------------------------
+
+    def observe_phase(self, namespace: str, name: str, phase: str) -> None:
+        """Fed from the one site every phase transition flows through
+        (:meth:`~.metrics.JobMetrics.observe_phase` forwards here)."""
+        key = _job_key(namespace, name)
+        with self._lock:
+            if key in self._finished:
+                return
+            if phase in _PHASE_TERMINAL:
+                emit = self._close_locked(key)
+                self._state.pop(key, None)
+                self._pending.pop(key, None)
+                self._finished.add(key)
+            elif phase == _PHASE_RUNNING:
+                self._ran.add(key)
+                self._pending.pop(key, None)
+                bucket = ("backend_degraded" if key in self._degraded
+                          else GOODPUT)
+                emit = self._enter_locked(key, bucket)
+            else:  # Pending / Starting / Restarting / unknown
+                if key not in self._ran:
+                    bucket = "sched_wait"
+                else:
+                    bucket = self._pending.get(key, "restore")
+                emit = self._enter_locked(key, bucket)
+        self._emit_segments(key, emit)
+
+    def note_incident(self, namespace: str, name: str, cause: str) -> None:
+        """An incident hook fired (drain notice, arbiter eviction, hard
+        preemption): badput starts NOW — the gang is already dying even
+        while the phase still reads Running — and the stretch until the
+        job is Running again stays charged to this cause. The first
+        incident of an episode wins (a drain notice followed by the
+        restart it cues is one ``drain`` episode, not drain+restore)."""
+        if cause not in BADPUT_CAUSES:
+            cause = "restore"
+        key = _job_key(namespace, name)
+        with self._lock:
+            if key in self._finished:
+                return
+            if key in self._pending:
+                emit: List[dict] = []
+            else:
+                self._pending[key] = cause
+                emit = self._enter_locked(key, cause)
+        self._emit_segments(key, emit)
+
+    def charge(self, namespace: str, name: str, cause: str,
+               seconds: float) -> float:
+        """Move ``seconds`` of already-accumulated goodput into a badput
+        cause (worker-reported data stalls, compile time, straggler
+        overlap loss). Clamped to the goodput actually banked, so the
+        ledger can never attribute time that did not pass; returns the
+        seconds actually moved."""
+        if cause not in BADPUT_CAUSES or seconds <= 0:
+            return 0.0
+        key = _job_key(namespace, name)
+        with self._lock:
+            if key not in self._buckets and key not in self._state:
+                return 0.0
+            emit = self._close_locked(key)  # bank the open stretch first
+            buckets = self._buckets.setdefault(key, {})
+            moved = min(float(seconds), buckets.get(GOODPUT, 0.0))
+            if moved > 0:
+                buckets[GOODPUT] = buckets[GOODPUT] - moved
+                buckets[cause] = buckets.get(cause, 0.0) + moved
+            total = sum(buckets.values())
+        self._emit_segments(key, emit)
+        if moved > 0:
+            # total_s is unchanged by the move (charges self-conserve);
+            # carried so the offline rebuild sees one uniform stream
+            tracer().event("ledger_charge", job=key, cause=cause,
+                           s=round(moved, 6), total_s=round(total, 6))
+        return moved
+
+    # -- backend-degradation detector ------------------------------------
+
+    def observe_throughput(self, namespace: str, name: str,
+                           examples_per_s: float) -> bool:
+        """One throughput sample (examples/s) against the job's OWN
+        recent healthy baseline. Returns True while degraded.
+
+        A resumed job that silently landed on a slow backend collapses
+        orders of magnitude below its own history — the median of the
+        last healthy samples — and fires on the first post-resume
+        sample. Degraded samples are NOT folded into the baseline, so a
+        long outage cannot normalize itself away; recovery (back above
+        ``recovery_ratio`` x baseline) re-arms the detector."""
+        key = _job_key(namespace, name)
+        eps = float(examples_per_s)
+        alert: Optional[str] = None
+        with self._lock:
+            tb = self._tput.get(key)
+            if tb is None:
+                tb = self._tput[key] = ThroughputBaseline(
+                    degraded_ratio=self._degraded_ratio,
+                    recovery_ratio=self._recovery_ratio,
+                    window=self._baseline_window,
+                    min_samples=self._baseline_min)
+            change = tb.observe(eps)
+            emit: List[dict] = []
+            if change == "degraded":
+                self._degraded.add(key)
+                self._degraded_total[key] = \
+                    self._degraded_total.get(key, 0) + 1
+                alert = ("observed %.3g examples/s vs own baseline %.3g "
+                         "(< %.0f%%): the job is likely running on a "
+                         "degraded backend (CPU fallback after resume?)"
+                         % (eps, tb.baseline, self._degraded_ratio * 100))
+                if self._state.get(key, ("",))[0] == GOODPUT:
+                    emit = self._enter_locked(key, "backend_degraded")
+            elif change == "recovered":
+                self._degraded.discard(key)
+                if self._state.get(key, ("",))[0] == "backend_degraded":
+                    emit = self._enter_locked(key, GOODPUT)
+            degraded = tb.degraded
+        self._emit_segments(key, emit)
+        if alert is not None:
+            tracer().event("backend_degraded", job=key,
+                           examples_per_s=round(eps, 6))
+            cb = self.on_alert
+            if cb is not None:
+                cb(namespace, name, "BackendDegraded", alert)
+        return degraded
+
+    def degraded_jobs(self) -> List[str]:
+        with self._lock:
+            return sorted(self._degraded)
+
+    # -- readout ---------------------------------------------------------
+
+    def snapshot(self, namespace: str, name: str) -> Dict[str, Any]:
+        """One job's attribution: ``{"wall", "goodput", "badput":
+        {cause: s}, "observed_s", "ratio"}``. The open segment's elapsed
+        time is added VIRTUALLY (banked only at real transitions), so a
+        scrape-driven read neither mutates state nor floods the trace —
+        while wall stays the sum of a partition of observed time."""
+        key = _job_key(namespace, name)
+        with self._lock:
+            return self._snapshot_locked(key)
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """Aggregate attribution across every job the ledger has seen
+        (live + finished, until forgotten)."""
+        with self._lock:
+            wall = good = 0.0
+            badput: Dict[str, float] = {}
+            for key in set(self._buckets) | set(self._state):
+                snap = self._snapshot_locked(key)
+                wall += snap["wall"]
+                good += snap["goodput"]
+                for cause, s in snap["badput"].items():
+                    badput[cause] = badput.get(cause, 0.0) + s
+        return {"wall": wall, "goodput": good, "badput": badput,
+                "ratio": (good / wall) if wall > 0 else 1.0}
+
+    def job_ratios(self) -> Dict[str, float]:
+        """Per-job goodput ratio — the SLO evaluator's pull source."""
+        with self._lock:
+            out = {}
+            for key in set(self._buckets) | set(self._state):
+                snap = self._snapshot_locked(key)
+                if snap["wall"] > 0:
+                    out[key] = snap["ratio"]
+            return out
+
+    def job_count(self) -> int:
+        """Jobs with live ledger series (churn-boundedness checks)."""
+        with self._lock:
+            return len(set(self._buckets) | set(self._state)
+                       | set(self._tput))
+
+    def forget_job(self, namespace: str, name: str) -> None:
+        """Terminal-job GC: drop every per-job series so 10k-job churn
+        shows no monotonic growth in label cardinality."""
+        key = _job_key(namespace, name)
+        with self._lock:
+            self._state.pop(key, None)
+            self._buckets.pop(key, None)
+            self._pending.pop(key, None)
+            self._ran.discard(key)
+            self._finished.discard(key)
+            self._first.pop(key, None)
+            self._last.pop(key, None)
+            self._tput.pop(key, None)
+            self._degraded.discard(key)
+            self._degraded_total.pop(key, None)
+
+    # -- exposition ------------------------------------------------------
+
+    def metrics_block(self) -> str:
+        """Text-exposition lines (no trailing newline); merged into the
+        operator scrape by :meth:`~.metrics.JobMetrics.metrics_block`."""
+        esc = escape_label_value
+        with self._lock:
+            snaps = {key: self._snapshot_locked(key)
+                     for key in sorted(set(self._buckets)
+                                       | set(self._state))}
+            degraded_total = dict(self._degraded_total)
+        lines: List[str] = []
+        with_wall = {k: s for k, s in snaps.items() if s["wall"] > 0}
+        if with_wall:
+            lines.append("# HELP tpujob_goodput_ratio Productive fraction "
+                         "of the job's observed wall clock.")
+            lines.append("# TYPE tpujob_goodput_ratio gauge")
+            for key, snap in with_wall.items():
+                lines.append('tpujob_goodput_ratio{job="%s"} %.6f'
+                             % (esc(key), snap["ratio"]))
+            lines.append("# HELP tpujob_goodput_seconds_total Seconds "
+                         "attributed to productive training.")
+            lines.append("# TYPE tpujob_goodput_seconds_total counter")
+            for key, snap in with_wall.items():
+                lines.append('tpujob_goodput_seconds_total{job="%s"} %.6f'
+                             % (esc(key), snap["goodput"]))
+            badput_lines = []
+            for key, snap in with_wall.items():
+                for cause in BADPUT_CAUSES:
+                    s = snap["badput"].get(cause)
+                    if s:
+                        badput_lines.append(
+                            'tpujob_badput_seconds_total'
+                            '{job="%s",cause="%s"} %.6f'
+                            % (esc(key), cause, s))
+            if badput_lines:
+                lines.append("# HELP tpujob_badput_seconds_total Seconds "
+                             "attributed to a named non-productive cause.")
+                lines.append("# TYPE tpujob_badput_seconds_total counter")
+                lines.extend(badput_lines)
+            fleet_wall = sum(s["wall"] for s in with_wall.values())
+            fleet_good = sum(s["goodput"] for s in with_wall.values())
+            lines.append("# HELP tpujob_fleet_goodput_ratio Fleet-wide "
+                         "goodput over observed wall clock, all jobs.")
+            lines.append("# TYPE tpujob_fleet_goodput_ratio gauge")
+            lines.append("tpujob_fleet_goodput_ratio %.6f"
+                         % ((fleet_good / fleet_wall)
+                            if fleet_wall > 0 else 1.0))
+        if degraded_total:
+            lines.append("# HELP tpujob_backend_degraded_total Backend-"
+                         "degradation episodes detected (throughput "
+                         "collapse vs the job's own baseline).")
+            lines.append("# TYPE tpujob_backend_degraded_total counter")
+            for key in sorted(degraded_total):
+                lines.append('tpujob_backend_degraded_total{job="%s"} %d'
+                             % (esc(key), degraded_total[key]))
+        return "\n".join(lines)
+
+    # -- internals (all called with self._lock held) ---------------------
+
+    def _enter_locked(self, key: str, bucket: str) -> List[dict]:
+        """Switch the job's open segment to ``bucket``; returns trace
+        records to emit after the lock drops."""
+        cur = self._state.get(key)
+        if cur is not None and cur[0] == bucket:
+            return []
+        # ONE clock read for close + reopen: a second read would leave a
+        # sliver of time outside every bucket and break conservation
+        # against the independent first/last clock bounds
+        now = self._clock()
+        emit = self._close_locked(key, now=now)
+        self._state[key] = (bucket, now)
+        self._first.setdefault(key, now)
+        self._last[key] = now
+        return emit
+
+    def _close_locked(self, key: str,
+                      now: Optional[float] = None) -> List[dict]:
+        """Bank the open segment (if any) into its bucket; the state
+        stays open in the same bucket from now. Returns trace records."""
+        cur = self._state.get(key)
+        if cur is None:
+            return []
+        bucket, since = cur
+        if now is None:
+            now = self._clock()
+        dur = max(0.0, now - since)
+        self._state[key] = (bucket, now)
+        self._last[key] = now
+        if dur <= 0.0:
+            return []
+        buckets = self._buckets.setdefault(key, {})
+        buckets[bucket] = buckets.get(bucket, 0.0) + dur
+        total = sum(buckets.values())
+        return [{"cause": bucket, "dur_s": round(dur, 6),
+                 "total_s": round(total, 6)}]
+
+    def _snapshot_locked(self, key: str) -> Dict[str, Any]:
+        buckets = dict(self._buckets.get(key, {}))
+        cur = self._state.get(key)
+        end = self._last.get(key)
+        if cur is not None:
+            # the open segment counts VIRTUALLY: reads must see current
+            # attribution without banking (banking on the read path
+            # would emit a trace segment per scrape per job)
+            bucket, since = cur
+            now = self._clock()
+            if now > since:
+                buckets[bucket] = buckets.get(bucket, 0.0) + (now - since)
+                end = now
+        good = buckets.get(GOODPUT, 0.0)
+        badput = {c: s for c, s in buckets.items()
+                  if c != GOODPUT and s > 0}
+        wall = good + sum(badput.values())
+        first = self._first.get(key)
+        observed = (end - first) if first is not None \
+            and end is not None else 0.0
+        return {"wall": wall, "goodput": good, "badput": badput,
+                "observed_s": observed,
+                "ratio": (good / wall) if wall > 0 else 1.0}
+
+    def _emit_segments(self, key: str, emit: List[dict]) -> None:
+        for rec in emit:
+            tracer().event("ledger_segment", job=key, **rec)

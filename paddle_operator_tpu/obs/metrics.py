@@ -1,29 +1,20 @@
-"""Unified observability layer: per-job metrics, flight recorder, worker
-exposition, and the text-format tooling shared by both planes.
-
-The reference operator's only observability surface is zap logs and k8s
-Events (SURVEY.md §5.1); our runtime previously exposed workqueue-level
-counters only. This module owns everything above that:
+"""Operator-plane per-job collectors: metrics, flight recorder, events.
 
 * :class:`JobMetrics` — the per-job collector the reconciler feeds at its
   phase-transition / restart / resize sites. Registered on the Manager via
   ``add_metrics_provider(job_metrics.metrics_block)``; exports phase state
   gauges, time-in-phase histograms, cause-split restart counters
   (preemption vs app-OOM vs app-error — the pod-sim distinction), elastic
-  resize counters, and coordination barrier wait time.
+  resize counters, and coordination barrier wait time. Every hook also
+  forwards into the attached :class:`~.ledger.GoodputLedger`, so wall-
+  clock attribution rides the exact same signal the status subresource
+  sees — no second phase machine to drift.
 * :class:`FlightRecorder` — a bounded ring of the last N phase transitions
   and events per job, the in-memory half of what ``scripts/obs_report.py``
   reconstructs from trace + events after the fact.
 * :class:`ObservedEventRecorder` — wraps a
-  :class:`~.k8s.client.EventRecorder` so every k8s Event the reconciler
+  :class:`~..k8s.client.EventRecorder` so every k8s Event the reconciler
   emits also lands in the flight recorder and the process trace.
-* :func:`parse_exposition` — a strict Prometheus text-format parser; the
-  exposition-validity tests and ``scripts/metrics_lint.py`` run it against
-  ``Manager.metrics_text()`` so an undeclared or unescaped family can't
-  ship.
-* :class:`WorkerMetricsServer` — the training runner's zero-dependency
-  ``/metrics`` endpoint (steps/s, examples/s, loss, loader queue depth,
-  per-stage host timings, goodput).
 
 Everything here is stdlib-only and cheap when idle; nothing imports jax.
 """
@@ -31,16 +22,16 @@ Everything here is stdlib-only and cheap when idle; nothing imports jax.
 from __future__ import annotations
 
 import logging
-import math
 import threading
 import time
 from collections import deque
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
-from .api.types import Phase
-from .k8s.runtime import escape_label_value, fold_suffix
-from .utils.trace import tracer
+from ..api.types import Phase
+from ..k8s.runtime import escape_label_value
+from ..utils.trace import tracer
+from .exposition import format_float
+from .ledger import GoodputLedger
 
 log = logging.getLogger("tpujob.obs")
 
@@ -61,7 +52,7 @@ def incident_cause(pods: List[dict]) -> str:
     in the batch marks the incident a preemption), then splits the
     all-app-crash case by the OOMKilled container reason the pod sim (and
     the kubelet) records: ``"preemption"`` | ``"oom"`` | ``"error"``."""
-    from .controllers import helper
+    from ..controllers import helper
 
     if any(helper.classify_pod_failure(p) != "app" for p in pods):
         return "preemption"
@@ -114,6 +105,11 @@ class FlightRecorder:
         out.sort(key=lambda e: e["seq"])
         return out
 
+    def ring_count(self) -> int:
+        """Number of per-job rings held (churn-boundedness checks)."""
+        with self._lock:
+            return len(self._rings)
+
     def forget(self, namespace: str, name: str) -> None:
         with self._lock:
             self._rings.pop(job_key(namespace, name), None)
@@ -122,14 +118,17 @@ class FlightRecorder:
 class JobMetrics:
     """Per-job metrics collector + flight recorder, fed by the reconciler.
 
-    Thread-safe; clocks are injectable so tests drive deterministic
-    durations. ``metrics_block()`` returns complete text-exposition lines
-    (HELP/TYPE included) for ``Manager.add_metrics_provider``.
+    Thread-safe; clocks are injectable so tests (and the chaos harness's
+    ``goodput_audit`` tick clock) drive deterministic durations.
+    ``metrics_block()`` returns complete text-exposition lines (HELP/TYPE
+    included) for ``Manager.add_metrics_provider`` — including the
+    attached :class:`~.ledger.GoodputLedger`'s goodput/badput families.
     """
 
     def __init__(self, clock: Callable[[], float] = time.monotonic,
                  wall: Callable[[], float] = time.time,
-                 recorder_depth: int = 64):
+                 recorder_depth: int = 64,
+                 ledger: Optional[GoodputLedger] = None):
         self._clock = clock
         self._lock = threading.Lock()
         # job key -> (phase, entered-at monotonic)
@@ -153,7 +152,17 @@ class JobMetrics:
         self._ckpt_saves: Dict[str, int] = {}
         self._ckpt_corrupt: Dict[str, int] = {}
         self._ckpt_restore_step: Dict[str, int] = {}
+        # time-to-running SLO feed: first-observation stamp per live job,
+        # jobs already sampled, and the drainable sample queue (bounded:
+        # the SLO source pops it at every evaluation)
+        self._first_seen: Dict[str, float] = {}
+        self._ttr_done: set = set()
+        self._ttr_pending: Deque[float] = deque(maxlen=1024)
         self.flight = FlightRecorder(depth=recorder_depth, wall=wall)
+        #: wall-clock attribution (docs/observability.md "Goodput & SLOs");
+        #: shares the injected clock so chaos stays deterministic
+        self.ledger = ledger if ledger is not None \
+            else GoodputLedger(clock=clock)
 
     # -- feeding hooks (reconciler / coordination server) ----------------
 
@@ -170,13 +179,20 @@ class JobMetrics:
             if prev is not None and prev[0] == phase:
                 return
             self._phase[key] = (phase, now)
+            first = self._first_seen.setdefault(key, now)
             if prev is not None:
                 self._observe_hist(prev[0], now - prev[1])
+            if phase == Phase.RUNNING and key not in self._ttr_done:
+                # only the FIRST Running transition is a time-to-running
+                # sample; restart recovery is the ledger's department
+                self._ttr_done.add(key)
+                self._ttr_pending.append(max(0.0, now - first))
         old = prev[0] if prev else ""
         self.flight.record(namespace, name, "phase",
                            **{"from": old, "to": phase})
         tracer().event("phase_transition", job=key,
                        **{"from": old, "to": phase})
+        self.ledger.observe_phase(namespace, name, phase)
 
     def observe_restart(self, namespace: str, name: str, cause: str) -> None:
         if cause not in RESTART_CAUSES:
@@ -187,6 +203,11 @@ class JobMetrics:
                 self._restarts.get((key, cause), 0) + 1
         self.flight.record(namespace, name, "restart", cause=cause)
         tracer().event("restart", job=key, cause=cause)
+        # a hard preemption's recovery stretch is restore-from-checkpoint
+        # time (the drain/eviction hooks fire BEFORE this one when the
+        # incident was graceful, and the first incident of an episode
+        # wins inside the ledger)
+        self.ledger.note_incident(namespace, name, "restore")
 
     def observe_resize(self, namespace: str, name: str,
                        np: Optional[int] = None) -> None:
@@ -217,6 +238,7 @@ class JobMetrics:
             self._drains[key] = self._drains.get(key, 0) + 1
         self.flight.record(namespace, name, "drain", pods=pods)
         tracer().event("drain_notice", job=key, pods=pods)
+        self.ledger.note_incident(namespace, name, "drain")
 
     def observe_sched_eviction(self, namespace: str, name: str) -> None:
         """The fleet arbiter preempted this job (ANNOT_SCHED_EVICT drain
@@ -227,6 +249,7 @@ class JobMetrics:
                 self._sched_evictions.get(key, 0) + 1
         self.flight.record(namespace, name, "sched_evicted")
         tracer().event("sched_evicted", job=key)
+        self.ledger.note_incident(namespace, name, "eviction")
 
     def observe_gang_stranded(self, namespace: str, name: str) -> None:
         """A startup-release failure left the gang stuck in its init
@@ -269,6 +292,14 @@ class JobMetrics:
         tracer().event("k8s_event", job=key, type=etype, reason=reason,
                        message=message)
 
+    def pop_time_to_running_samples(self) -> List[float]:
+        """Drain the pending first-Running latencies (seconds) — the
+        ``time_to_running`` SLO source consumes them at evaluation."""
+        with self._lock:
+            out = list(self._ttr_pending)
+            self._ttr_pending.clear()
+        return out
+
     def forget_job(self, namespace: str, name: str) -> None:
         """Drop a deleted job's series so cardinality stays bounded across
         job churn (phase histograms are per-phase, not per-job: kept)."""
@@ -284,9 +315,17 @@ class JobMetrics:
             self._ckpt_saves.pop(key, None)
             self._ckpt_corrupt.pop(key, None)
             self._ckpt_restore_step.pop(key, None)
+            self._first_seen.pop(key, None)
+            self._ttr_done.discard(key)
             for k in [k for k in self._restarts if k[0] == key]:
                 del self._restarts[k]
         self.flight.forget(namespace, name)
+        self.ledger.forget_job(namespace, name)
+
+    def job_count(self) -> int:
+        """Live per-job series held (churn-boundedness checks)."""
+        with self._lock:
+            return len(self._first_seen)
 
     def _observe_hist(self, phase: str, seconds: float) -> None:
         counts = self._hist.get(phase)
@@ -430,13 +469,16 @@ class JobMetrics:
             for key in sorted(ckpt_restore):
                 lines.append('tpujob_checkpoint_restore_step{job="%s"} %d'
                              % (esc(key), ckpt_restore[key]))
+        ledger_block = self.ledger.metrics_block()
+        if ledger_block:
+            lines.append(ledger_block)
         return "\n".join(lines)
 
 
 def wire_checkpoint_observer(job_metrics: "JobMetrics", namespace: str,
                              name: str) -> Callable[[str, dict], None]:
     """Bridge the checkpoint layer's process-wide recovery events
-    (:func:`~.utils.checkpoint.set_checkpoint_observer`) into one job's
+    (:func:`~..utils.checkpoint.set_checkpoint_observer`) into one job's
     :class:`JobMetrics` series — how an embedding runner (or the chaos
     harness) attributes worker-side saves/corrupt-skips/restores to the
     job the operator knows. Returns the observer fn; install it with
@@ -454,39 +496,6 @@ def wire_checkpoint_observer(job_metrics: "JobMetrics", namespace: str,
     return observer
 
 
-def format_float(v: float) -> str:
-    """Bucket bound formatting: integral bounds render bare (``1`` not
-    ``1.0``), matching common Prometheus client output."""
-    return str(int(v)) if float(v) == int(v) else repr(float(v))
-
-
-def format_value(v: float) -> str:
-    """Sample-value formatting, safe for the non-finite values a diverged
-    run produces (``int(nan)`` raises — a NaN loss must not take the
-    whole /metrics scrape down with it)."""
-    v = float(v)
-    if math.isnan(v):
-        return "NaN"
-    if math.isinf(v):
-        return "+Inf" if v > 0 else "-Inf"
-    return "%d" % v if v == int(v) else "%.6f" % v
-
-
-def http_respond(req, code: int, body: bytes,
-                 ctype: str = "text/plain") -> None:
-    """The one response-writer for this package's stdlib HTTP handlers
-    (probes, metrics, worker exposition): headers + body with the
-    client-went-away errors swallowed."""
-    req.send_response(code)
-    req.send_header("Content-Type", ctype)
-    req.send_header("Content-Length", str(len(body)))
-    req.end_headers()
-    try:
-        req.wfile.write(body)
-    except (BrokenPipeError, ConnectionResetError):
-        pass
-
-
 class ObservedEventRecorder:
     """EventRecorder wrapper: every event also feeds the flight recorder
     and the process trace, so the k8s Event stream and the JSONL timeline
@@ -501,272 +510,3 @@ class ObservedEventRecorder:
         self._obs.record_event(meta.get("namespace", "default"),
                                meta.get("name", ""), etype, reason, message)
         self._inner.event(obj, etype, reason, message)
-
-
-# ---------------------------------------------------------------------------
-# Prometheus text-format validation (tests + scripts/metrics_lint.py)
-# ---------------------------------------------------------------------------
-
-def _valid_name(name: str) -> bool:
-    if not name:
-        return False
-    ok_first = name[0].isalpha() or name[0] in "_:"
-    return ok_first and all(c.isalnum() or c in "_:" for c in name)
-
-
-def _parse_labels(raw: str) -> Tuple[Optional[Dict[str, str]], Optional[str]]:
-    """Parse the inside of ``{...}``. Returns (labels, error)."""
-    labels: Dict[str, str] = {}
-    i, n = 0, len(raw)
-    while i < n:
-        j = i
-        while j < n and (raw[j].isalnum() or raw[j] == "_"):
-            j += 1
-        name = raw[i:j]
-        if not name or not (name[0].isalpha() or name[0] == "_"):
-            return None, "bad label name at %r" % raw[i:i + 12]
-        if j >= n or raw[j] != "=":
-            return None, "expected '=' after label %r" % name
-        j += 1
-        if j >= n or raw[j] != '"':
-            return None, "label %r value not quoted" % name
-        j += 1
-        value = []
-        while j < n:
-            c = raw[j]
-            if c == "\\":
-                if j + 1 >= n or raw[j + 1] not in ('\\', '"', 'n'):
-                    return None, "bad escape in label %r" % name
-                value.append({"\\": "\\", '"': '"', "n": "\n"}[raw[j + 1]])
-                j += 2
-                continue
-            if c == '"':
-                break
-            if c == "\n":
-                return None, "raw newline in label %r" % name
-            value.append(c)
-            j += 1
-        else:
-            return None, "unterminated value for label %r" % name
-        labels[name] = "".join(value)
-        j += 1  # closing quote
-        if j < n and raw[j] == ",":
-            j += 1
-        elif j < n:
-            return None, "expected ',' between labels at %r" % raw[j:j + 12]
-        i = j
-    return labels, None
-
-
-def parse_exposition(text: str) -> List[str]:
-    """Strictly validate Prometheus text exposition; returns a list of
-    error strings (empty = valid). Checks:
-
-    * every sample belongs to a declared (``# TYPE``-ed) family —
-      ``_bucket``/``_sum``/``_count`` suffixes allowed for histogram and
-      summary families;
-    * each family is declared exactly once, HELP/TYPE before its samples,
-      and a family's samples are contiguous (no interleaving);
-    * label blocks parse strictly (escaped ``\\``/``"``/newlines only);
-    * sample values parse as floats.
-    """
-    errors: List[str] = []
-    types: Dict[str, str] = {}
-    helped: set = set()
-    closed: set = set()   # families whose sample run has ended
-    current: Optional[str] = None
-
-    def family_of(metric: str) -> Optional[str]:
-        # the suffix rules live in ONE place (k8s.runtime.fold_suffix),
-        # shared with the Manager's provider-block merger
-        return fold_suffix(metric, types.get)
-
-    for lineno, line in enumerate(text.splitlines(), 1):
-        if not line.strip():
-            continue
-        if line.startswith("# HELP "):
-            parts = line.split(" ", 3)
-            if len(parts) < 3:
-                errors.append("line %d: malformed HELP" % lineno)
-                continue
-            fam = parts[2]
-            if fam in helped:
-                errors.append("line %d: duplicate HELP for %s" % (lineno, fam))
-            helped.add(fam)
-            continue
-        if line.startswith("# TYPE "):
-            parts = line.split(" ")
-            if len(parts) != 4:
-                errors.append("line %d: malformed TYPE" % lineno)
-                continue
-            fam, mtype = parts[2], parts[3]
-            if fam in types:
-                errors.append("line %d: duplicate TYPE for %s" % (lineno, fam))
-                continue
-            if mtype not in ("counter", "gauge", "histogram", "summary",
-                             "untyped"):
-                errors.append("line %d: unknown type %r" % (lineno, mtype))
-            if not _valid_name(fam):
-                errors.append("line %d: bad family name %r" % (lineno, fam))
-            types[fam] = mtype
-            if current is not None and current != fam:
-                closed.add(current)
-            current = fam
-            continue
-        if line.startswith("#"):
-            continue  # comment
-        # sample line: name[{labels}] value [timestamp]
-        brace = line.find("{")
-        if brace >= 0:
-            metric = line[:brace]
-            close = line.rfind("}")
-            if close < brace:
-                errors.append("line %d: unbalanced label braces" % lineno)
-                continue
-            labels_raw = line[brace + 1:close]
-            rest = line[close + 1:].strip()
-            _labels, err = _parse_labels(labels_raw)
-            if err:
-                errors.append("line %d: %s" % (lineno, err))
-        else:
-            metric, _, rest = line.partition(" ")
-            rest = rest.strip()
-        if not _valid_name(metric):
-            errors.append("line %d: bad metric name %r" % (lineno, metric))
-            continue
-        fam = family_of(metric)
-        if fam is None:
-            errors.append("line %d: sample %r has no declared family"
-                          % (lineno, metric))
-            continue
-        if fam != current:
-            if fam in closed:
-                errors.append(
-                    "line %d: samples for %s are not contiguous"
-                    % (lineno, fam))
-            if current is not None:
-                closed.add(current)
-            current = fam
-        try:
-            float(rest.split(" ")[0])
-        except (ValueError, IndexError):
-            errors.append("line %d: unparseable value %r" % (lineno, rest))
-    return errors
-
-
-# ---------------------------------------------------------------------------
-# worker-side exposition (the training runner's /metrics)
-# ---------------------------------------------------------------------------
-
-_WORKER_GAUGES = [
-    ("tpujob_worker_steps_total",
-     "Optimizer steps completed this run.", "counter"),
-    ("tpujob_worker_steps_per_second",
-     "Training throughput at the last log boundary.", "gauge"),
-    ("tpujob_worker_examples_per_second",
-     "Example throughput at the last log boundary.", "gauge"),
-    ("tpujob_worker_loss",
-     "Loss at the last resolved log boundary.", "gauge"),
-    ("tpujob_worker_loader_queue_depth",
-     "Prestaged batches/windows waiting in the input pipeline.", "gauge"),
-    ("tpujob_worker_goodput_ratio",
-     "Productive step-dispatch time over wall time.", "gauge"),
-]
-
-
-class WorkerMetricsServer:
-    """Zero-dependency ``/metrics`` endpoint for the training runner.
-
-    The runner pushes values with :meth:`update` /
-    :meth:`set_stage_summary`; scrapes render them in the same text
-    exposition format the operator serves. ``bind=":0"`` picks a free
-    port (tests); production sets ``TPUJOB_WORKER_METRICS_PORT``.
-    """
-
-    def __init__(self, bind: str = ":0"):
-        host, _, port = bind.rpartition(":")
-        outer = self
-        self._lock = threading.Lock()
-        self._values: Dict[str, float] = {}
-        self._stages: Dict[str, Dict[str, float]] = {}
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path != "/metrics":
-                    http_respond(self, 404, b"")
-                    return
-                http_respond(self, 200, outer.metrics_text().encode(),
-                             ctype="text/plain; version=0.0.4")
-
-            def log_message(self, *a):
-                pass
-
-        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
-                                          Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    # -- lifecycle -------------------------------------------------------
-
-    def start(self) -> "WorkerMetricsServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="worker-metrics")
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-
-    @property
-    def url(self) -> str:
-        return "http://127.0.0.1:%d" % self.port
-
-    # -- updates (runner) ------------------------------------------------
-
-    def update(self, **values: float) -> None:
-        """Merge gauge/counter values by short name (``steps_total``,
-        ``steps_per_second``, ``examples_per_second``, ``loss``,
-        ``loader_queue_depth``, ``goodput_ratio``)."""
-        with self._lock:
-            for k, v in values.items():
-                if v is not None:
-                    self._values[k] = float(v)
-
-    def set_stage_summary(self, summary: Dict[str, Dict[str, float]]) -> None:
-        """Publish a :meth:`~.utils.trace.StageTimes.summary` breakdown."""
-        with self._lock:
-            self._stages = {k: dict(v) for k, v in summary.items()}
-
-    # -- exposition ------------------------------------------------------
-
-    def metrics_text(self) -> str:
-        with self._lock:
-            values = dict(self._values)
-            stages = {k: dict(v) for k, v in self._stages.items()}
-        lines: List[str] = []
-        for name, help_text, mtype in _WORKER_GAUGES:
-            short = name[len("tpujob_worker_"):]
-            if short not in values:
-                continue
-            lines.append("# HELP %s %s" % (name, help_text))
-            lines.append("# TYPE %s %s" % (name, mtype))
-            lines.append("%s %s" % (name, format_value(values[short])))
-        if stages:
-            lines.append("# HELP tpujob_worker_stage_seconds_total Host "
-                         "wall-clock accumulated per pipeline stage.")
-            lines.append("# TYPE tpujob_worker_stage_seconds_total counter")
-            for stage in sorted(stages):
-                lines.append(
-                    'tpujob_worker_stage_seconds_total{stage="%s"} %.6f'
-                    % (escape_label_value(stage),
-                       stages[stage].get("ms", 0.0) / 1e3))
-            lines.append("# HELP tpujob_worker_stage_calls_total Times "
-                         "each pipeline stage was entered.")
-            lines.append("# TYPE tpujob_worker_stage_calls_total counter")
-            for stage in sorted(stages):
-                lines.append(
-                    'tpujob_worker_stage_calls_total{stage="%s"} %d'
-                    % (escape_label_value(stage),
-                       int(stages[stage].get("count", 0))))
-        return "\n".join(lines) + "\n"

@@ -1,0 +1,248 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+An :class:`SloSpec` declares an objective over a sample stream —
+``goodput_ratio`` (fed from the :class:`~.ledger.GoodputLedger`),
+``time_to_running`` (fed from :class:`~.metrics.JobMetrics`' first
+Pending→Running transition), ``step_latency_p99`` (fed from worker step
+profiles), or any custom objective pushed via
+:meth:`SloEvaluator.observe` — a target, a comparator, and an error
+budget. The evaluator keeps a bounded sliding window of samples per SLO
+and computes the classic fast/slow **burn-rate pair**:
+
+    burn(window) = bad_fraction(window) / error_budget
+
+A burn of 1.0 consumes the budget exactly at the sustainable rate; an
+alert fires only when BOTH the fast and the slow window exceed
+``burn_threshold`` (the standard multi-window guard: the fast window
+gives reaction time, the slow window keeps a transient blip from
+paging), and re-arms once the fast window recovers. Alerts surface as
+k8s Events + flight-recorder entries through the ``on_alert`` callback
+(wired by the harness / manager), and every evaluation exports
+
+    tpujob_slo_burn_rate{slo=,window="fast"|"slow"}
+
+gauges the fleet arbiter (sched/) and a future TpuServe autoscaler can
+consume as scale / preemption signals (``burn_rates()`` returns the same
+numbers programmatically).
+
+Evaluation is pull-driven: :meth:`metrics_block` (registered as a
+Manager metrics provider) evaluates at scrape time, so there is no
+background thread; sources registered with :meth:`add_source` are
+drained on each evaluation. Everything is clock-injectable and bounded
+(sample windows are fixed-size deques; no per-job state), so fleet churn
+cannot grow evaluator memory.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, Iterable, List, Optional, Tuple
+
+from collections import deque
+
+from ..k8s.runtime import escape_label_value
+
+#: objectives with built-in sources (docs/observability.md):
+#: goodput_ratio (ledger), time_to_running (JobMetrics),
+#: step_latency_p99 (worker step profiles) — plus anything custom.
+KNOWN_OBJECTIVES = ("goodput_ratio", "time_to_running", "step_latency_p99")
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One declarative SLO. ``comparator`` says which side of ``target``
+    is GOOD for a sample value: ``">="`` for ratios (higher is better),
+    ``"<="`` for latencies."""
+
+    name: str
+    objective: str
+    target: float
+    comparator: str = ">="
+    budget: float = 0.1           # allowed bad-sample fraction
+    fast_window: float = 60.0     # seconds
+    slow_window: float = 300.0
+    burn_threshold: float = 1.0
+
+    def is_good(self, value: float) -> bool:
+        if self.comparator == "<=":
+            return value <= self.target
+        return value >= self.target
+
+
+def parse_slo_spec(text: str) -> SloSpec:
+    """Parse the CLI / config form: a name followed by ``key=value``
+    tokens, e.g.::
+
+        goodput objective=goodput_ratio target=0.9 budget=0.1 \\
+            fast=60 slow=300 cmp=ge burn=1.0
+
+    ``cmp`` is ``ge`` (value >= target is good; ratios) or ``le``
+    (latencies). Unknown keys raise — a typo'd SLO must not silently
+    evaluate as something else."""
+    parts = text.split()
+    if not parts or "=" in parts[0]:
+        raise ValueError("SLO spec needs a leading name: %r" % text)
+    kw: Dict[str, str] = {}
+    for tok in parts[1:]:
+        k, sep, v = tok.partition("=")
+        if not sep:
+            raise ValueError("SLO token %r is not key=value" % tok)
+        kw[k] = v
+    known = {"objective", "target", "budget", "fast", "slow", "cmp",
+             "burn"}
+    unknown = set(kw) - known
+    if unknown:
+        raise ValueError("unknown SLO keys %s in %r"
+                         % (sorted(unknown), text))
+    if "objective" not in kw or "target" not in kw:
+        raise ValueError("SLO spec %r needs objective= and target=" % text)
+    cmp_tok = kw.get("cmp", "ge")
+    if cmp_tok not in ("ge", "le"):
+        raise ValueError("SLO cmp must be ge|le, got %r" % cmp_tok)
+    return SloSpec(
+        name=parts[0],
+        objective=kw["objective"],
+        target=float(kw["target"]),
+        comparator=">=" if cmp_tok == "ge" else "<=",
+        budget=float(kw.get("budget", 0.1)),
+        fast_window=float(kw.get("fast", 60.0)),
+        slow_window=float(kw.get("slow", 300.0)),
+        burn_threshold=float(kw.get("burn", 1.0)),
+    )
+
+
+def default_slos() -> List[SloSpec]:
+    """The stock fleet SLO set wired by the harness and the manager:
+    goodput, admission latency, and worker step latency."""
+    return [
+        SloSpec("goodput", "goodput_ratio", target=0.5, comparator=">=",
+                budget=0.25),
+        SloSpec("time-to-running", "time_to_running", target=120.0,
+                comparator="<=", budget=0.2),
+        SloSpec("step-latency", "step_latency_p99", target=1.0,
+                comparator="<=", budget=0.1),
+    ]
+
+
+class SloEvaluator:
+    """Sliding-window burn-rate evaluation over pushed + pulled samples.
+
+    Thread-safe; all state under ``self._lock``; the alert callback runs
+    outside it."""
+
+    def __init__(self, specs: Iterable[SloSpec],
+                 clock: Callable[[], float] = time.monotonic,
+                 on_alert: Optional[Callable[[SloSpec, float, float, str],
+                                             None]] = None,
+                 max_samples: int = 4096):
+        self.specs: List[SloSpec] = list(specs)
+        names = [s.name for s in self.specs]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate SLO names: %r" % names)
+        self._clock = clock
+        # on_alert(spec, burn_fast, burn_slow, message)
+        self.on_alert = on_alert
+        self._lock = threading.Lock()
+        self._samples: Dict[str, Deque[Tuple[float, bool]]] = {
+            s.name: deque(maxlen=max_samples) for s in self.specs}
+        self._burn: Dict[Tuple[str, str], float] = {}
+        self._alerting: set = set()
+        # pull sources: fn() -> iterable of (objective, value); drained
+        # at every evaluation (scrape)
+        self._sources: List[Callable[[], Iterable[Tuple[str, float]]]] = []
+
+    def add_source(self, fn: Callable[[], Iterable[Tuple[str, float]]]
+                   ) -> None:
+        self._sources.append(fn)
+
+    def observe(self, objective: str, value: float,
+                t: Optional[float] = None) -> None:
+        """Push one sample; routed to every spec with this objective."""
+        now = self._clock() if t is None else t
+        with self._lock:
+            for spec in self.specs:
+                if spec.objective == objective:
+                    self._samples[spec.name].append(
+                        (now, spec.is_good(float(value))))
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Drain the pull sources, recompute every (slo, window) burn
+        rate, and fire/clear alerts. Returns the alerts fired THIS call."""
+        for src in list(self._sources):
+            for objective, value in src():
+                self.observe(objective, value)
+        if now is None:
+            now = self._clock()
+        fired: List[dict] = []
+        alerts: List[Tuple[SloSpec, float, float, str]] = []
+        with self._lock:
+            for spec in self.specs:
+                samples = self._samples[spec.name]
+                fast = _burn_rate(samples, now, spec.fast_window,
+                                  spec.budget)
+                slow = _burn_rate(samples, now, spec.slow_window,
+                                  spec.budget)
+                self._burn[(spec.name, "fast")] = fast
+                self._burn[(spec.name, "slow")] = slow
+                hot = (fast >= spec.burn_threshold
+                       and slow >= spec.burn_threshold)
+                if hot and spec.name not in self._alerting:
+                    self._alerting.add(spec.name)
+                    msg = ("SLO %s (%s %s %.4g) burning: fast-window "
+                           "burn %.2f, slow-window burn %.2f (threshold "
+                           "%.2f, budget %.0f%%)"
+                           % (spec.name, spec.objective, spec.comparator,
+                              spec.target, fast, slow,
+                              spec.burn_threshold, spec.budget * 100))
+                    alerts.append((spec, fast, slow, msg))
+                    fired.append({"slo": spec.name, "burn_fast": fast,
+                                  "burn_slow": slow, "message": msg})
+                elif not hot and fast < spec.burn_threshold:
+                    # re-arm once the fast window is healthy again
+                    self._alerting.discard(spec.name)
+        cb = self.on_alert
+        if cb is not None:
+            for spec, fast, slow, msg in alerts:
+                cb(spec, fast, slow, msg)
+        return fired
+
+    def burn_rates(self) -> Dict[Tuple[str, str], float]:
+        """Last-evaluated burn per (slo, window) — the programmatic
+        surface the arbiter / autoscaler consume."""
+        with self._lock:
+            return dict(self._burn)
+
+    def metrics_block(self) -> str:
+        """Evaluate (pull model: every scrape re-evaluates) and render
+        the burn-rate gauges."""
+        self.evaluate()
+        with self._lock:
+            burns = dict(self._burn)
+        if not burns:
+            return ""
+        lines = ["# HELP tpujob_slo_burn_rate Error-budget burn rate "
+                 "per SLO and window (1.0 = budget consumed exactly at "
+                 "the sustainable rate).",
+                 "# TYPE tpujob_slo_burn_rate gauge"]
+        for (slo, window) in sorted(burns):
+            lines.append(
+                'tpujob_slo_burn_rate{slo="%s",window="%s"} %.6f'
+                % (escape_label_value(slo), window, burns[(slo, window)]))
+        return "\n".join(lines)
+
+
+def _burn_rate(samples: Deque[Tuple[float, bool]], now: float,
+               window: float, budget: float) -> float:
+    lo = now - window
+    total = bad = 0
+    for t, good in samples:
+        if t >= lo:
+            total += 1
+            if not good:
+                bad += 1
+    if total == 0:
+        return 0.0
+    frac = bad / total
+    return frac / max(budget, 1e-9)

@@ -25,6 +25,9 @@ import numpy as np
 from . import compile_cache
 from .data import DeferredMetrics, ShardedLoader, job_window_source
 from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distributed
+from .obs.worker import (
+    StepProfiler, StragglerDetector, ThroughputBaseline, median,
+)
 from .ops.optim import Optimizer
 from .parallel import batch_shardings, build_train_step, make_mesh
 from .parallel.sharding import Rules
@@ -207,6 +210,16 @@ class TrainJob:
     # programmatic drain channel (tests / embedding runners call
     # monitor.request()); built automatically when None
     drain_monitor: Optional[DrainMonitor] = None
+    # cross-worker straggler detection: own dispatch-p50 -> {worker_id:
+    # p50} giving the gang view at a log boundary. None on multi-host
+    # defaults to a process_allgather of every worker's p50 (an aligned
+    # collective — all processes reach the same boundary); tests inject
+    # a fake gang here so detection runs without real TPUs. A worker
+    # whose p50 exceeds straggler_k x the gang median emits a
+    # `straggler` trace event + tpujob_straggler_total and counts in
+    # result["straggler_events"].
+    gang_p50_source: Optional[Callable[[float], Dict[Any, float]]] = None
+    straggler_k: float = 2.0
     seed: int = 0
 
 
@@ -267,6 +280,23 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
     # time over cycle wall time — the headline "is this job actually
     # training" number (EasyScale-style regression triage needs it)
     goodput_acc = {"wall": 0.0, "step": 0.0}
+    # step-level observability (docs/observability.md "Goodput & SLOs"):
+    # a bounded per-step phase ring, the gang straggler detector, and the
+    # run-level badput attribution that becomes result["goodput_detail"]
+    profiler = StepProfiler()
+    detector = StragglerDetector(k=job.straggler_k)
+    # the worker is the authoritative source of its own examples/s, so
+    # the silent-CPU-fallback alarm runs HERE too: a resumed process
+    # whose throughput collapses against its own recent baseline warns,
+    # traces, and counts — even when nothing operator-side scrapes it
+    tput_watch = ThroughputBaseline()
+    badput_acc: Dict[str, float] = {}
+    result["straggler_events"] = 0
+    result["backend_degraded_events"] = 0
+
+    def add_badput(cause: str, seconds: float) -> None:
+        if seconds > 0:
+            badput_acc[cause] = badput_acc.get(cause, 0.0) + seconds
 
     def save(step: int, state, epoch: int) -> None:
         """Multi-host: every process writes its own shards (a full gather of
@@ -389,6 +419,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         # also resolves each step's manifest + data together — a
         # checkpoint published mid-restore can't mix two steps' files.
         manifest = None
+        t_restore0 = time.perf_counter()
         if job.checkpoint_dir:
             try:
                 # sharded manifests restore shard-wise into the live
@@ -419,6 +450,9 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             state = _materialize_state(state)
             start_step = manifest["step"]
             result.setdefault("resume_steps", []).append(start_step)
+            # the whole restore chain (read + verify + place +
+            # materialize) is restore badput in the goodput ledger
+            add_badput("restore", time.perf_counter() - t_restore0)
             log.info("restored checkpoint step=%d (epoch %s)",
                      start_step, manifest["meta"].get("epoch"))
         if ckpt_writer is not None and job.checkpoint_dir:
@@ -441,10 +475,27 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             stalls the dispatch pipeline."""
             if resolved is None:
                 return
+            t_d2h0 = time.perf_counter()
             pstep, t_submit, host = resolved
             rate = (pstep - start_step) / max(t_submit - t0, 1e-9)
             log.info("step %d loss=%.4f steps/s=%.2f",
                      pstep, float(host["loss"]), rate)
+            eps = rate * examples_per_step
+            if examples_per_step > 0 and \
+                    tput_watch.observe(eps) == "degraded":
+                log.warning(
+                    "backend degraded: %.3g examples/s vs own baseline "
+                    "%.3g — likely a CPU-fallback resume", eps,
+                    tput_watch.baseline)
+                trc.event("backend_degraded", step=pstep,
+                          examples_per_s=round(eps, 6),
+                          baseline=round(tput_watch.baseline, 6))
+                result["backend_degraded_events"] += 1
+                if metrics_srv is not None:
+                    metrics_srv.inc("tpujob_worker_backend_degraded_total")
+            # the readback that really landed here is the d2h phase of
+            # this boundary's step profile (usually ~0: deferred design)
+            profiler.record(pstep, d2h=time.perf_counter() - t_d2h0)
             if metrics_srv is not None:
                 metrics_srv.update(
                     steps_total=pstep,
@@ -484,17 +535,61 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             place=not multi, timings=times)
         t_dispatched = None  # end of the previous dispatch (host clock)
 
-        def dispatch(fn, batch):
+        def fetch():
+            """Dequeue the next prestaged batch/window, charging the
+            host wait (consumer starved = producer-bound) to data_stall
+            badput and the step profile's data_wait phase."""
+            t_f0 = time.perf_counter()
+            batch = next(loader)
+            wait = time.perf_counter() - t_f0
+            add_badput("data_stall", wait)
+            return batch, wait
+
+        def dispatch(fn, fetched, at_step):
             """One step_fn/single_fn call, with the host gap between
             consecutive dispatches (batch wait + logging + checkpoint
-            time) recorded as the `dispatch_gap` stage."""
+            time) recorded as the `dispatch_gap` stage and the per-step
+            phases (data_wait, dispatch) in the bounded profiler ring."""
             nonlocal t_dispatched
+            batch, data_wait = fetched
             if t_dispatched is not None:
                 times.add("dispatch_gap", time.perf_counter() - t_dispatched)
+            t_d0 = time.perf_counter()
             with times.timed("step_dispatch"):
                 out = fn(state, batch)
             t_dispatched = time.perf_counter()
+            profiler.record(at_step, data_wait=data_wait,
+                            dispatch=t_dispatched - t_d0)
             return out
+
+        def straggler_check(at_step):
+            """Compare this worker's dispatch p50 against the gang view
+            (injected source, or an allgather on multi-host — an aligned
+            collective: every process reaches the same log boundary)."""
+            own = profiler.p50("dispatch")
+            if own <= 0.0:
+                return
+            if job.gang_p50_source is not None:
+                gang = job.gang_p50_source(own)
+                me = cfg.worker_id
+            elif multi:
+                from jax.experimental import multihost_utils
+
+                arr = multihost_utils.process_allgather(
+                    np.asarray(own, dtype=np.float64))
+                gang = {i: float(v) for i, v in enumerate(np.ravel(arr))}
+                me = jax.process_index()
+            else:
+                return
+            slow = detector.evaluate(gang or {})
+            if me in slow:
+                # the SAME median the detector thresholded against
+                trc.event("straggler", step=at_step, p50=round(own, 6),
+                          gang_median=round(median(list(gang.values())),
+                                            6))
+                result["straggler_events"] += 1
+                if metrics_srv is not None:
+                    metrics_srv.inc("tpujob_straggler_total")
 
         try:
             step = start_step
@@ -505,7 +600,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 if k_here == K:
                     # full window (K>1) or plain per-step batch (K==1),
                     # prestaged by the loader
-                    state, metrics = dispatch(step_fn, next(loader))
+                    state, metrics = dispatch(step_fn, fetch(), step)
                     if K > 1:
                         # fused metrics come back stacked [K]; report the last
                         metrics = jax.tree_util.tree_map(
@@ -515,8 +610,9 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                     # (the scan length is fixed at trace time)
                     if single_fn is None:
                         single_fn = make_single_fn()
-                    for _ in range(k_here):
-                        state, metrics = dispatch(single_fn, next(loader))
+                    for tail_i in range(k_here):
+                        state, metrics = dispatch(single_fn, fetch(),
+                                                  step + tail_i)
                 prof.after(step, span=k_here)
                 step += k_here
                 trc.event("train_step", step=step, epoch=epoch)
@@ -525,9 +621,17 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                     # deferred readback: start the D2H copy for THIS
                     # boundary, log the PREVIOUS one (already on host)
                     log_resolved(deferred.start(step, metrics))
+                    straggler_check(step)
+                    trc.event("step_profile", step=step,
+                              **{ph: st["p50"] for ph, st
+                                 in profiler.stats().items()})
                 if job.checkpoint_dir and (
                         step % job.checkpoint_every < k_here):
+                    t_ck0 = time.perf_counter()
                     save(step, state, epoch)
+                    ck_s = time.perf_counter() - t_ck0
+                    add_badput("checkpoint", ck_s)
+                    profiler.record(step, checkpoint=ck_s)
                     last_saved = step
                 outcome = poll_boundary()
                 if outcome != _POLL_NONE:
@@ -545,9 +649,12 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                         # skip the rewrite when the periodic save just
                         # covered this exact step — the stop path only
                         # needs the write durable, not duplicated
+                        t_ck0 = time.perf_counter()
                         if last_saved != step:
                             save(step, state, epoch)
                         drain_saves()  # the restart restores this write
+                        add_badput("checkpoint",
+                                   time.perf_counter() - t_ck0)
                     if drained:
                         # exit CLEAN: the drained pod's replacement (or
                         # the next incarnation after the operator's
@@ -583,6 +690,8 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 "step_dispatch", {}).get("ms", 0.0) / 1e3
             if metrics_srv is not None:
                 metrics_srv.set_stage_summary(result["host_stages"])
+                metrics_srv.set_step_stats(profiler.stats())
+                metrics_srv.set_badput(badput_acc)
                 if goodput_acc["wall"] > 0:
                     metrics_srv.update(goodput_ratio=min(
                         1.0, goodput_acc["step"] / goodput_acc["wall"]))
@@ -618,4 +727,32 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         result["goodput"] = round(
             min(1.0, goodput_acc["step"] / goodput_acc["wall"]), 4)
     result["compile_cache"] = compile_cache.startup_block()
+    result["step_profile"] = profiler.stats()
+    # -- worker-local goodput attribution (the runner half of the
+    # operator's goodput ledger; docs/observability.md "Goodput & SLOs").
+    # Conservation is structural: wall == goodput + Σ badput, with the
+    # independently-measured causes clamped into the non-productive
+    # remainder (a cause overlapping dispatch — e.g. a jit-rung compile
+    # that ran inside the first step — must not over-attribute) and the
+    # unnamed rest reported as host_other, never silently dropped.
+    add_badput("compile",
+               float(result["compile_cache"].get("compile_seconds") or 0.0))
+    wall = goodput_acc["wall"]
+    if wall > 0:
+        good = min(goodput_acc["step"], wall)
+        avail = max(0.0, wall - good)
+        named = sum(badput_acc.values())
+        scale = (avail / named) if named > avail and named > 0 else 1.0
+        badput_s = {cause: round(s * scale, 6)
+                    for cause, s in sorted(badput_acc.items())
+                    if s * scale > 1e-9}
+        other = max(0.0, avail - sum(badput_s.values()))
+        if other > 1e-9:
+            badput_s["host_other"] = round(other, 6)
+        result["goodput_detail"] = {
+            "wall_s": round(wall, 6),
+            "goodput_s": round(good, 6),
+            "ratio": round(good / wall, 4),
+            "badput_s": badput_s,
+        }
     return result

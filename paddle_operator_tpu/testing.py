@@ -18,7 +18,7 @@ from .k8s.fake import FakeKubeClient
 from .k8s.informer import CachedKubeClient, InformerCache, cached_kinds
 from .k8s.podsim import PodSimulator
 from .k8s.runtime import Manager
-from .obs import JobMetrics
+from .obs import JobMetrics, SloEvaluator, default_slos
 from .controllers import helper
 
 
@@ -35,6 +35,8 @@ class OperatorHarness:
         client_middleware=None,
         arbiter_factory=None,
         reconcile_workers: int = 1,
+        metrics_clock=None,
+        slo_specs=None,
     ):
         self.client = FakeKubeClient()
         self.client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
@@ -59,6 +61,13 @@ class OperatorHarness:
         # is operator memory and must be rebuilt by restart_operator()
         # (its whole state is a cache over cluster objects)
         self._arbiter_factory = arbiter_factory
+        # injectable JobMetrics/ledger clock: the goodput_audit chaos
+        # scenario drives a tick clock here so badput seconds are
+        # deterministic and can join the replay fingerprint
+        self._metrics_clock = metrics_clock
+        # declarative SLOs evaluated at scrape time (None = the stock
+        # default_slos set; pass [] to disable the evaluator entirely)
+        self._slo_specs = slo_specs
         self.arbiter = None
         self.coord_server = None
         self._build_operator()
@@ -85,7 +94,28 @@ class OperatorHarness:
         # per-job observability: shared by the reconciler and (when HTTP
         # coordination is on) the barrier-wait tracking, exposed through
         # Manager.metrics_text like production manager.py wires it
-        self.job_metrics = JobMetrics()
+        if self._metrics_clock is not None:
+            self.job_metrics = JobMetrics(clock=self._metrics_clock)
+        else:
+            self.job_metrics = JobMetrics()
+        # SLO burn-rate evaluation (obs.slo): pull-driven at scrape time
+        # from the goodput ledger + time-to-running feed; alerts land as
+        # flight-recorder entries + Warning Events like production
+        self.slo = None
+        specs = default_slos() if self._slo_specs is None \
+            else list(self._slo_specs)
+        if specs:
+            kw = {}
+            if self._metrics_clock is not None:
+                kw["clock"] = self._metrics_clock
+            self.slo = SloEvaluator(specs, on_alert=self._slo_alert, **kw)
+            self.slo.add_source(
+                lambda: [("goodput_ratio", r)
+                         for r in self.job_metrics.ledger
+                         .job_ratios().values()])
+            self.slo.add_source(
+                lambda: [("time_to_running", s) for s in self.job_metrics
+                         .pop_time_to_running_samples()])
         # Production release channel: a real CoordinationServer on localhost;
         # the pod simulator polls it over real HTTP like the init container.
         coord_url = ""
@@ -116,6 +146,8 @@ class OperatorHarness:
                                cache=self.cache,
                                reconcile_workers=self._reconcile_workers)
         self.manager.add_metrics_provider(self.job_metrics.metrics_block)
+        if self.slo is not None:
+            self.manager.add_metrics_provider(self.slo.metrics_block)
         if self.arbiter is not None:
             self.manager.add_metrics_provider(self.arbiter.metrics_block)
         self.controller = self.manager.add_controller(
@@ -141,7 +173,19 @@ class OperatorHarness:
                 "_phase", "_hist", "_hist_sum", "_hist_count",
                 "_restarts", "_resizes", "_barrier_wait", "_releases",
                 "_drains", "_sched_evictions", "_gang_stranded",
-                "_ckpt_saves", "_ckpt_corrupt", "_ckpt_restore_step"])
+                "_ckpt_saves", "_ckpt_corrupt", "_ckpt_restore_step",
+                "_first_seen", "_ttr_done", "_ttr_pending"])
+            # the goodput ledger's whole segment/detector state is
+            # lock-owned: an unlocked touch is exactly the torn-
+            # attribution class of bug the conservation invariant exists
+            # to catch
+            racedetect.guard_fields(self.job_metrics.ledger, "_lock", [
+                "_state", "_buckets", "_pending", "_ran", "_finished",
+                "_first", "_last", "_tput", "_degraded",
+                "_degraded_total"])
+            if self.slo is not None:
+                racedetect.guard_fields(self.slo, "_lock", [
+                    "_samples", "_burn", "_alerting"])
             if self.arbiter is not None:
                 # decision_log is deliberately unguarded: the chaos
                 # auditor and tests read it post-quiescence without the
@@ -168,6 +212,21 @@ class OperatorHarness:
             if self.coord_server is not None:
                 racedetect.guard_fields(self.coord_server, "_barrier_lock",
                                         ["_first_denied", "_released_pods"])
+
+    def _slo_alert(self, spec, burn_fast, burn_slow, message) -> None:
+        """An SLO's fast+slow burn windows both exceeded threshold:
+        surface it as a flight-recorder entry (ring key ``slo/<name>``)
+        and a Warning Event, the same channels incidents use."""
+        self.job_metrics.flight.record(
+            "slo", spec.name, "slo_alert",
+            burn_fast=round(burn_fast, 3), burn_slow=round(burn_slow, 3))
+        ref = {"kind": api.KIND, "apiVersion": api.API_VERSION,
+               "metadata": {"namespace": "slo", "name": spec.name}}
+        try:
+            self.reconciler.recorder.event(ref, "Warning", "SloBurnRate",
+                                           message)
+        except Exception:
+            pass  # alerting must never take the control plane down
 
     def restart_operator(self) -> None:
         """Model the operator PROCESS dying and a replacement starting

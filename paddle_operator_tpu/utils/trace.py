@@ -57,13 +57,36 @@ _NULL_SPAN = _NullSpan()
 
 
 class Tracer:
-    """Structured span recorder, JSONL sink, thread-safe, cheap when off."""
+    """Structured span recorder, JSONL sink, thread-safe, cheap when off.
 
-    def __init__(self, path: str = "", enabled: Optional[bool] = None):
+    The sink rotates by size: once the live file exceeds ``max_bytes``
+    (``TPUJOB_TRACE_MAX_MB``; 0/unset = never), it is atomically renamed
+    to ``<path>.1`` (older segments shifting to ``.2`` … ``.keep``, the
+    oldest discarded) and a fresh file is opened — a week-long run can no
+    longer grow one unbounded JSONL. ``scripts/obs_report.py`` reads the
+    rotated segments transparently (oldest → newest → live)."""
+
+    def __init__(self, path: str = "", enabled: Optional[bool] = None,
+                 max_bytes: Optional[int] = None,
+                 keep: Optional[int] = None):
         self.path = path or os.environ.get("TPUJOB_TRACE_FILE", "")
         self.enabled = bool(self.path) if enabled is None else enabled
+        if max_bytes is None:
+            try:
+                max_bytes = int(float(os.environ.get(
+                    "TPUJOB_TRACE_MAX_MB", "0")) * 1024 * 1024)
+            except ValueError:
+                max_bytes = 0
+        self.max_bytes = max(0, max_bytes)
+        if keep is None:
+            try:
+                keep = int(os.environ.get("TPUJOB_TRACE_KEEP", "3"))
+            except ValueError:
+                keep = 3
+        self.keep = max(1, keep)
         self._lock = threading.Lock()
         self._file = None
+        self._bytes = 0
         self._events = deque(maxlen=4096)  # in-memory ring, O(1) append
 
     @contextmanager
@@ -103,7 +126,38 @@ class Tracer:
                 if self._file is None:
                     os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
                     self._file = open(self.path, "a", buffering=1)
-                self._file.write(json.dumps(rec) + "\n")
+                    try:  # appending to a survivor: resume its byte count
+                        self._bytes = os.path.getsize(self.path)
+                    except OSError:
+                        self._bytes = 0
+                line = json.dumps(rec) + "\n"
+                self._file.write(line)
+                self._bytes += len(line)
+                if self.max_bytes and self._bytes >= self.max_bytes:
+                    self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path.i`` → ``path.i+1`` (discarding ``.keep``) and
+        atomically rename the live file to ``path.1``. os.replace is a
+        single atomic rename per segment, so a reader (or a crash)
+        observes either the old or the new name — never a torn file."""
+        self._file.close()
+        self._file = None
+        self._bytes = 0
+        try:
+            for i in range(self.keep, 0, -1):
+                src = "%s.%d" % (self.path, i)
+                if not os.path.exists(src):
+                    continue
+                if i == self.keep:
+                    os.remove(src)
+                else:
+                    os.replace(src, "%s.%d" % (self.path, i + 1))
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            # a rotation failure (read-only dir race, NFS hiccup) must
+            # not take tracing down; keep appending to the live file
+            pass
 
     @property
     def events(self):

@@ -91,6 +91,13 @@ def selftest_text() -> str:
     h.job_metrics.observe_restart("default", 'evil"name\\x', "oom")
     h.job_metrics.observe_sched_eviction("default", 'evil"name\\x')
     h.job_metrics.observe_gang_stranded("default", 'evil"name\\x')
+    # a worker-reported data stall + a throughput collapse, so the
+    # goodput-ledger badput + degradation families populate
+    h.job_metrics.ledger.charge("default", "lint-tpu", "data_stall", 0.001)
+    for _ in range(3):
+        h.job_metrics.ledger.observe_throughput("default", "lint-tpu",
+                                                1000.0)
+    h.job_metrics.ledger.observe_throughput("default", "lint-tpu", 0.4)
     text = h.manager.metrics_text()
     # the coverage this selftest claims must actually be in the text —
     # a scenario drift that stops exercising these emitters should fail
@@ -103,10 +110,52 @@ def selftest_text() -> str:
                 # histogram split by outcome
                 "tpujob_workqueue_lane_depth",
                 "tpujob_workqueue_active",
-                "tpujob_reconcile_seconds"):
+                "tpujob_reconcile_seconds",
+                # the goodput ledger + SLO plane (ISSUE 10)
+                "tpujob_goodput_ratio",
+                "tpujob_goodput_seconds_total",
+                "tpujob_badput_seconds_total",
+                "tpujob_fleet_goodput_ratio",
+                "tpujob_backend_degraded_total",
+                "tpujob_slo_burn_rate"):
         assert "# TYPE %s" % fam in text, "selftest lost %s" % fam
     assert 'tenant="evil' in text, "adversarial tenant label missing"
     assert 'outcome="done"' in text, "reconcile histogram lost its outcomes"
+    assert 'cause="data_stall"' in text, "ledger badput cause missing"
+    h.close()
+    return text
+
+
+def selftest_worker_text() -> str:
+    """Drive a live WorkerMetricsServer through every update surface the
+    runner uses (gauges, stage summary, step-phase quantiles, badput,
+    the straggler counter) and return its exposition — previously this
+    endpoint shipped UNVALIDATED while only the operator scrape was
+    gated."""
+    from paddle_operator_tpu.obs import StepProfiler, WorkerMetricsServer
+
+    srv = WorkerMetricsServer().start()
+    try:
+        srv.update(steps_total=12, steps_per_second=3.25,
+                   examples_per_second=26.0, loss=0.5,
+                   loader_queue_depth=2, goodput_ratio=0.85)
+        srv.set_stage_summary({"batch_build": {"ms": 10.0, "count": 12,
+                                               "mean_ms": 0.83}})
+        prof = StepProfiler()
+        for i in range(8):
+            prof.record(i, data_wait=0.001 * i, dispatch=0.01,
+                        checkpoint=0.002)
+        srv.set_step_stats(prof.stats())
+        srv.set_badput({"data_stall": 0.004, "checkpoint": 0.016,
+                        'evil"cause\\x': 0.001})
+        srv.inc("tpujob_straggler_total")
+        text = srv.metrics_text()
+    finally:
+        srv.stop()
+    for fam in ("tpujob_worker_step_phase_seconds",
+                "tpujob_worker_badput_seconds_total",
+                "tpujob_straggler_total"):
+        assert "# TYPE %s" % fam in text, "worker selftest lost %s" % fam
     return text
 
 
@@ -123,6 +172,8 @@ def main(argv=None) -> int:
     targets = []
     if args.selftest:
         targets.append(("selftest:Manager.metrics_text", selftest_text()))
+        targets.append(("selftest:WorkerMetricsServer.metrics_text",
+                        selftest_worker_text()))
     for path in args.files:
         with open(path) as f:
             targets.append((path, f.read()))

@@ -28,7 +28,7 @@ import json
 import os
 import sys
 import tempfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -39,19 +39,45 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # loading
 # ---------------------------------------------------------------------------
 
+def trace_paths(path: str) -> List[str]:
+    """The live trace file plus its rotated segments (``<path>.N`` from
+    size-based rotation — see utils.trace.Tracer), oldest first, so a
+    timeline spanning a rotation reads as one stream."""
+    import glob
+    import re
+
+    rotated = []
+    for p in glob.glob(glob.escape(path) + ".*"):
+        m = re.match(re.escape(path) + r"\.(\d+)$", p)
+        if m:
+            rotated.append((int(m.group(1)), p))
+    out = [p for _n, p in sorted(rotated, reverse=True)]
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
 def load_trace(path: str) -> List[dict]:
-    """Read a Tracer JSONL file; unparseable lines are skipped (a crash
-    mid-write must not take the post-mortem tool down with it)."""
+    """Read a Tracer JSONL file — rotated segments included, oldest
+    first; unparseable lines are skipped (a crash mid-write must not
+    take the post-mortem tool down with it)."""
     records = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except ValueError:
-                continue
+    for p in trace_paths(path):
+        try:
+            f = open(p)
+        except FileNotFoundError:
+            # the live file may not exist (rotated away at the exact
+            # boundary, or nothing was ever emitted)
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    continue
     return records
 
 
@@ -205,6 +231,82 @@ def phases_of(timeline: List[dict]) -> List[str]:
     return out
 
 
+def ledger_waterfall(records: List[dict], job: Optional[str] = None
+                     ) -> Tuple[Dict[str, Dict[str, float]],
+                                Dict[str, float]]:
+    """Rebuild per-job goodput/badput attribution from the trace ALONE:
+    ``ledger_segment`` events carry each closed segment's cause +
+    duration (and the ledger's own running ``total_s``), and
+    ``ledger_charge`` events move seconds from goodput into a named
+    cause (the sum is unchanged — charges self-conserve). Returns
+    ``(buckets, ledger_totals)`` — the conservation check compares the
+    rebuilt sum against the ledger's last self-reported running total,
+    so a dropped or double-emitted SEGMENT event is detectable (a
+    dropped charge shifts attribution between buckets but cannot break
+    the sum)."""
+    buckets: Dict[str, Dict[str, float]] = {}
+    totals: Dict[str, float] = {}
+    for rec in records:
+        name = rec.get("name")
+        if name not in ("ledger_segment", "ledger_charge"):
+            continue
+        attrs = rec.get("attrs") or {}
+        jkey = attrs.get("job")
+        if not jkey or not _matches(jkey, job):
+            continue
+        b = buckets.setdefault(jkey, {})
+        cause = attrs.get("cause", "?")
+        if name == "ledger_segment":
+            b[cause] = b.get(cause, 0.0) + float(attrs.get("dur_s") or 0.0)
+        else:  # a charge conserves the sum: goodput -> cause
+            s = float(attrs.get("s") or 0.0)
+            b[cause] = b.get(cause, 0.0) + s
+            b["goodput"] = b.get("goodput", 0.0) - s
+        if attrs.get("total_s") is not None:
+            totals[jkey] = float(attrs["total_s"])
+    return buckets, totals
+
+
+def waterfall_violations(buckets: Dict[str, Dict[str, float]],
+                         totals: Dict[str, float],
+                         tol: float = 0.01) -> List[str]:
+    """Conservation check on the REBUILT waterfall: Σ rebuilt buckets
+    must equal the ledger's own last running total."""
+    errs = []
+    for jkey in sorted(buckets):
+        want = totals.get(jkey)
+        if want is None:
+            errs.append("%s: trace has ledger events but no running "
+                        "total" % jkey)
+            continue
+        rebuilt = sum(buckets[jkey].values())
+        if abs(rebuilt - want) > tol:
+            errs.append("%s: rebuilt waterfall %.6fs != ledger total "
+                        "%.6fs (conservation broken in the trace)"
+                        % (jkey, rebuilt, want))
+    return errs
+
+
+def render_waterfall(jkey: str, buckets: Dict[str, float]) -> str:
+    """One job's goodput waterfall as text: per-cause seconds with
+    proportional bars, goodput first, then badput causes by weight."""
+    lines = []
+    title = "Goodput waterfall for %s" % jkey
+    lines.append(title)
+    lines.append("-" * len(title))
+    total = sum(buckets.values())
+    peak = max((abs(v) for v in buckets.values()), default=0.0)
+    order = sorted(buckets.items(),
+                   key=lambda kv: (kv[0] != "goodput", -kv[1]))
+    for cause, secs in order:
+        bar = "#" * (int(round(24 * abs(secs) / peak)) if peak > 0 else 0)
+        share = (secs / total * 100) if total > 0 else 0.0
+        lines.append("  %-18s %9.3fs %5.1f%%  %s"
+                     % (cause, secs, share, bar))
+    lines.append("  %-18s %9.3fs" % ("wall (attributed)", total))
+    return "\n".join(lines)
+
+
 def render_report(timeline: List[dict], metrics_text: str = "",
                   job: Optional[str] = None) -> str:
     lines = []
@@ -288,6 +390,21 @@ def run_chaos(scenario: str, seed: int, verbose: bool) -> int:
             rc = 0
         print(render_report(timeline, metrics_text=metrics, job=jkey))
         print()
+    # goodput waterfalls, rebuilt from the trace ALONE, with the
+    # conservation invariant re-checked offline (the `make obs` proof
+    # that attribution survives the trace round trip)
+    buckets, totals = ledger_waterfall(records)
+    if buckets:
+        for jkey in sorted(buckets):
+            print(render_waterfall(jkey, buckets[jkey]))
+            print()
+        errs = waterfall_violations(buckets, totals)
+        if errs:
+            print("WATERFALL CONSERVATION VIOLATIONS:")
+            for e in errs:
+                print("  " + e)
+            return 1
+        print("waterfall conservation: ok (%d job(s))" % len(buckets))
     return rc
 
 
@@ -303,6 +420,10 @@ def main(argv=None) -> int:
                     help="run this chaos scenario (with tracing) and "
                          "report from its output")
     ap.add_argument("--seed", type=int, default=0, help="chaos seed")
+    ap.add_argument("--waterfall", action="store_true",
+                    help="also render per-job goodput waterfalls from "
+                         "the trace's ledger events and re-check the "
+                         "conservation invariant (exit 1 on violation)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="include every reconcile span")
     args = ap.parse_args(argv)
@@ -325,6 +446,17 @@ def main(argv=None) -> int:
     timeline = build_timeline(records, events, job=args.job,
                               verbose=args.verbose)
     print(render_report(timeline, metrics_text=metrics, job=args.job))
+    if args.waterfall:
+        buckets, totals = ledger_waterfall(records, job=args.job)
+        for jkey in sorted(buckets):
+            print()
+            print(render_waterfall(jkey, buckets[jkey]))
+        errs = waterfall_violations(buckets, totals)
+        if errs:
+            print("WATERFALL CONSERVATION VIOLATIONS:")
+            for e in errs:
+                print("  " + e)
+            return 1
     return 0 if timeline else 2
 
 
