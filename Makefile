@@ -48,7 +48,7 @@ race:
 	  tests/test_chaos.py tests/test_compile_cache.py \
 	  tests/test_control_plane.py tests/test_coordination.py \
 	  tests/test_data.py tests/test_elastic_e2e.py tests/test_fake_client.py \
-	  tests/test_goodput.py \
+	  tests/test_feedback.py tests/test_goodput.py \
 	  tests/test_helper.py tests/test_hostport_elastic_server.py \
 	  tests/test_http_client.py tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
@@ -72,11 +72,15 @@ recovery:
 	  --scenario graceful_drain --seeds 1 --quick
 
 # fleet-scheduler fast lane (docs/design.md "Fleet scheduling &
-# multi-tenancy"): scheduler unit tests + one seed of the multi_tenant
-# scenario (priority/fair-share arbitration, shrink-before-evict,
-# checkpoint-aware preemption, FIFO-baseline goodput comparison)
+# multi-tenancy" + docs/observability.md "Feedback loop"): scheduler +
+# feedback-loop unit tests, then one seed of the multi_tenant scenario
+# (priority/fair-share arbitration, shrink-before-evict, badput-
+# predicted victim selection, straggler re-gang + degradation
+# remediation, and the goodput-ratio comparison against the static
+# arbiter and FIFO replays of the same seed)
 sched:
-	$(PY) -m pytest tests/test_sched.py -x -q -m "not slow"
+	$(PY) -m pytest tests/test_sched.py tests/test_feedback.py -x -q \
+	  -m "not slow"
 	$(PY) scripts/chaos_stress.py --scenario multi_tenant --seeds 1 --quick
 
 # observability lanes (see docs/observability.md):
@@ -89,9 +93,13 @@ sched:
 #                  Manager.metrics_text() AND WorkerMetricsServer
 #                  .metrics_text() with every provider registered,
 #                  so an undeclared/unescaped family can't ship
+#                  ... plus the feedback-decision lane: every
+#                  sched_feedback decision (victim/regang/remediate/
+#                  boost) reconstructed with its inputs from trace alone
 obs:
 	$(PY) scripts/obs_report.py --chaos preemption_burst --seed 1
 	$(PY) scripts/obs_report.py --chaos goodput_audit --seed 1
+	$(PY) scripts/obs_report.py --chaos multi_tenant --seed 1 --decisions
 
 metrics-lint:
 	$(PY) scripts/metrics_lint.py --selftest

@@ -148,9 +148,11 @@ class ChaosHarness:
         self.clock = _TickClock() if audit else None
         # remaining ticks of collapsed examples/s (backend_degrade fault)
         self._degrade_ticks = 0
-        # data_stall seconds the ledger really accepted (charges clamp
-        # to banked goodput; the audit compares against what moved)
+        # data_stall / straggler seconds the ledger really accepted
+        # (charges clamp to banked goodput; the audit compares against
+        # what moved)
         self._stall_moved = 0.0
+        self._straggler_moved = 0.0
         self.h = OperatorHarness(
             init_image="" if storm else "docker.io/library/busybox:1",
             client_middleware=lambda c: ChaosKubeClient(c, self.injector),
@@ -324,6 +326,14 @@ class ChaosHarness:
                 "default", p["job"], "data_stall", float(p["seconds"]))
             self._stall_moved += moved
             self.injector.record("data_stall")
+        elif ev.kind == "straggler":
+            # worker-reported straggler overlap loss (gang blocked on a
+            # slow member): the runner's gang-median detector feed,
+            # charged into the ledger's straggler bucket
+            moved = self.h.job_metrics.ledger.charge(
+                "default", p["job"], "straggler", float(p["seconds"]))
+            self._straggler_moved += moved
+            self.injector.record("straggler")
         elif ev.kind == "backend_degrade":
             # the silent CPU-fallback model: the job's reported
             # examples/s collapses for N ticks; the detector must catch
@@ -507,6 +517,10 @@ class ChaosHarness:
         if abs(bad.get("data_stall", 0.0) - self._stall_moved) > 1e-6:
             out.append("data_stall badput %.6f != accepted charges %.6f"
                        % (bad.get("data_stall", 0.0), self._stall_moved))
+        if abs(bad.get("straggler", 0.0) - self._straggler_moved) > 1e-6:
+            out.append("straggler badput %.6f != accepted charges %.6f"
+                       % (bad.get("straggler", 0.0),
+                          self._straggler_moved))
         if counts.get("backend_degrade"):
             evs = [e for e in self.h.client.all_objects("Event")
                    if e.get("reason") == "BackendDegraded"]

@@ -33,9 +33,16 @@ Fault taxonomy (``FaultEvent.kind``):
 ``loader_stall``          producer-side stall inside the input pipeline
 ``data_stall``            worker-reported input-stall seconds charged to the
                           goodput ledger (``goodput_audit``)
-``backend_degrade``       collapse the job's reported examples/s for N ticks —
-                          the silent CPU-fallback model the degradation
-                          detector must catch (``goodput_audit``)
+``backend_degrade``       collapse the job's reported examples/s for N ticks
+                          (``goodput_audit``), or — in ``multi_tenant`` —
+                          mark the job as resumed onto a degraded host (its
+                          throughput collapses and its progress crawls until
+                          the feedback loop re-schedules it)
+``straggler``             one gang member becomes persistently slow (its p50
+                          stays above k x the gang median), taxing the whole
+                          slice until the feedback loop evicts and re-gangs
+                          it (``multi_tenant``); in ``goodput_audit`` a
+                          worker-reported straggler overlap-loss charge
 ========================  ====================================================
 
 ``graceful_drain`` runs a second, training-plane leg after the control-plane
@@ -257,14 +264,25 @@ def _multi_tenant(rng: random.Random, quick: bool
     apiserver errors. ``job_submit`` params feed chaos.tenants.
 
     Base jobs are sized so their sum exceeds one slice but fits the
-    fleet; min_hosts=hosts on some jobs models "refuses to shrink"."""
+    fleet; min_hosts=hosts on some jobs models "refuses to shrink".
+
+    Every seed also carries the two feedback-loop shapes (ISSUE 11): a
+    ``backend_degrade`` landing on one long base job (resume onto a
+    degraded host: throughput collapses, progress crawls at 1/4 rate
+    until re-scheduled) and a ``straggler`` on a DIFFERENT multi-host
+    base job (one member persistently slow, the whole gang at 1/2 rate
+    until the member is re-ganged). The goodput-aware run remediates
+    both; the static-arbiter replay of the same seed cannot — the fleet
+    goodput-ratio invariant in chaos.tenants measures exactly that."""
     events: List[FaultEvent] = []
     tenants = ("team-a", "team-b")
     classes = ("tpu-low", "tpu-standard")
     n_base = rng.randint(3, 4)
     small_names = []
     for i in range(n_base):
-        hosts = rng.choice([1, 2, 2, 4])
+        # base0 is pinned multi-host so every seed has a valid straggler
+        # target (a 1-host gang has no "slow member vs gang" contrast)
+        hosts = 2 if i == 0 else rng.choice([1, 2, 2, 4])
         name = "base%d" % i
         small_names.append(name)
         events.append(FaultEvent(0, "job_submit", {
@@ -279,6 +297,15 @@ def _multi_tenant(rng: random.Random, quick: bool
             "duration": rng.randint(14, 20),
             "elastic": True,
         }))
+    # the degraded host hits a different base job than the straggler so
+    # the two remediation paths are exercised independently every seed
+    degrade_target = "base%d" % rng.randrange(1, n_base)
+    events.append(FaultEvent(rng.randint(3, 7), "backend_degrade",
+                             {"job": degrade_target}))
+    # worker 0: elastic shrink drops the HIGHEST indices, so the slow
+    # member survives shrink churn and only a re-gang can replace it
+    events.append(FaultEvent(rng.randint(3, 7), "straggler",
+                             {"job": "base0", "worker": 0}))
     if rng.random() < 0.5:
         # a rigid bystander: non-elastic, never preemptible — the
         # arbiter must reserve around it
@@ -340,6 +367,13 @@ def _goodput_audit(rng: random.Random, quick: bool
         events.append(FaultEvent(rng.randint(3, 24), "data_stall",
                                  {"job": "audit",
                                   "seconds": rng.randint(1, 3)}))
+    # worker-reported straggler overlap loss (the gang blocked on one
+    # slow member): charged into the ledger's straggler bucket like the
+    # runner's gang-median detector feed would
+    for _ in range(rng.randint(1, 2)):
+        events.append(FaultEvent(rng.randint(3, 24), "straggler",
+                                 {"job": "audit",
+                                  "seconds": rng.randint(1, 2)}))
     if rng.random() < 0.5:
         events.append(FaultEvent(
             drain_at + rng.randint(10, 14), "backend_degrade",

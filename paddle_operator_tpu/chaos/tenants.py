@@ -11,9 +11,22 @@ final checkpoint cut at every graceful drain — the control-plane model
 of the PR 5 runner behavior (the bit-identical training-plane proof
 lives in chaos.recovery).
 
-After the arbitrated run, the SAME plan replays against a naive-FIFO
-baseline (``FleetArbiter(mode="fifo")``: arrival order, head-of-line
-blocking, no shrink, no preemption) and the report carries both goodput
+Since ISSUE 11 the run also carries the feedback-loop model: every plan
+lands a ``backend_degrade`` (the job resumed onto a degraded host — its
+reported examples/s collapses and its progress crawls at 1/4 rate until
+re-scheduled onto fresh hosts) and a ``straggler`` (one member of a
+multi-host gang persistently slow; the whole slice pays and progresses
+at 1/2 rate until that member is evicted and re-ganged). The goodput-
+aware arbitrated run (``mode="fair"``: FleetArbiter + FeedbackController)
+detects and remediates both through the reconciler's budget-free
+graceful-drain path; the **static-arbiter replay** (``mode="static"``:
+the same fair arbiter WITHOUT feedback — the PR 6 scheduler) suffers
+them for the rest of the run. The obs ledger runs on the harness tick
+clock in every mode, so per-cause badput seconds and the fleet goodput
+ratio are deterministic replayable facts.
+
+After the arbitrated run, the SAME plan replays against the static
+arbiter and a naive-FIFO baseline, and the report carries all goodput
 numbers. Invariants audited on the arbitrated run:
 
 * **no starvation** — every submitted job reaches Completed, and makes
@@ -25,7 +38,11 @@ numbers. Invariants audited on the arbitrated run:
 * **no lost work without a hard kill** — jobs that saw only graceful
   (scheduler) drains finish with every worked step kept;
 * **goodput** — priority-weighted completion reward strictly beats the
-  FIFO baseline run from the same seed.
+  FIFO baseline run from the same seed;
+* **feedback** — the degraded job is remediated (budget-free: its
+  schedPreemptions count, its preemption budget untouched), the
+  straggler member is re-ganged, and the fleet goodput ratio (from the
+  ledger) strictly beats the static-arbiter replay of the same seed.
 """
 
 from __future__ import annotations
@@ -33,19 +50,19 @@ from __future__ import annotations
 import random
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from ..api import types as api
 from ..controllers import helper
 from ..k8s.errors import NotFoundError
 from ..k8s.objects import get_controller_of
 from ..sched import (
-    ANNOT_ARRIVAL, ANNOT_TENANT_WEIGHT, PRIORITY_CLASSES, FleetArbiter,
-    make_tpu_node,
+    ANNOT_ARRIVAL, ANNOT_TENANT_WEIGHT, PRIORITY_CLASSES,
+    FeedbackController, FleetArbiter, make_tpu_node,
 )
 from ..testing import OperatorHarness
 from .api_faults import ChaosKubeClient, FaultInjector
-from .harness import ChaosReport
+from .harness import ChaosReport, _TickClock
 from .plan import ChaosPlan
 from .pod_faults import PodChaos
 
@@ -63,17 +80,41 @@ FIRST_PROGRESS_BOUND = 120
 
 HIGH_PRIO = PRIORITY_CLASSES["tpu-high"]
 
+#: the throughput model the ledger's degradation detector sees: healthy
+#: examples/s vs the r03-r05 CPU-fallback floor
+HEALTHY_EPS = 1000.0
+DEGRADED_EPS = 0.4
+#: healthy samples the detector needs before a collapse can fire
+BASELINE_SAMPLES = 3
+#: progress divisors while the fault is live: a degraded backend crawls
+#: at 1/4 rate, a gang taxed by one straggler at 1/2
+DEGRADED_DIVISOR = 4
+STRAGGLER_DIVISOR = 2
+#: the straggler's p50 vs the gang median fed to the feedback watch
+#: (3x > the k=2 threshold) and the per-tick overlap-loss charge
+STRAGGLER_P50, STRAGGLER_MEDIAN = 3.0, 1.0
+STRAGGLER_CHARGE_S = 0.5
+
 
 class TenantFleetRun:
-    """One mode ("fair" or "fifo") of one seeded multi-tenant run."""
+    """One mode of one seeded multi-tenant run: ``fair`` (the goodput-
+    aware arbiter: feedback loop wired), ``static`` (the same arbiter
+    WITHOUT feedback — the PR 6 replay baseline), or ``fifo`` (naive
+    first-come baseline)."""
 
     def __init__(self, plan: ChaosPlan, mode: str = "fair"):
+        assert mode in ("fair", "static", "fifo")
         self.plan = plan
         self.mode = mode
         self.injector = FaultInjector()
+        # the obs ledger runs on the harness tick clock in EVERY mode:
+        # badput seconds and the fleet goodput ratio are deterministic
+        # replayable facts the feedback-vs-static invariant compares
+        self.clock = _TickClock()
         self.h = OperatorHarness(
             client_middleware=lambda c: ChaosKubeClient(c, self.injector),
-            arbiter_factory=self._arbiter_factory)
+            arbiter_factory=self._arbiter_factory,
+            metrics_clock=self.clock)
         self.h.manager.add_metrics_provider(self.injector.metrics_block)
         for pool in range(FLEET_POOLS):
             for node in range(NODES_PER_POOL):
@@ -88,14 +129,21 @@ class TenantFleetRun:
         self._arrival_seq = 0
         self.cap_violations: List[str] = []
         self.max_allocated = 0
+        #: feedback-loop fault targets (plan events), for the invariants
+        self.degrade_targets: Set[str] = set()
+        self.straggler_targets: Set[str] = set()
 
     # -- wiring ----------------------------------------------------------
 
     def _arbiter_factory(self, client, job_metrics):
+        feedback = None
+        if self.mode == "fair":
+            feedback = FeedbackController(ledger=job_metrics.ledger)
         return FleetArbiter(
             client, evictor=self._evict, job_metrics=job_metrics,
-            mode=self.mode, drain_grace=DRAIN_GRACE,
-            ckpt_info=self._ckpt_info)
+            mode="fifo" if self.mode == "fifo" else "fair",
+            drain_grace=DRAIN_GRACE,
+            ckpt_info=self._ckpt_info, feedback=feedback)
 
     def _ckpt_info(self, job: api.TpuJob) -> Optional[dict]:
         st = self.jobs.get(job.name)
@@ -152,6 +200,16 @@ class TenantFleetRun:
             "progress": 0, "ckpt": 0, "worked": 0,
             "first_progress": None, "completed": None, "terminal": False,
             "drained": 0, "hard_kills": 0, "lost": 0,
+            # feedback-loop model state (backend_degrade / straggler):
+            # the faults are HOST-sticky — an ordinary preemption
+            # resumes on whatever is free (the bad host included), so
+            # only a committed feedback remediation (which excludes the
+            # offender) heals them; the *_base fields snapshot the
+            # commit counters at activation
+            "degrade_pending": False, "degraded": False,
+            "healthy_feeds": 0, "remediate_base": 0,
+            "straggler_pending": None, "straggler": None,
+            "regang_base": 0, "rate_tick": 0,
         }
 
     def _fire(self, tick: int, ev) -> None:
@@ -175,6 +233,22 @@ class TenantFleetRun:
                 st["hard_kills"] += 1
                 st["lost"] += st["progress"] - st["ckpt"]
                 st["progress"] = st["ckpt"]
+        elif ev.kind == "backend_degrade":
+            # the job's NEXT stretch runs on a degraded host: activates
+            # once the detector has a baseline (>= BASELINE_SAMPLES
+            # healthy feeds), so the collapse is catchable in one sample
+            st = self.jobs.get(p["job"])
+            if st is not None:
+                st["degrade_pending"] = True
+                self.degrade_targets.add(p["job"])
+        elif ev.kind == "straggler":
+            # one gang member turns persistently slow at the next
+            # gang-up tick; cleared only when THAT member is recreated
+            # on a fresh host (uid turnover)
+            st = self.jobs.get(p["job"])
+            if st is not None:
+                st["straggler_pending"] = int(p.get("worker", 0))
+                self.straggler_targets.add(p["job"])
         else:
             raise ValueError("unknown multi_tenant fault %r" % ev.kind)
 
@@ -230,6 +304,10 @@ class TenantFleetRun:
                 for p in live))
             if not gang_up:
                 continue
+            divisor = self._gang_tick(name, st, live)
+            st["rate_tick"] += 1
+            if st["rate_tick"] % divisor != 0:
+                continue  # degraded/straggling: this tick made no step
             st["progress"] += 1
             st["worked"] += 1
             if st["first_progress"] is None:
@@ -245,6 +323,89 @@ class TenantFleetRun:
             self.cap_violations.append(
                 "tick %d: %d live worker chips exceed the %d-chip fleet"
                 % (tick, allocated, FLEET_CHIPS))
+
+    def _worker_by_index(self, pods: List[dict],
+                         idx: int) -> Optional[dict]:
+        for pod in pods:
+            _res, i = helper.extract_name_index(pod["metadata"]["name"])
+            if i == idx:
+                return pod
+        return None
+
+    def _gang_tick(self, name: str, st: dict, live: List[dict]) -> int:
+        """One tick with the gang fully up: drive the worker-plane model
+        (throughput feed to the degradation detector, straggler windows
+        to the feedback watch, overlap-loss charges) and return this
+        tick's progress divisor. Deterministic: everything keys off the
+        tick clock and the plan."""
+        ledger = self.h.job_metrics.ledger
+        feedback = self.h.arbiter.feedback if self.h.arbiter else None
+        commits = (feedback.commits("default", name)
+                   if feedback is not None else {})
+        # The faults are HOST-sticky: an ordinary eviction/preemption
+        # resumes on whatever hosts are free — the bad host it just
+        # vacated included — so only a COMMITTED feedback remediation
+        # (which excludes the offender from placement) heals. By the
+        # first fully-up gang after a commit, the targeted member (or
+        # the whole gang) has been recreated, so healing at that tick
+        # is exact. The static/fifo replays have no feedback: they pay
+        # the tax for the rest of the run — the contrast the fleet
+        # goodput-ratio invariant measures.
+        if st["straggler_pending"] is not None and st["straggler"] is None:
+            if self._worker_by_index(live, st["straggler_pending"]) \
+                    is not None:
+                st["straggler"] = st["straggler_pending"]
+                st["straggler_pending"] = None
+                st["regang_base"] = commits.get("regang", 0)
+        if st["straggler"] is not None and \
+                commits.get("regang", 0) > st["regang_base"]:
+            st["straggler"] = None
+        if st["degraded"] and \
+                commits.get("remediate", 0) > st["remediate_base"]:
+            st["degraded"] = False
+        # degraded-host activation only once the detector has a healthy
+        # baseline, so the collapse is catchable within one sample in
+        # every mode
+        if st["degrade_pending"] and st["healthy_feeds"] >= \
+                BASELINE_SAMPLES:
+            st["degrade_pending"] = False
+            st["degraded"] = True
+            st["remediate_base"] = commits.get("remediate", 0)
+        # the worker-plane feeds a scrape/allgather would deliver now
+        eps = DEGRADED_EPS if st["degraded"] else HEALTHY_EPS
+        if ledger.observe_throughput("default", name, eps) \
+                and feedback is not None:
+            # a degraded sample with a remediation outstanding: nudge
+            # the workqueue (the scraper-side half of the loop)
+            feedback.nudge("default", name)
+        if not st["degraded"]:
+            st["healthy_feeds"] += 1
+        if feedback is not None and name in self.straggler_targets \
+                and st["straggler_pending"] is None:
+            # the runner's gang-median evaluation, one window per member
+            # per log boundary: the slow member reports k-busting p50,
+            # every healthy member reports the median (healthy windows
+            # also reset streaks and drop a stale pending re-gang whose
+            # target was already replaced)
+            for pod in live:
+                _res, i = helper.extract_name_index(
+                    pod["metadata"]["name"])
+                slow = st["straggler"] is not None and \
+                    i == st["straggler"]
+                feedback.observe_straggler(
+                    "default", name, i,
+                    STRAGGLER_P50 if slow else STRAGGLER_MEDIAN,
+                    STRAGGLER_MEDIAN)
+        divisor = 1
+        if st["straggler"] is not None:
+            # the gang blocked on its slow member: overlap loss charged
+            # into the ledger's straggler bucket
+            ledger.charge("default", name, "straggler",
+                          STRAGGLER_CHARGE_S)
+            divisor = max(divisor, STRAGGLER_DIVISOR)
+        if st["degraded"]:
+            divisor = max(divisor, DEGRADED_DIVISOR)
+        return divisor
 
     def run(self) -> int:
         """Execute to quiescence (or the horizon); returns ticks used."""
@@ -262,6 +423,8 @@ class TenantFleetRun:
             sim_changed = self.h.sim.step()
             self.pod_chaos.tick()
             self._account(tick)
+            # one deterministic obs-ledger second per harness tick
+            self.clock.advance(1.0)
             queues_empty = all(
                 len(c.queue) == 0 and c.queue.pending_deferred == 0
                 for c in self.h.manager.controllers)
@@ -294,6 +457,12 @@ class TenantFleetRun:
             reward += (st["chips"] * weight
                        * (self.plan.horizon - st["completed"]))
         return reward
+
+    def fleet_ratio(self) -> float:
+        """The ledger's fleet goodput ratio — productive seconds over
+        attributed wall clock across every job, on the tick clock. The
+        number the feedback-vs-static invariant compares."""
+        return float(self.h.job_metrics.ledger.fleet_snapshot()["ratio"])
 
     def job_states(self) -> Dict[str, dict]:
         out = {}
@@ -347,6 +516,47 @@ class TenantFleetRun:
                          "strictly higher-priority beneficiary (%s)"
                          % (entry["victim"], entry["victim_priority"],
                             top))
+        if self.mode == "fair":
+            v.extend(self._check_feedback_invariants())
+        return v
+
+    def _check_feedback_invariants(self) -> List[str]:
+        """The observe->decide loop really closed (fair mode only): the
+        degraded job was re-scheduled (budget-FREE) and healed, and the
+        persistent straggler's member was re-ganged."""
+        v: List[str] = []
+        feedback = self.h.arbiter.feedback if self.h.arbiter else None
+        counts = feedback.counts() if feedback is not None else {}
+        for name in sorted(self.degrade_targets):
+            st = self.jobs[name]
+            if st["degraded"] or st["degrade_pending"]:
+                v.append("job %s still degraded at quiescence — the "
+                         "feedback loop never remediated it" % name)
+            try:
+                job = self.h.get_job(name)
+            except NotFoundError:
+                continue
+            sp = int(job.status.get("schedPreemptions") or 0)
+            pr = int(job.status.get("preemptionRestarts") or 0)
+            if st["hard_kills"] == 0 and sp < 1:
+                v.append("degraded job %s was never budget-free "
+                         "re-scheduled (schedPreemptions=%d)"
+                         % (name, sp))
+            if st["hard_kills"] == 0 and pr != 0:
+                v.append("remediation of %s spent the preemption budget "
+                         "(preemptionRestarts=%d) — it must book "
+                         "schedPreemptions only" % (name, pr))
+        for name in sorted(self.straggler_targets):
+            st = self.jobs[name]
+            if st["straggler"] is not None:
+                v.append("job %s still taxed by its straggler member at "
+                         "quiescence — no re-gang happened" % name)
+        if self.degrade_targets and counts.get("remediate", 0) < 1:
+            v.append("backend degradation injected but the feedback "
+                     "loop recorded no remediate decision (%r)" % counts)
+        if self.straggler_targets and counts.get("regang", 0) < 1:
+            v.append("persistent straggler injected but the feedback "
+                     "loop recorded no regang decision (%r)" % counts)
         return v
 
     def close(self) -> None:
@@ -355,12 +565,16 @@ class TenantFleetRun:
 
 def run_tenant_scenario(plan: ChaosPlan) -> ChaosReport:
     """The ``multi_tenant`` entry point for chaos.harness.run_scenario:
-    the arbitrated run (audited) plus the naive-FIFO baseline replay for
-    the goodput comparison."""
+    the goodput-aware arbitrated run (audited), the STATIC-arbiter
+    replay (the same fair arbiter without the feedback loop — the fleet
+    goodput-ratio comparison the ISSUE-11 tentpole is proven on), and
+    the naive-FIFO baseline replay (the PR 6 goodput comparison)."""
     t0 = time.perf_counter()
     fair = TenantFleetRun(plan, mode="fair")
     ticks = fair.run()
     violations = fair.check_invariants()
+    static = TenantFleetRun(plan, mode="static")
+    static.run()
     fifo = TenantFleetRun(plan, mode="fifo")
     fifo.run()
     goodput, fifo_goodput = fair.goodput(), fifo.goodput()
@@ -368,10 +582,19 @@ def run_tenant_scenario(plan: ChaosPlan) -> ChaosReport:
         violations.append(
             "arbiter goodput %d does not beat the naive-FIFO baseline %d"
             % (goodput, fifo_goodput))
+    ratio, static_ratio = fair.fleet_ratio(), static.fleet_ratio()
+    if ratio <= static_ratio:
+        violations.append(
+            "feedback fleet goodput ratio %.4f does not strictly beat "
+            "the static-arbiter replay %.4f" % (ratio, static_ratio))
     arbiter = fair.h.arbiter
+    feedback = arbiter.feedback if arbiter is not None else None
+    fb_counts = feedback.counts() if feedback is not None else {}
     extra = {
         "goodput": goodput,
         "fifo_goodput": fifo_goodput,
+        "fleet_goodput_ratio": round(ratio, 4),
+        "static_goodput_ratio": round(static_ratio, 4),
         "fifo_completed": sum(
             1 for st in fifo.jobs.values() if st["completed"] is not None),
         "evictions": sum(1 for e in (arbiter.decision_log if arbiter
@@ -380,11 +603,14 @@ def run_tenant_scenario(plan: ChaosPlan) -> ChaosReport:
                                    else []) if e["action"] == "shrink"),
         "max_allocated_chips": fair.max_allocated,
     }
+    for action, n in sorted(fb_counts.items()):
+        extra["feedback_%s" % action] = n
     jobs = fair.job_states()
     converged = all(st["completed"] is not None
                     for st in fair.jobs.values())
     faults = dict(fair.injector.counts)
     fair.close()
+    static.close()
     fifo.close()
     return ChaosReport(plan.scenario, plan.seed, converged, ticks, faults,
                        jobs, violations, time.perf_counter() - t0,

@@ -202,6 +202,13 @@ class TpuJobReconciler:
                 self._sched_queued.pop((namespace, name), None)
                 self._preempt_handled.pop((namespace, name), None)
             self.obs.forget_job(namespace, name)
+            if self.arbiter is not None:
+                try:
+                    # per-job decision counters / own-write ledger /
+                    # feedback state: bounded across job churn
+                    self.arbiter.forget_job(namespace, name)
+                except Exception as e:
+                    log.error("fleet arbiter forget failed: %s", e)
             return Result()
         job = api.TpuJob(obj)
 
@@ -254,6 +261,17 @@ class TpuJobReconciler:
         # job does not even claim a PodGroup.
         if self.arbiter is not None:
             gate = self._sched_gate(job)
+            if gate is not None:
+                return gate
+
+        # -- feedback remediation (sched/feedback.py) -------------------
+        # The observe->decide loop acting: a persistent straggler gets
+        # its slow member evicted and re-ganged; a backend-degraded job
+        # (silent CPU-fallback) gets a budget-free re-schedule through
+        # the same graceful-drain path an arbiter eviction rides.
+        if (self.arbiter is not None
+                and getattr(self.arbiter, "feedback", None) is not None):
+            gate = self._feedback_remediation(job, child_pods)
             if gate is not None:
                 return gate
 
@@ -527,6 +545,69 @@ class TpuJobReconciler:
             self.recorder.event(job.obj, "Normal", "SchedQueued",
                                 decision.reason)
         return Result(requeue_after=decision.retry_after or 1.0)
+
+    def _feedback_remediation(self, job: api.TpuJob,
+                              child_pods: List[dict]) -> Optional[Result]:
+        """Apply a pending feedback decision to this job (sched/feedback
+        .py): evict-and-re-gang a persistently slow member (``regang``)
+        or drain the whole gang off a degraded backend (``remediate``).
+
+        Both ride the PR 5 graceful-drain path and are BUDGET-FREE: the
+        job is stamped with ANNOT_SCHED_EVICT first, so the drain books
+        ``status.schedPreemptions`` (a remediation must never push a
+        well-behaved job toward its restart budget). The decision is
+        only consumed (``commit_remediation`` — counter + sched_feedback
+        trace event) once the stamp persisted and the eviction is in
+        flight; a failed stamp leaves it pending for the next pass."""
+        fb = self.arbiter.feedback
+        if job.phase != api.Phase.RUNNING or job.elastic is None:
+            return None
+        action = fb.pending_remediation(job.namespace, job.name)
+        if action is None:
+            return None
+        live = [p for p in child_pods
+                if (p["metadata"].get("annotations") or {})
+                .get(api.ANNOT_RESOURCE) == api.RES_WORKER
+                and k8s.pod_phase(p) in ("Pending", "Running")
+                and not p["metadata"].get("deletionTimestamp")]
+        if not live:
+            return None  # mid-incident already; nothing to drain
+        targets = live
+        if action.get("action") == "regang":
+            targets = []
+            for pod in live:
+                _res, idx = helper.extract_name_index(
+                    pod["metadata"]["name"])
+                if idx == action.get("worker"):
+                    targets.append(pod)
+            if not targets:
+                # the slow member is already gone (recreating): leave
+                # the decision pending — a healthy detector window for
+                # the replacement clears it, acting on the new pod is
+                # exactly what persistence (M more windows) is for
+                return None
+        if not self.arbiter.stamp_evict(job.namespace, job.name):
+            return self._requeue_error((job.namespace, job.name))
+        fb.commit_remediation(job.namespace, job.name, action)
+        if action.get("action") == "regang":
+            reason, what = "SchedFeedbackRegang", (
+                "worker %s flagged as the gang straggler for %s "
+                "consecutive windows (p50 %s vs gang median %s): "
+                "evicting it for re-gang on a healthy host"
+                % (action.get("worker"), action.get("straggler_windows"),
+                   action.get("p50"), action.get("gang_median")))
+        else:
+            reason, what = "SchedFeedbackRemediate", (
+                "backend degradation detected (throughput collapse vs "
+                "the job's own baseline): draining the gang for a "
+                "budget-free re-schedule off the degraded backend")
+        self.recorder.event(
+            job.obj, "Normal", reason,
+            "%s; %d pod(s) draining gracefully (schedPreemptions are "
+            "budget-free)" % (what, len(targets)))
+        for pod in targets:
+            self.arbiter.evictor(pod, self.arbiter.drain_grace)
+        return Result(requeue=True)
 
     def _count_restart_durably(self, job: api.TpuJob, field: str) -> None:
         """Increment a restart counter with bounded retry and a fresh GET

@@ -301,12 +301,24 @@ def main(argv=None):
 
     arbiter = None
     if args.fleet_sched:
-        from .sched import FleetArbiter
+        from .sched import FeedbackController, FleetArbiter, feedback_enabled
 
+        # The observe->decide loop (sched/feedback.py): badput-predicted
+        # victim selection, straggler re-gang, degradation remediation,
+        # SLO-burn priority boosts. TPUJOB_SCHED_FEEDBACK=0 disables it
+        # (the arbiter falls back to the static PR 6 ordering); knobs
+        # ride TPUJOB_STRAGGLER_K / _STRAGGLER_WINDOWS / _SCHED_BOOST_CAP
+        # (docs/user-guide.md "Feedback loop"). The SLO evaluator is
+        # attached below, once --slo-spec is parsed.
+        feedback = None
+        if feedback_enabled():
+            feedback = FeedbackController.from_env(
+                ledger=job_metrics.ledger)
         # default evictor (graceful pod delete) + annotation-fed
         # checkpoint costs; everything it knows is recomputed from
         # cluster state, so restarts and failovers lose nothing
-        arbiter = FleetArbiter(cached_client, job_metrics=job_metrics)
+        arbiter = FleetArbiter(cached_client, job_metrics=job_metrics,
+                               feedback=feedback)
 
     reconciler = TpuJobReconciler(
         cached_client,
@@ -353,6 +365,12 @@ def main(argv=None):
     mgr.add_metrics_provider(job_metrics.metrics_block)
     if arbiter is not None:
         mgr.add_metrics_provider(arbiter.metrics_block)
+        if arbiter.feedback is not None:
+            # feedback decisions ride the incident (high) lane: a
+            # steadily-Running job emits no watch events, so an armed
+            # decision must enqueue the pass that applies it
+            arbiter.feedback.notify = \
+                lambda ns, name: ctrl.queue.add((ns, name), lane="high")
 
     # SLO burn-rate evaluation at scrape time (obs.slo): goodput +
     # time-to-running feeds, alerts as flight-recorder entries + Events
@@ -376,6 +394,12 @@ def main(argv=None):
                 "slo", spec.name, "slo_alert",
                 burn_fast=round(burn_fast, 3),
                 burn_slow=round(burn_slow, 3))
+            if arbiter is not None and arbiter.feedback is not None:
+                # burn-driven replanning: boosts are a plan input the
+                # rv/TTL cache cannot see — force the replan (episodic:
+                # bounded by the alert's re-arm hysteresis)
+                arbiter.invalidate()
+                mgr.enqueue_all()
             ref = {"kind": api.KIND, "apiVersion": api.API_VERSION,
                    "metadata": {"namespace": "slo", "name": spec.name}}
             try:
@@ -392,6 +416,10 @@ def main(argv=None):
             ("time_to_running", s)
             for s in job_metrics.pop_time_to_running_samples()])
         mgr.add_metrics_provider(slo.metrics_block)
+        if arbiter is not None and arbiter.feedback is not None:
+            # SLO-burn-driven replanning: burn_rates() feeds the bounded
+            # priority boost (docs/observability.md "Feedback loop")
+            arbiter.feedback.slo = slo
 
     Probes = probes_handler(cache, mgr, leader_elect=args.leader_elect,
                             standby_ready=args.standby_ready)

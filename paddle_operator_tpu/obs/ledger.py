@@ -78,6 +78,11 @@ BADPUT_CAUSES = (
 )
 GOODPUT = "goodput"
 
+#: the badput causes that make up a RECOVERY episode — what one more
+#: preemption of this job would re-pay (the badput predictor's feed,
+#: sched/feedback.py)
+RECOVERY_CAUSES = ("restore", "drain", "eviction", "compile")
+
 #: incident kinds -> the bucket the *next* non-running stretch is charged
 #: to (set by the reconciler hooks; "restore" is the default for a hard
 #: preemption with no richer evidence)
@@ -118,6 +123,10 @@ class GoodputLedger:
         self._buckets: Dict[str, Dict[str, float]] = {}
         # job key -> bucket the next non-running stretch belongs to
         self._pending: Dict[str, str] = {}
+        # job key -> completed incident episodes (note_incident openings):
+        # the badput predictor divides recovery badput by this to price
+        # "one more preemption of this job"
+        self._episodes: Dict[str, int] = {}
         # jobs that have reached Running at least once (first Pending
         # stretch is sched_wait; later ones are incident recovery)
         self._ran: set = set()
@@ -177,6 +186,7 @@ class GoodputLedger:
                 emit: List[dict] = []
             else:
                 self._pending[key] = cause
+                self._episodes[key] = self._episodes.get(key, 0) + 1
                 emit = self._enter_locked(key, cause)
         self._emit_segments(key, emit)
 
@@ -299,6 +309,39 @@ class GoodputLedger:
                     out[key] = snap["ratio"]
             return out
 
+    def recovery_stats(self, namespace: str, name: str) -> Dict[str, Any]:
+        """The badput predictor's feed (sched/feedback.py): what the
+        ledger knows about the cost of preempting this job *now* —
+        ``episodes``/``recovery_s`` cover COMPLETED incident episodes
+        only (count and total badput in the recovery causes), while
+        ``open_bucket``/``open_s`` describe the segment the job is in at
+        this instant: a job mid-restore or mid-compile-warmup has sunk
+        cost a preemption would make it re-pay. An in-progress episode
+        lives ONLY in the open fields — folding it into the average too
+        would double-count it. Cheap, read-only, never raises; all-zero
+        for a job the ledger has not seen."""
+        key = _job_key(namespace, name)
+        with self._lock:
+            buckets = self._buckets.get(key, {})
+            recovery = sum(buckets.get(c, 0.0) for c in RECOVERY_CAUSES)
+            episodes = self._episodes.get(key, 0)
+            cur = self._state.get(key)
+            open_bucket: Optional[str] = None
+            open_s = 0.0
+            if cur is not None:
+                open_bucket, since = cur
+                now = self._clock()
+                if now > since:
+                    open_s = now - since
+            if open_bucket in RECOVERY_CAUSES:
+                # the banked totals above never include the open
+                # segment (it banks only at a real transition), so the
+                # in-progress episode just comes off the COUNT — its
+                # time is reported solely as open_s
+                episodes = max(0, episodes - 1)
+            return {"episodes": episodes, "recovery_s": recovery,
+                    "open_bucket": open_bucket, "open_s": open_s}
+
     def job_count(self) -> int:
         """Jobs with live ledger series (churn-boundedness checks)."""
         with self._lock:
@@ -313,6 +356,7 @@ class GoodputLedger:
             self._state.pop(key, None)
             self._buckets.pop(key, None)
             self._pending.pop(key, None)
+            self._episodes.pop(key, None)
             self._ran.discard(key)
             self._finished.discard(key)
             self._first.pop(key, None)

@@ -5,8 +5,11 @@ reconciler.
 * :mod:`.fairshare` — priority tiers + DRF-style weighted fair share.
 * :mod:`.arbiter` — :class:`FleetArbiter`: admission, shrink-before-evict,
   checkpoint-cost-aware preemption through the graceful-drain path.
+* :mod:`.feedback` — the observe→decide loop: badput-predicted victim
+  selection, straggler re-gang / degradation remediation, SLO-burn boost.
 
-See docs/design.md "Fleet scheduling & multi-tenancy".
+See docs/design.md "Fleet scheduling & multi-tenancy" and
+docs/observability.md "Feedback loop".
 """
 
 from .arbiter import (  # noqa: F401
@@ -17,6 +20,10 @@ from .arbiter import (  # noqa: F401
 from .capacity import (  # noqa: F401
     FleetCapacity, FleetSnapshot, job_chip_demand, make_tpu_node,
 )
+from .feedback import (  # noqa: F401
+    FEEDBACK_ACTIONS, BadputPredictor, FeedbackController,
+    feedback_enabled,
+)
 from .fairshare import (  # noqa: F401
     ANNOT_ARRIVAL, ANNOT_TENANT_WEIGHT, PREEMPTION_POLICIES,
     PRIORITY_CLASSES, ShareTable, effective_priority, fair_order,
@@ -26,9 +33,11 @@ from .fairshare import (  # noqa: F401
 __all__ = [
     "ANNOT_ARRIVAL", "ANNOT_CKPT_STEP", "ANNOT_PROGRESS_STEP",
     "ANNOT_RESTORE_NP", "ANNOT_SCHED_EVICT", "ANNOT_TENANT_WEIGHT",
-    "Decision", "FleetArbiter", "FleetCapacity", "FleetSnapshot",
-    "PREEMPTION_POLICIES", "PRIORITY_CLASSES", "ShareTable",
-    "annotation_ckpt_info", "checkpoint_staleness", "effective_priority",
-    "fair_order", "job_chip_demand", "make_tpu_node", "preemption_policy",
-    "tenant_of", "tenant_weight",
+    "BadputPredictor", "Decision", "FEEDBACK_ACTIONS",
+    "FeedbackController", "FleetArbiter", "FleetCapacity",
+    "FleetSnapshot", "PREEMPTION_POLICIES", "PRIORITY_CLASSES",
+    "ShareTable", "annotation_ckpt_info", "checkpoint_staleness",
+    "effective_priority", "fair_order", "feedback_enabled",
+    "job_chip_demand", "make_tpu_node", "preemption_policy", "tenant_of",
+    "tenant_weight",
 ]

@@ -39,8 +39,10 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import (Any, Callable, Deque, Dict, List, Optional, Set,
+                    Tuple)
 
 from ..api import types as api
 from ..controllers.helper import ANNOT_SCHED_EVICT, ANNOT_SCHED_RESTORE_NP
@@ -48,6 +50,7 @@ from ..k8s.errors import ApiError, ConflictError, NotFoundError
 from ..k8s.runtime import escape_label_value
 from ..utils.trace import tracer
 from .capacity import FleetCapacity, FleetSnapshot, job_chip_demand
+from .feedback import FeedbackController
 from .fairshare import (
     PREEMPT_NEVER, ShareTable, arrival_key, effective_priority, fair_order,
     preemption_policy, tenant_of, tenant_weight,
@@ -116,6 +119,9 @@ class _Target:
     priority: int
     ready: bool = True
     reason: str = ""
+    #: the badput prediction that ordered this victim (feedback mode):
+    #: carried so _evict can mirror the decision's inputs to trace
+    predicted: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -146,8 +152,14 @@ class FleetArbiter:
                  = annotation_ckpt_info,
                  decision_log_depth: int = 256,
                  replan_interval: float = 0.5,
-                 clock: Optional[Callable[[], float]] = None) -> None:
+                 clock: Optional[Callable[[], float]] = None,
+                 feedback: Optional[FeedbackController] = None) -> None:
         self.client = client
+        # the observe->decide loop (sched/feedback.py): badput-predicted
+        # victim ordering, SLO-burn priority boosts, and the remediation
+        # surface the reconciler consults. None = the PR 6 static
+        # arbiter (also the chaos baseline replay mode).
+        self.feedback = feedback
         self.capacity = FleetCapacity(client)
         # evictor(pod_dict, grace_seconds): production uses the eviction
         # API (here: a graceful delete); harnesses inject the pod-sim's
@@ -178,13 +190,24 @@ class FleetArbiter:
         self._preempts: Dict[str, int] = {}
         self._shrinks: Dict[str, int] = {}
         #: bounded, deterministic audit trail of preempt/shrink decisions
-        #: (the chaos invariants replay it); oldest entries drop first
-        self.decision_log: List[dict] = []
-        self._log_depth = decision_log_depth
+        #: (the chaos invariants replay it): a configurable ring —
+        #: oldest entries drop first, so 10k-job churn cannot grow it
+        self.decision_log: Deque[dict] = deque(
+            maxlen=max(1, int(decision_log_depth)))
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+
+    def invalidate(self) -> None:
+        """Drop the plan cache so the next gate consult replans even
+        though no cluster object changed. The SLO-burn alert path calls
+        this: a burn flips feedback priority boosts, which are a plan
+        INPUT the rv/TTL cache cannot see — without the invalidation a
+        boost could wait out an arbitrarily long quiet period."""
+        with self._lock:
+            self._plan_rv = None
+            self._plan_t = None
 
     def poke(self) -> None:
         """Replan (and act) if the cluster changed — called from passes
@@ -299,6 +322,10 @@ class FleetArbiter:
                 lines.append(
                     'tpujob_sched_shrink_decisions_total{job="%s"} %d'
                     % (esc(job), shrinks[job]))
+        if self.feedback is not None:
+            block = self.feedback.metrics_block()
+            if block:
+                lines.append(block)
         return "\n".join(lines)
 
     # ------------------------------------------------------------------
@@ -332,9 +359,35 @@ class FleetArbiter:
         self._plan_t = self._clock()
 
     def _log(self, entry: dict) -> None:
-        self.decision_log.append(entry)
-        if len(self.decision_log) > self._log_depth:
-            del self.decision_log[:len(self.decision_log) - self._log_depth]
+        self.decision_log.append(entry)  # deque ring: oldest drop first
+
+    def forget_job(self, namespace: str, name: str) -> None:
+        """Terminal-job GC (the reconciler's job-gone path): drop every
+        per-job arbiter series — decision counters, the own-write np
+        ledger, feedback state — so job churn cannot grow operator
+        memory. The decision_log ring needs no per-job cleanup."""
+        jkey = "%s/%s" % (namespace, name)
+        with self._lock:
+            self._preempts.pop(jkey, None)
+            self._shrinks.pop(jkey, None)
+            self._written_np.pop((namespace, name), None)
+        if self.feedback is not None:
+            self.feedback.forget_job(namespace, name)
+
+    def job_count(self) -> int:
+        """Jobs with live per-job arbiter series — decision counters and
+        the own-write np ledger (churn-boundedness checks)."""
+        with self._lock:
+            keys = {tuple(k.split("/", 1))
+                    for k in set(self._preempts) | set(self._shrinks)}
+            return len(keys | set(self._written_np))
+
+    def stamp_evict(self, namespace: str, name: str) -> bool:
+        """Public spelling of the eviction marker write — the feedback
+        remediation path (controllers/reconciler.py) stamps the victim
+        before draining so the incident books budget-FREE
+        (status.schedPreemptions), exactly like an arbiter eviction."""
+        return self._stamp_evict_annotation((namespace, name))
 
     def _jobs(self) -> List[api.TpuJob]:
         return [api.TpuJob(o) for o in self.client.list(api.KIND)]
@@ -424,14 +477,25 @@ class FleetArbiter:
             draining[key] = bool(pods) and all(
                 p["metadata"].get("deletionTimestamp") for p in pods)
             candidates.append(job)
+        # Effective priorities for this plan, computed ONCE per job: the
+        # SLO-burn feedback boost (bounded, hysteretic) rides on top of
+        # the static priority so a job burning its error budget bids for
+        # chips ahead of fair share. The memo keeps one plan internally
+        # consistent (ordering, protected_below, decision_log all see
+        # the same number).
+        prios: Dict[Tuple[str, str], int] = {}
+        for job in candidates:
+            prio = effective_priority(job)
+            if self.mode != "fifo" and self.feedback is not None:
+                prio += self.feedback.priority_boost(job)
+            prios[(job.namespace, job.name)] = prio
         if snap is None:
             # capacity unknown: admit everything (pre-arbiter behavior)
             for job in candidates:
                 key = (job.namespace, job.name)
                 np = self._desired_np(job)
                 plan.targets[key] = _Target(
-                    ADMIT, np, np, job_chip_demand(job, np),
-                    effective_priority(job))
+                    ADMIT, np, np, job_chip_demand(job, np), prios[key])
             return plan
         total_live = sum(live_chips.values()) + completing_live
         # Placement sanity for pinned slice shapes: a job whose topology
@@ -447,8 +511,7 @@ class FleetArbiter:
             per_slice = chips // job.tpu_num_slices()
             if job.tpu.get("topology") and per_slice > snap.slice_chips:
                 plan.targets[key] = _Target(
-                    QUEUE, 0, self._desired_np(job), chips,
-                    effective_priority(job),
+                    QUEUE, 0, self._desired_np(job), chips, prios[key],
                     reason="unplaceable: topology needs a %d-chip slice "
                            "but the largest pool has %d chips"
                            % (per_slice, snap.slice_chips))
@@ -459,7 +522,7 @@ class FleetArbiter:
             self._plan_fifo(plan, candidates, live_chips, total_live)
         else:
             self._plan_fair(plan, candidates, live_chips, draining,
-                            total_live)
+                            total_live, prios)
         # prune the own-write ledger to live arbitrated jobs so memory
         # stays bounded across job churn
         self._written_np = {k: v for k, v in self._written_np.items()
@@ -515,7 +578,8 @@ class FleetArbiter:
     def _plan_fair(self, plan: _Plan, candidates: List[api.TpuJob],
                    live_chips: Dict[Tuple[str, str], int],
                    draining: Dict[Tuple[str, str], bool],
-                   total_live: int) -> None:
+                   total_live: int,
+                   prios: Dict[Tuple[str, str], int]) -> None:
         fleet = plan.snapshot.fleet_chips
         remaining = fleet
         # Entries already in plan.targets here are unplaceable parks
@@ -544,8 +608,8 @@ class FleetArbiter:
                     and not draining.get(key)):
                 np = self._desired_np(job)
                 chips = job_chip_demand(job, np)
-                prio = effective_priority(job)
-                plan.targets[key] = _Target(ADMIT, np, np, chips, prio)
+                plan.targets[key] = _Target(ADMIT, np, np, chips,
+                                            prios[key])
                 remaining -= chips
                 plan.allocated_chips += chips
                 table.add(tenant_of(job), chips)
@@ -555,7 +619,8 @@ class FleetArbiter:
         for job in candidates:
             if (job.namespace, job.name) in rigid_keys:
                 continue
-            tiers.setdefault(effective_priority(job), []).append(job)
+            tiers.setdefault(prios[(job.namespace, job.name)],
+                             []).append(job)
 
         def protected_below(prio: int) -> int:
             """Chips running lower-priority (non-rigid) jobs are
@@ -570,7 +635,7 @@ class FleetArbiter:
                 okey = (other.namespace, other.name)
                 if okey in rigid_keys:
                     continue
-                if (effective_priority(other) < prio
+                if (prios[okey] < prio
                         and live_chips.get(okey, 0) > 0
                         and not draining.get(okey)):
                     onp = self._desired_np(other)
@@ -596,8 +661,29 @@ class FleetArbiter:
                        if live_chips.get((j.namespace, j.name), 0) > 0
                        and not draining.get((j.namespace, j.name))]
             queued = [j for j in tier if j not in running]
+
+            # Goodput-aware victim selection (sched/feedback.py):
+            # allocate costliest-first so the job squeezed out under
+            # pressure is the one whose preemption the ledger predicts
+            # to waste the LEAST fleet badput. Without feedback (or
+            # without ledger signal) this is exactly the PR 6 staleness
+            # ordering: freshest checkpoint = cheapest victim. ONE
+            # prediction per job per pass — the sort key, the
+            # decision_log entry, and the trace payload must all see
+            # the same snapshot.
+            victim: Dict[Tuple[str, str],
+                         Tuple[float, int, Optional[Dict[str, Any]]]] = {}
+            for j in running:
+                jkey = (j.namespace, j.name)
+                stale = checkpoint_staleness(j, self.ckpt_info)
+                if self.feedback is None:
+                    victim[jkey] = (float(stale), stale, None)
+                else:
+                    info = self.feedback.predict_info(j, stale)
+                    victim[jkey] = (float(info.get("cost_s", stale)),
+                                    stale, info)
             running.sort(key=lambda j: (
-                -checkpoint_staleness(j, self.ckpt_info), arrival_key(j)))
+                -victim[(j.namespace, j.name)][0], arrival_key(j)))
             for job in running:
                 key = (job.namespace, job.name)
                 np = self._desired_np(job)
@@ -613,7 +699,7 @@ class FleetArbiter:
                 guarantee_np = min(min_np, np) if min_np is not None \
                     else np
                 chips = guarantee_np * cph
-                staleness = checkpoint_staleness(job, self.ckpt_info)
+                _cost, staleness, predicted = victim[key]
                 if chips <= remaining:
                     state = ADMIT if guarantee_np == np else SHRINK
                     target = _Target(state, guarantee_np, np, chips, prio,
@@ -623,17 +709,22 @@ class FleetArbiter:
                     plan.targets[key] = _Target(
                         EVICT, 0, np, 0, prio,
                         reason="preempted for higher-priority work",
+                        predicted=predicted,
                     )
-                    self._log({"action": EVICT,
-                               "victim": "%s/%s" % key,
-                               "victim_priority": prio,
-                               "top_admitted_priority": top_admitted_prio,
-                               "staleness": staleness,
-                               # unshrinkable outright, or floor pinned
-                               # at full size: either way the job would
-                               # not yield chips short of eviction
-                               "refused_shrink": (min_np is None
-                                                  or min_np >= np)})
+                    entry = {"action": EVICT,
+                             "victim": "%s/%s" % key,
+                             "victim_priority": prio,
+                             "top_admitted_priority": top_admitted_prio,
+                             "staleness": staleness,
+                             # unshrinkable outright, or floor pinned
+                             # at full size: either way the job would
+                             # not yield chips short of eviction
+                             "refused_shrink": (min_np is None
+                                                or min_np >= np)}
+                    if predicted is not None:
+                        entry["predicted_badput_s"] = round(
+                            float(predicted.get("cost_s", 0.0)), 3)
+                    self._log(entry)
                     continue
                 realized.claim(target, live_chips.get(key, 0))
                 remaining -= target.chips
@@ -827,6 +918,11 @@ class FleetArbiter:
                                    priority=target.priority)
         tracer().event("sched_preempt", job=jkey, pods=len(fresh),
                        priority=target.priority)
+        if self.feedback is not None and target.predicted is not None:
+            # the goodput-aware victim pick was APPLIED: count it and
+            # mirror its inputs (sched_feedback action=victim)
+            self.feedback.record_victim(key[0], key[1], target.predicted,
+                                        target.priority)
         for pod in fresh:
             self.evictor(pod, self.drain_grace)
 

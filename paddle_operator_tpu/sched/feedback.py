@@ -1,0 +1,445 @@
+"""Feedback surface from the telemetry plane (obs/) into the arbiter.
+
+PR 10 made the fleet legible — per-second badput attribution, SLO burn
+rates, straggler and backend-degradation detectors — but nothing consumed
+the measurements: the arbiter decided on static priority/fair-share +
+checkpoint staleness alone, and the degradation detector's only output
+was a Warning Event. This module closes the observe→decide loop
+(*Singularity*, arXiv 2202.07848: transparent preemption + global
+optimization of utilization driven by live workload signals):
+
+* :class:`BadputPredictor` — from the ledger's per-job segment history,
+  price the fleet badput of preempting each candidate *now*: a job
+  mid-compile-warmup or mid-restore has sunk recovery cost a preemption
+  would make it re-pay, and a job with expensive past recovery episodes
+  will pay that again — the ledger knows both. With no ledger signal the
+  prediction degrades to the PR 6 checkpoint-staleness ordering (and it
+  NEVER blocks admission: prediction only orders victims).
+* **Straggler-triggered remediation** — when the PR 10 gang-median
+  detector flags the same member for ``straggler_windows`` (M)
+  consecutive windows, the reconciler evicts and re-gangs that member
+  (budget-free, through the PR 5 graceful-drain path) instead of letting
+  one slow host tax the whole slice.
+* **Degradation auto-remediation** — a job the ledger marks
+  ``backend_degraded`` (the silent CPU-fallback class) gets a budget-free
+  re-schedule instead of just a Warning; one remediation per degradation
+  episode (the detector re-arming on recovery is the hysteresis).
+* **SLO-burn-driven replanning** — :meth:`FeedbackController.
+  priority_boost` turns ``burn_rates()`` (built as "the arbiter/
+  autoscaler surface") into a bounded priority boost: a job burning the
+  goodput error budget bids for chips ahead of fair share, and the boost
+  latches until the fast window re-arms so it cannot flap.
+
+Every decision emits a structured ``sched_feedback`` trace event carrying
+its inputs (predicted badput, burn rates, straggler window) — the
+``obs_report --decisions`` lane reconstructs why each decision fired from
+trace alone — and bumps ``tpujob_sched_feedback_total{action=}``.
+
+See docs/observability.md "Feedback loop" for the signal → decision →
+hysteresis table and the knobs (k, M, boost cap, ``TPUJOB_SCHED_FEEDBACK``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import types as api
+from ..obs.ledger import RECOVERY_CAUSES
+from ..utils.trace import tracer
+
+#: the decision taxonomy exported as tpujob_sched_feedback_total{action=}
+FEEDBACK_ACTIONS = ("victim", "regang", "remediate", "boost")
+
+#: knob defaults (docs/user-guide.md "Feedback loop")
+STRAGGLER_K = 2.0        #: p50 > k x gang median counts as a flagged window
+STRAGGLER_WINDOWS = 3    #: M consecutive flagged windows before a re-gang
+BOOST_CAP = 1            #: bounded priority boost for budget-burning jobs
+BURN_THRESHOLD = 1.0     #: both burn windows must exceed this to boost
+BOOST_REARM = 0.5        #: boost drops once fast burn < rearm * threshold
+
+_JobKey = Tuple[str, str]
+
+
+def feedback_enabled() -> bool:
+    """The global disable switch: ``TPUJOB_SCHED_FEEDBACK=0`` turns the
+    whole feedback loop off (the arbiter falls back to the PR 6 static
+    ordering and nothing remediates)."""
+    return os.environ.get("TPUJOB_SCHED_FEEDBACK", "1") not in ("0", "false")
+
+
+class BadputPredictor:
+    """Price the fleet badput of preempting a job *now* from the goodput
+    ledger's per-job history.
+
+    ``predict()`` returns an info dict whose ``cost_s`` the arbiter
+    minimizes when it must pick victims:
+
+    * ``avg_recovery_s`` — mean badput seconds per past incident episode
+      (restore/drain/eviction/compile buckets over episode count): what
+      one more preemption historically costs this job;
+    * ``sunk_s`` — seconds of the CURRENT open recovery segment: a job
+      mid-restore or mid-compile-warmup re-pays everything it has sunk;
+    * ``staleness`` x ``staleness_weight`` — the PR 6 checkpoint-cost
+      component, so with no ledger signal the ordering degrades to
+      exactly the old staleness ordering (``signal`` stays False).
+
+    Read-only and never raises toward the arbiter: any ledger failure
+    falls back to the staleness-only cost, so prediction can order
+    victims but can never block admission.
+    """
+
+    def __init__(self, ledger: Any = None,
+                 staleness_weight: float = 1.0) -> None:
+        self.ledger = ledger
+        self.staleness_weight = float(staleness_weight)
+
+    def predict(self, namespace: str, name: str,
+                staleness: int = 0) -> Dict[str, Any]:
+        cost = self.staleness_weight * max(0, int(staleness))
+        info: Dict[str, Any] = {"staleness": int(staleness),
+                                "cost_s": cost, "signal": False}
+        if self.ledger is None:
+            return info
+        try:
+            stats = self.ledger.recovery_stats(namespace, name)
+        except Exception:
+            return info
+        episodes = int(stats.get("episodes") or 0)
+        if episodes > 0:
+            per = float(stats.get("recovery_s") or 0.0) / episodes
+            info["avg_recovery_s"] = per
+            info["episodes"] = episodes
+            info["signal"] = True
+            cost += per
+        if stats.get("open_bucket") in RECOVERY_CAUSES:
+            sunk = float(stats.get("open_s") or 0.0)
+            info["sunk_s"] = sunk
+            info["open_bucket"] = stats["open_bucket"]
+            info["signal"] = True
+            cost += sunk
+        info["cost_s"] = cost
+        return info
+
+
+class FeedbackController:
+    """The arbiter/reconciler-facing aggregation of the feedback signals.
+
+    Thread-safe; all mutable state under ``self._lock``; trace emission
+    happens outside it. The controller never acts itself — the arbiter
+    asks :meth:`evict_cost`/:meth:`priority_boost` while planning, and
+    the reconciler asks :meth:`pending_remediation` on its pass and
+    confirms what it actually did with :meth:`commit_remediation` (so a
+    decision that could not be applied stays pending instead of being
+    silently dropped).
+    """
+
+    def __init__(self, ledger: Any = None, slo: Any = None,
+                 predictor: Optional[BadputPredictor] = None,
+                 straggler_k: float = STRAGGLER_K,
+                 straggler_windows: int = STRAGGLER_WINDOWS,
+                 boost_cap: int = BOOST_CAP,
+                 burn_threshold: float = BURN_THRESHOLD,
+                 boost_rearm: float = BOOST_REARM,
+                 slo_objective: str = "goodput_ratio") -> None:
+        self.ledger = ledger
+        #: the SloEvaluator (settable after construction: the manager
+        #: builds the arbiter before it parses --slo-spec)
+        self.slo = slo
+        self.predictor = predictor if predictor is not None \
+            else BadputPredictor(ledger)
+        self.straggler_k = float(straggler_k)
+        self.straggler_windows = max(1, int(straggler_windows))
+        self.boost_cap = max(0, int(boost_cap))
+        self.burn_threshold = float(burn_threshold)
+        self.boost_rearm = float(boost_rearm)
+        self.slo_objective = slo_objective
+        #: notify(namespace, name): enqueue the job for a reconcile pass
+        #: NOW (wired to the controller workqueue's high lane by the
+        #: manager/harness). Without it a steadily-Running job — which
+        #: generates no watch events — would never get the pass that
+        #: applies a pending remediation.
+        self.notify: Optional[Any] = None
+        self._lock = threading.Lock()
+        # (ns, name) -> worker -> consecutive flagged windows
+        self._streaks: Dict[_JobKey, Dict[Any, int]] = {}
+        # (ns, name) -> pending re-gang action awaiting a reconcile pass
+        self._pending: Dict[_JobKey, Dict[str, Any]] = {}
+        # degradation episodes already remediated (job keys); cleared
+        # when the detector reports recovery, which re-arms the episode
+        self._remediated: set = set()
+        # job key -> active priority boost (hysteresis latch)
+        self._boosted: Dict[str, int] = {}
+        self._counts: Dict[str, int] = {}
+        # job key -> action -> decisions COMMITTED for that job (what
+        # actually happened, not what was pending); tests and the chaos
+        # model key healing on these
+        self._commits: Dict[str, Dict[str, int]] = {}
+
+    @classmethod
+    def from_env(cls, ledger: Any = None, slo: Any = None
+                 ) -> "FeedbackController":
+        """Production wiring: knobs from the environment
+        (``TPUJOB_STRAGGLER_K`` / ``TPUJOB_STRAGGLER_WINDOWS`` /
+        ``TPUJOB_SCHED_BOOST_CAP``; see docs/user-guide.md)."""
+        def _f(var: str, default: float) -> float:
+            try:
+                return float(os.environ.get(var, ""))
+            except ValueError:
+                return default
+
+        return cls(ledger=ledger, slo=slo,
+                   straggler_k=_f("TPUJOB_STRAGGLER_K", STRAGGLER_K),
+                   straggler_windows=int(_f("TPUJOB_STRAGGLER_WINDOWS",
+                                            STRAGGLER_WINDOWS)),
+                   boost_cap=int(_f("TPUJOB_SCHED_BOOST_CAP", BOOST_CAP)))
+
+    # -- victim selection (arbiter planning) -----------------------------
+
+    def evict_cost(self, job: api.TpuJob, staleness: int = 0) -> float:
+        """Predicted fleet badput (seconds-ish) of preempting this job
+        now — the arbiter allocates running jobs COSTLIEST-first so the
+        job squeezed out is always the cheapest victim. Never raises."""
+        try:
+            return float(self.predictor.predict(
+                job.namespace, job.name, staleness)["cost_s"])
+        except Exception:
+            return float(max(0, int(staleness)))
+
+    def predict_info(self, job: api.TpuJob,
+                     staleness: int = 0) -> Dict[str, Any]:
+        """The full prediction (decision_log / trace payload)."""
+        try:
+            return self.predictor.predict(job.namespace, job.name,
+                                          staleness)
+        except Exception:
+            return {"staleness": int(staleness),
+                    "cost_s": float(max(0, int(staleness))),
+                    "signal": False}
+
+    def record_victim(self, namespace: str, name: str,
+                      predicted: Dict[str, Any], priority: int) -> None:
+        """An eviction the predictor ordered was actually applied
+        (arbiter ``_evict``): count it and mirror the inputs to trace."""
+        jkey = "%s/%s" % (namespace, name)
+        with self._lock:
+            self._counts["victim"] = self._counts.get("victim", 0) + 1
+        tracer().event(
+            "sched_feedback", action="victim", job=jkey,
+            predicted_badput_s=round(float(predicted.get("cost_s", 0.0)),
+                                     3),
+            staleness=int(predicted.get("staleness", 0)),
+            signal=bool(predicted.get("signal", False)),
+            priority=priority)
+
+    # -- straggler-triggered re-gang --------------------------------------
+
+    def observe_straggler(self, namespace: str, name: str, worker: Any,
+                          p50: float, gang_median: float) -> bool:
+        """One detector window for one gang member (the runner's
+        gang-median evaluation at a log boundary; harnesses feed it
+        directly). ``straggler_windows`` CONSECUTIVE flagged windows arm
+        a re-gang of that member; any healthy window resets the streak,
+        and firing resets it too, so a replacement that is still slow
+        needs M fresh windows before the next re-gang (no flapping).
+        Returns True when a re-gang was armed by this observation."""
+        flagged = (gang_median > 0.0
+                   and float(p50) > self.straggler_k * float(gang_median))
+        key = (namespace, name)
+        with self._lock:
+            streaks = self._streaks.setdefault(key, {})
+            if not flagged:
+                streaks.pop(worker, None)
+                pending = self._pending.get(key)
+                if pending is not None and pending.get("worker") == worker:
+                    # the member recovered on its own before any pass
+                    # acted: a re-gang now would churn a healthy gang
+                    del self._pending[key]
+                if not streaks:
+                    self._streaks.pop(key, None)
+                return False
+            n = streaks.get(worker, 0) + 1
+            streaks[worker] = n
+            if n < self.straggler_windows or key in self._pending:
+                return False
+            streaks[worker] = 0
+            self._pending[key] = {
+                "action": "regang", "worker": worker,
+                "straggler_windows": n,
+                "p50": round(float(p50), 6),
+                "gang_median": round(float(gang_median), 6),
+            }
+        self._notify(namespace, name)
+        return True
+
+    def _notify(self, namespace: str, name: str) -> None:
+        cb = self.notify
+        if cb is None:
+            return
+        try:
+            cb(namespace, name)
+        except Exception:
+            pass  # a failed enqueue nudge must never take a feed down
+
+    def nudge(self, namespace: str, name: str) -> None:
+        """Ask for a reconcile pass if this job has a remediation
+        outstanding — the throughput feeder calls this on degraded
+        samples (the workqueue dedups, so repeated nudges are free)."""
+        if self.pending_remediation(namespace, name) is not None:
+            self._notify(namespace, name)
+
+    # -- remediation surface (reconciler gate) ----------------------------
+
+    def pending_remediation(self, namespace: str,
+                            name: str) -> Optional[Dict[str, Any]]:
+        """Peek the next remediation the reconciler should apply to this
+        job: a pending straggler re-gang, else a degradation re-schedule
+        (once per detector episode). Returns a copy; the caller confirms
+        with :meth:`commit_remediation` once it has actually acted."""
+        key = (namespace, name)
+        with self._lock:
+            act = self._pending.get(key)
+            if act is not None:
+                return dict(act)
+        if self.ledger is None:
+            return None
+        jkey = "%s/%s" % (namespace, name)
+        try:
+            degraded = jkey in self.ledger.degraded_jobs()
+        except Exception:
+            return None
+        with self._lock:
+            if not degraded:
+                # episode over (detector recovered): re-arm
+                self._remediated.discard(jkey)
+                return None
+            if jkey in self._remediated:
+                return None  # one re-schedule per degradation episode
+        return {"action": "remediate", "degraded": True}
+
+    def commit_remediation(self, namespace: str, name: str,
+                           action: Dict[str, Any]) -> None:
+        """The reconciler applied ``action`` (victim gang/member stamped
+        and draining): consume it, count it, and mirror the decision +
+        its inputs to trace."""
+        key = (namespace, name)
+        jkey = "%s/%s" % (namespace, name)
+        kind = action.get("action", "remediate")
+        with self._lock:
+            if kind == "regang":
+                cur = self._pending.get(key)
+                if cur is not None and cur.get("worker") == \
+                        action.get("worker"):
+                    del self._pending[key]
+            else:
+                self._remediated.add(jkey)
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            per = self._commits.setdefault(jkey, {})
+            per[kind] = per.get(kind, 0) + 1
+        attrs: Dict[str, Any] = {"action": kind, "job": jkey}
+        for k in ("worker", "straggler_windows", "p50", "gang_median",
+                  "degraded"):
+            if k in action:
+                attrs[k] = action[k]
+        tracer().event("sched_feedback", **attrs)
+
+    # -- SLO-burn-driven priority boost -----------------------------------
+
+    def priority_boost(self, job: api.TpuJob) -> int:
+        """Bounded priority boost for a job burning the goodput error
+        budget: applied while BOTH burn windows of the goodput SLO are
+        hot AND this job's own ratio is below target; once latched it
+        holds until the fast window re-arms (< ``boost_rearm`` x
+        threshold) or the job's ratio recovers — the hysteresis that
+        keeps the boost from flapping a job in and out of a tier."""
+        if self.boost_cap <= 0 or self.slo is None or self.ledger is None:
+            return 0
+        jkey = "%s/%s" % (job.namespace, job.name)
+        try:
+            spec = next((s for s in self.slo.specs
+                         if s.objective == self.slo_objective), None)
+            if spec is None:
+                return 0
+            burns = self.slo.burn_rates()
+            fast = burns.get((spec.name, "fast"), 0.0)
+            slow = burns.get((spec.name, "slow"), 0.0)
+            ratio = self.ledger.job_ratios().get(jkey)
+        except Exception:
+            return 0
+        job_bad = ratio is not None and not spec.is_good(ratio)
+        fired: Optional[int] = None
+        with self._lock:
+            active = self._boosted.get(jkey)
+            if active is not None:
+                if fast < self.boost_rearm * self.burn_threshold \
+                        or not job_bad:
+                    del self._boosted[jkey]
+                    return 0
+                return active
+            if (fast >= self.burn_threshold
+                    and slow >= self.burn_threshold and job_bad):
+                fired = self.boost_cap
+                self._boosted[jkey] = fired
+                self._counts["boost"] = self._counts.get("boost", 0) + 1
+        if fired is None:
+            return 0
+        tracer().event("sched_feedback", action="boost", job=jkey,
+                       boost=fired, burn_fast=round(fast, 3),
+                       burn_slow=round(slow, 3),
+                       goodput_ratio=round(ratio, 4)
+                       if ratio is not None else None)
+        return fired
+
+    # -- lifecycle / exposition -------------------------------------------
+
+    def forget_job(self, namespace: str, name: str) -> None:
+        """Terminal-job GC (called from the arbiter's forget path): drop
+        every per-job series so job churn cannot grow feedback memory."""
+        key = (namespace, name)
+        jkey = "%s/%s" % (namespace, name)
+        with self._lock:
+            self._streaks.pop(key, None)
+            self._pending.pop(key, None)
+            self._remediated.discard(jkey)
+            self._boosted.pop(jkey, None)
+            self._commits.pop(jkey, None)
+
+    def counts(self) -> Dict[str, int]:
+        """Decisions applied so far, by action (the chaos invariants and
+        tests read this; the exposition is :meth:`metrics_block`)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def commits(self, namespace: str, name: str) -> Dict[str, int]:
+        """Remediation decisions COMMITTED against one job, by action."""
+        with self._lock:
+            return dict(self._commits.get("%s/%s" % (namespace, name),
+                                          {}))
+
+    def job_count(self) -> int:
+        """Jobs with live feedback state (churn-boundedness checks)."""
+        with self._lock:
+            keys = set(self._streaks) | set(self._pending)
+            jkeys = (set(self._boosted) | set(self._remediated)
+                     | set(self._commits))
+            return len(keys | {tuple(k.split("/", 1)) for k in jkeys})
+
+    def metrics_block(self) -> str:
+        """Text-exposition lines (no trailing newline); merged into the
+        arbiter's provider block."""
+        with self._lock:
+            counts = dict(self._counts)
+        if not counts:
+            return ""
+        lines = [
+            "# HELP tpujob_sched_feedback_total Feedback-loop decisions "
+            "applied (the observe->decide loop), by action.",
+            "# TYPE tpujob_sched_feedback_total counter",
+        ]
+        for action in FEEDBACK_ACTIONS:
+            if action in counts:
+                lines.append(
+                    'tpujob_sched_feedback_total{action="%s"} %d'
+                    % (action, counts[action]))
+        return "\n".join(lines)

@@ -162,6 +162,15 @@ class OperatorHarness:
             lane_for=helper.event_lane,
         )
         self.controller.backoff_provider = self.reconciler.current_backoff
+        fb = getattr(self.arbiter, "feedback", None) \
+            if self.arbiter is not None else None
+        if fb is not None:
+            # feedback decisions ride the incident (high) lane: a
+            # steadily-Running job emits no watch events, so the armed
+            # decision must enqueue the pass that applies it
+            queue = self.controller.queue
+            fb.notify = lambda ns, name: queue.add((ns, name),
+                                                   lane="high")
         # Under TPUJOB_RACE_DETECT (make race) declare the shared fields
         # the PR 2/3 incidents were about: every access must hold the
         # owning lock or the session fails (happens-before checker —
@@ -180,8 +189,8 @@ class OperatorHarness:
             # attribution class of bug the conservation invariant exists
             # to catch
             racedetect.guard_fields(self.job_metrics.ledger, "_lock", [
-                "_state", "_buckets", "_pending", "_ran", "_finished",
-                "_first", "_last", "_tput", "_degraded",
+                "_state", "_buckets", "_pending", "_episodes", "_ran",
+                "_finished", "_first", "_last", "_tput", "_degraded",
                 "_degraded_total"])
             if self.slo is not None:
                 racedetect.guard_fields(self.slo, "_lock", [
@@ -193,6 +202,15 @@ class OperatorHarness:
                 racedetect.guard_fields(self.arbiter, "_lock", [
                     "_plan", "_plan_rv", "_plan_t", "_passes",
                     "_preempts", "_shrinks", "_written_np"])
+                fb = getattr(self.arbiter, "feedback", None)
+                if fb is not None:
+                    # the feedback loop's whole decision state is
+                    # lock-owned: an unlocked touch of a streak table or
+                    # the pending-action map is exactly the lost/double-
+                    # remediation class of bug
+                    racedetect.guard_fields(fb, "_lock", [
+                        "_streaks", "_pending", "_remediated",
+                        "_boosted", "_counts", "_commits"])
             racedetect.guard_fields(self.reconciler, "_err_lock",
                                     ["_err_streak", "_err_hit"])
             racedetect.guard_fields(self.reconciler, "_warn_lock",
@@ -216,10 +234,18 @@ class OperatorHarness:
     def _slo_alert(self, spec, burn_fast, burn_slow, message) -> None:
         """An SLO's fast+slow burn windows both exceeded threshold:
         surface it as a flight-recorder entry (ring key ``slo/<name>``)
-        and a Warning Event, the same channels incidents use."""
+        and a Warning Event, the same channels incidents use — and when
+        the feedback loop is wired, force a fleet replan so the burn-
+        driven priority boosts take effect without waiting for cluster
+        churn (alerts are episodic, so the full-fleet re-enqueue is
+        bounded by the burn hysteresis)."""
         self.job_metrics.flight.record(
             "slo", spec.name, "slo_alert",
             burn_fast=round(burn_fast, 3), burn_slow=round(burn_slow, 3))
+        if self.arbiter is not None and \
+                getattr(self.arbiter, "feedback", None) is not None:
+            self.arbiter.invalidate()
+            self.manager.enqueue_all()
         ref = {"kind": api.KIND, "apiVersion": api.API_VERSION,
                "metadata": {"namespace": "slo", "name": spec.name}}
         try:

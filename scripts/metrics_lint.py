@@ -41,16 +41,20 @@ def selftest_text() -> str:
     families (fleet gauges + preempt/shrink decision counters)."""
     from paddle_operator_tpu.api import types as api
     from paddle_operator_tpu.chaos.api_faults import FaultInjector
-    from paddle_operator_tpu.sched import FleetArbiter, make_tpu_node
+    from paddle_operator_tpu.sched import (
+        FeedbackController, FleetArbiter, make_tpu_node)
     from paddle_operator_tpu.testing import OperatorHarness
 
     # lint-tpu reports a stale checkpoint so it is served (shrunk)
     # first; checkpoint-less lint-low2 counts as freshest and is the
-    # one squeezed out — the documented victim ranking
+    # one squeezed out — the documented victim ranking. The feedback
+    # loop is wired (ISSUE 11) so the degradation drive below exercises
+    # a REAL budget-free remediation and its counter family.
     ckpt = {"lint-tpu": {"progress": 100, "step": 0}}
     h = OperatorHarness(
         arbiter_factory=lambda c, m: FleetArbiter(
-            c, job_metrics=m, ckpt_info=lambda j: ckpt.get(j.name)))
+            c, job_metrics=m, ckpt_info=lambda j: ckpt.get(j.name),
+            feedback=FeedbackController(ledger=m.ledger)))
     injector = FaultInjector()
     injector.record("api_error")
     h.manager.add_metrics_provider(injector.metrics_block)
@@ -98,6 +102,11 @@ def selftest_text() -> str:
         h.job_metrics.ledger.observe_throughput("default", "lint-tpu",
                                                 1000.0)
     h.job_metrics.ledger.observe_throughput("default", "lint-tpu", 0.4)
+    # ... and the feedback loop ACTS on the collapse: the next converge
+    # runs the budget-free re-schedule, populating the sched_feedback
+    # decision counter the same way production would
+    h.arbiter.feedback.nudge("default", "lint-tpu")
+    h.converge()
     text = h.manager.metrics_text()
     # the coverage this selftest claims must actually be in the text —
     # a scenario drift that stops exercising these emitters should fail
@@ -117,11 +126,15 @@ def selftest_text() -> str:
                 "tpujob_badput_seconds_total",
                 "tpujob_fleet_goodput_ratio",
                 "tpujob_backend_degraded_total",
-                "tpujob_slo_burn_rate"):
+                "tpujob_slo_burn_rate",
+                # the observe->decide loop (ISSUE 11)
+                "tpujob_sched_feedback_total"):
         assert "# TYPE %s" % fam in text, "selftest lost %s" % fam
     assert 'tenant="evil' in text, "adversarial tenant label missing"
     assert 'outcome="done"' in text, "reconcile histogram lost its outcomes"
     assert 'cause="data_stall"' in text, "ledger badput cause missing"
+    assert 'tpujob_sched_feedback_total{action="remediate"} 1' in text, \
+        "the degradation remediation did not fire"
     h.close()
     return text
 

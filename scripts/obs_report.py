@@ -307,6 +307,94 @@ def render_waterfall(jkey: str, buckets: Dict[str, float]) -> str:
     return "\n".join(lines)
 
 
+#: the inputs each sched_feedback action must carry for the decision to
+#: be reconstructable from trace alone (ISSUE 11 acceptance): a decision
+#: event missing its inputs fails the --decisions lane
+DECISION_INPUTS = {
+    "victim": ("predicted_badput_s", "staleness"),
+    "regang": ("worker", "straggler_windows", "p50", "gang_median"),
+    "remediate": ("degraded",),
+    "boost": ("boost", "burn_fast", "burn_slow"),
+}
+
+
+def decision_entries(records: List[dict],
+                     job: Optional[str] = None) -> List[dict]:
+    """Every feedback-loop decision (``sched_feedback`` trace events),
+    in emission order, with its inputs."""
+    out = []
+    for rec in records:
+        if rec.get("name") != "sched_feedback":
+            continue
+        attrs = dict(rec.get("attrs") or {})
+        if not _matches(attrs.get("job"), job):
+            continue
+        attrs["t"] = rec.get("t0", 0.0)
+        out.append(attrs)
+    return out
+
+
+def decision_why(entry: dict) -> str:
+    """Reconstruct WHY the decision fired, from its trace inputs."""
+    action = entry.get("action")
+    if action == "victim":
+        return ("chosen as cheapest victim: predicted badput %.3fs "
+                "(checkpoint staleness %s, ledger signal=%s)"
+                % (float(entry.get("predicted_badput_s") or 0.0),
+                   entry.get("staleness"), entry.get("signal")))
+    if action == "regang":
+        return ("worker %s p50 %s > k x gang median %s for %s "
+                "consecutive windows -> evict + re-gang the member"
+                % (entry.get("worker"), entry.get("p50"),
+                   entry.get("gang_median"),
+                   entry.get("straggler_windows")))
+    if action == "remediate":
+        return ("backend degradation detected (throughput collapse vs "
+                "own baseline) -> budget-free re-schedule")
+    if action == "boost":
+        return ("goodput SLO burning (fast %.2f / slow %.2f) and job "
+                "below target -> priority boost +%s"
+                % (float(entry.get("burn_fast") or 0.0),
+                   float(entry.get("burn_slow") or 0.0),
+                   entry.get("boost")))
+    return "unknown action %r" % action
+
+
+def decision_violations(entries: List[dict]) -> List[str]:
+    """A decision whose inputs are missing is NOT reconstructable from
+    trace — the structured-event contract is broken."""
+    errs = []
+    for i, entry in enumerate(entries):
+        action = entry.get("action")
+        required = DECISION_INPUTS.get(action or "")
+        if required is None:
+            errs.append("decision %d: unknown action %r" % (i, action))
+            continue
+        if not entry.get("job"):
+            errs.append("decision %d (%s): no job attributed"
+                        % (i, action))
+        missing = [k for k in required if entry.get(k) is None]
+        if missing:
+            errs.append("decision %d (%s on %s): inputs missing from "
+                        "trace: %s" % (i, action, entry.get("job"),
+                                       ", ".join(missing)))
+    return errs
+
+
+def render_decisions(entries: List[dict]) -> str:
+    lines = ["Feedback decisions (reconstructed from trace alone)",
+             "---------------------------------------------------"]
+    if not entries:
+        lines.append("(no sched_feedback events in the trace)")
+        return "\n".join(lines)
+    t0 = entries[0].get("t", 0.0)
+    for entry in entries:
+        lines.append("%+9.3fs  %-9s %-22s %s"
+                     % (entry.get("t", 0.0) - t0, entry.get("action"),
+                        entry.get("job") or "-", decision_why(entry)))
+    return "\n".join(lines)
+
+
 def render_report(timeline: List[dict], metrics_text: str = "",
                   job: Optional[str] = None) -> str:
     lines = []
@@ -356,12 +444,54 @@ def render_report(timeline: List[dict], metrics_text: str = "",
 # ---------------------------------------------------------------------------
 
 def run_chaos(scenario: str, seed: int, verbose: bool) -> int:
-    """Run one chaos-harness scenario with tracing enabled, then report
-    each job's timeline from the trace + recorded events."""
+    """Run one chaos scenario with tracing enabled, then report each
+    job's timeline from the trace + recorded events. ``multi_tenant``
+    runs the fleet-scheduler harness and reports the feedback-decision
+    lane (every sched_feedback decision reconstructed from trace alone,
+    inputs validated — exit 1 when one is not reconstructable)."""
     import paddle_operator_tpu.utils.trace as trace_mod
     from paddle_operator_tpu.chaos.harness import ChaosHarness
     from paddle_operator_tpu.chaos.plan import CONTROL_SCENARIOS, build_plan
 
+    if scenario == "multi_tenant":
+        from paddle_operator_tpu.chaos import run_scenario
+
+        fd, trace_path = tempfile.mkstemp(prefix="obs-trace-",
+                                          suffix=".jsonl")
+        os.close(fd)
+        prev = trace_mod._global
+        trace_mod._global = trace_mod.Tracer(path=trace_path)
+        try:
+            report = run_scenario(scenario, seed, quick=True)
+        finally:
+            trace_mod.tracer().close()
+            trace_mod._global = prev
+            records = load_trace(trace_path)
+            os.unlink(trace_path)
+        print(report.summary_line())
+        print()
+        if report.violations:
+            # a green decisions lane over a broken loop would be a lie:
+            # the run's own invariants (remediation happened, feedback
+            # goodput ratio beat the static replay) gate it too
+            print("CHAOS INVARIANT VIOLATIONS:")
+            for v in report.violations:
+                print("  " + v)
+            return 1
+        entries = decision_entries(records)
+        print(render_decisions(entries))
+        errs = decision_violations(entries)
+        if errs:
+            print("DECISION RECONSTRUCTION VIOLATIONS:")
+            for e in errs:
+                print("  " + e)
+            return 1
+        if not entries:
+            print("(expected feedback decisions in a multi_tenant run)")
+            return 2
+        print("decision reconstruction: ok (%d decision(s))"
+              % len(entries))
+        return 0
     if scenario not in CONTROL_SCENARIOS:
         print("scenario %r is not a control-plane scenario (one of %s)"
               % (scenario, ", ".join(sorted(CONTROL_SCENARIOS))))
@@ -424,6 +554,12 @@ def main(argv=None) -> int:
                     help="also render per-job goodput waterfalls from "
                          "the trace's ledger events and re-check the "
                          "conservation invariant (exit 1 on violation)")
+    ap.add_argument("--decisions", action="store_true",
+                    help="also reconstruct every feedback-loop decision "
+                         "(sched_feedback events: victim / regang / "
+                         "remediate / boost) with its inputs from the "
+                         "trace alone (exit 1 when a decision is not "
+                         "reconstructable)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="include every reconcile span")
     args = ap.parse_args(argv)
@@ -446,6 +582,16 @@ def main(argv=None) -> int:
     timeline = build_timeline(records, events, job=args.job,
                               verbose=args.verbose)
     print(render_report(timeline, metrics_text=metrics, job=args.job))
+    if args.decisions:
+        entries = decision_entries(records, job=args.job)
+        print()
+        print(render_decisions(entries))
+        errs = decision_violations(entries)
+        if errs:
+            print("DECISION RECONSTRUCTION VIOLATIONS:")
+            for e in errs:
+                print("  " + e)
+            return 1
     if args.waterfall:
         buckets, totals = ledger_waterfall(records, job=args.job)
         for jkey in sorted(buckets):

@@ -1,0 +1,481 @@
+"""The observe->decide loop (sched/feedback.py, ISSUE 11): badput
+predictor cost ordering + no-signal fallback, straggler-triggered
+re-gang, backend-degradation auto-remediation (budget-free), the
+SLO-burn priority boost with hysteresis, decision trace reconstruction,
+and churn boundedness of the new arbiter/feedback state.
+"""
+
+import sys
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import helper
+from paddle_operator_tpu.obs import (
+    GoodputLedger, SloEvaluator, SloSpec, parse_exposition,
+)
+from paddle_operator_tpu.sched import (
+    BadputPredictor, FeedbackController, FleetArbiter, make_tpu_node,
+)
+from paddle_operator_tpu.testing import OperatorHarness
+from paddle_operator_tpu.utils import trace as trace_mod
+from paddle_operator_tpu.utils.trace import Tracer
+
+sys.path.insert(0, "scripts")  # tests/conftest.py puts repo root first
+from obs_report import (  # noqa: E402
+    decision_entries, decision_violations, load_trace,
+)
+
+CHIPS_PER_HOST = 8  # v5e default
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def tpu_job(name, hosts, cls="tpu-low", min_hosts=1, elastic=True):
+    tmpl = {"containers": [{"name": "main", "image": "img"}],
+            "priorityClassName": cls}
+    worker = {"replicas": hosts, "template": {"spec": tmpl}}
+    spec = {"device": "tpu", "tpu": {"accelerator": "v5e"},
+            "worker": worker}
+    if elastic:
+        spec["elastic"] = 1
+        worker["requests"] = min_hosts
+    return api.new_tpujob(name, spec=spec)
+
+
+class FeedbackHarness:
+    """OperatorHarness + Node fleet + arbiter WITH the feedback loop,
+    mirroring test_sched.FleetHarness."""
+
+    def __init__(self, pools=2, nodes_per_pool=4, chips=CHIPS_PER_HOST,
+                 slo_specs=None, metrics_clock=None, **fb_kwargs):
+        self.ckpt = {}
+        self.evictions = []
+        self.fb_kwargs = fb_kwargs
+        self.feedback = None
+        self.h = OperatorHarness(arbiter_factory=self._factory,
+                                 slo_specs=slo_specs,
+                                 metrics_clock=metrics_clock)
+        # the production wiring order: the SLO evaluator feeds the
+        # feedback boost surface once both exist
+        if self.feedback is not None:
+            self.feedback.slo = self.h.slo
+        for p in range(pools):
+            for n in range(nodes_per_pool):
+                self.h.client.create(make_tpu_node(
+                    "n%d-%d" % (p, n), "pool-%d" % p, chips))
+
+    def _factory(self, client, job_metrics):
+        self.feedback = FeedbackController(ledger=job_metrics.ledger,
+                                           **self.fb_kwargs)
+        return FleetArbiter(client, evictor=self._evict,
+                            job_metrics=job_metrics, drain_grace=2,
+                            ckpt_info=self._info, feedback=self.feedback)
+
+    def _info(self, job):
+        return self.ckpt.get(job.name)
+
+    def _evict(self, pod, grace):
+        name = pod["metadata"]["name"]
+        self.evictions.append(name)
+        self.h.sim.preempt(name, reason="Preempted", grace_seconds=grace)
+        owner = name.rsplit("-", 2)[0]
+        if owner in self.ckpt:
+            self.ckpt[owner]["step"] = self.ckpt[owner]["progress"]
+
+    def converge(self, ticks=40):
+        return self.h.converge(max_ticks=ticks)
+
+    def job(self, name):
+        return self.h.get_job(name)
+
+    def worker_pods(self, name):
+        obj = self.h.client.get(api.KIND, "default", name)
+        return sorted((p for p in self.h.client.list_owned("Pod", obj)
+                       if (p["metadata"].get("annotations") or {})
+                       .get(api.ANNOT_RESOURCE) == api.RES_WORKER),
+                      key=lambda p: p["metadata"]["name"])
+
+    def events(self, reason):
+        return [e for e in self.h.client.all_objects("Event")
+                if e.get("reason") == reason]
+
+
+# ---------------------------------------------------------------------------
+# BadputPredictor: replayed ledger fixtures pin the cost ordering
+# ---------------------------------------------------------------------------
+
+class TestBadputPredictor:
+    def _ledger(self):
+        clock = FakeClock()
+        return GoodputLedger(clock=clock), clock
+
+    def test_warmup_heavy_costs_more_than_steady_state(self):
+        """Replayed fixtures: a job with expensive recovery episodes and
+        currently mid-restore must predict costlier than a steady-state
+        job — preempting it re-pays everything it has sunk."""
+        led, clock = self._ledger()
+        # warmup-heavy: two restore episodes of 15s each, mid-restore now
+        led.observe_phase("d", "warm", "Running")
+        for _ in range(2):
+            clock.advance(5)
+            led.note_incident("d", "warm", "restore")
+            clock.advance(15)
+            led.observe_phase("d", "warm", "Running")
+        clock.advance(5)
+        led.note_incident("d", "warm", "restore")
+        clock.advance(4)  # 4s sunk into the open restore
+        # steady-state: same age, pure goodput
+        led.observe_phase("d", "steady", "Running")
+        pred = BadputPredictor(led)
+        warm = pred.predict("d", "warm")
+        steady = pred.predict("d", "steady")
+        assert warm["signal"] and not steady["signal"]
+        assert warm["cost_s"] > steady["cost_s"]
+        # 2 COMPLETED episodes of 15s each drive the average; the
+        # in-progress episode counts once, as sunk cost — never both
+        assert warm["episodes"] == 2
+        assert warm["avg_recovery_s"] == pytest.approx(15.0)
+        assert warm["sunk_s"] == pytest.approx(4.0)
+        assert warm["cost_s"] == pytest.approx(19.0)
+        assert warm["open_bucket"] == "restore"
+
+    def test_mid_compile_warmup_is_sunk_cost(self):
+        led, clock = self._ledger()
+        led.observe_phase("d", "j", "Running")
+        clock.advance(2)
+        led.note_incident("d", "j", "compile")
+        clock.advance(7)
+        got = BadputPredictor(led).predict("d", "j")
+        assert got["open_bucket"] == "compile"
+        assert got["cost_s"] >= 7.0 and got["signal"]
+
+    def test_no_signal_degrades_to_staleness_ordering(self):
+        """The PR 6 fallback: with no ledger history the cost is a
+        monotone function of checkpoint staleness alone — the ordering
+        the old arbiter used."""
+        led, _clock = self._ledger()
+        pred = BadputPredictor(led)
+        costs = [pred.predict("d", "job%d" % i, staleness=s)["cost_s"]
+                 for i, s in enumerate([0, 3, 11])]
+        assert costs == sorted(costs)
+        assert costs[0] == 0.0 and costs[2] == 11.0
+        assert not pred.predict("d", "ghost", staleness=5)["signal"]
+        # no ledger at all: same fallback, never raises
+        bare = BadputPredictor(None)
+        assert bare.predict("d", "x", staleness=7)["cost_s"] == 7.0
+
+    def test_broken_ledger_never_breaks_victim_costing(self):
+        class Broken:
+            def recovery_stats(self, ns, name):
+                raise RuntimeError("ledger down")
+
+        fb = FeedbackController(ledger=None,
+                                predictor=BadputPredictor(Broken()))
+        job = api.TpuJob(tpu_job("j", 1))
+        assert fb.evict_cost(job, staleness=9) == 9.0
+
+
+# ---------------------------------------------------------------------------
+# arbiter victim selection: predicted badput instead of (only) staleness
+# ---------------------------------------------------------------------------
+
+def test_victim_selection_minimizes_predicted_badput():
+    """Two running low-prio jobs, checkpoint staleness equal (the PR 6
+    signal is silent) — the ledger knows one is warmup-heavy. When a
+    whale forces an eviction, the STEADY job (cheapest predicted
+    badput) is the victim and the warmup-heavy one keeps its slot."""
+    f = FeedbackHarness(pools=2, nodes_per_pool=1)  # 16 chips
+    f.ckpt = {"warm": {"progress": 10, "step": 10},
+              "steady": {"progress": 10, "step": 10}}
+    f.h.create_job(tpu_job("warm", 1, min_hosts=1))
+    f.h.create_job(tpu_job("steady", 1, min_hosts=1))
+    f.converge()
+    assert f.job("warm").phase == api.Phase.RUNNING
+    assert f.job("steady").phase == api.Phase.RUNNING
+    # replayed ledger history: "warm" has one expensive restore episode
+    led = f.h.job_metrics.ledger
+    clock = FakeClock()
+    led._clock = clock  # pin the ledger clock for exact seconds
+    led.note_incident("default", "warm", "restore")
+    clock.advance(30)
+    led.observe_phase("default", "warm", "Running")
+    # a high-prio whale needs 8 of the 16 chips: both floors are 8, so
+    # ONE of the two low jobs must be squeezed out entirely
+    f.h.create_job(tpu_job("whale", 1, cls="tpu-high", min_hosts=1))
+    f.converge()
+    assert f.job("whale").phase == api.Phase.RUNNING
+    assert any("steady" in name for name in f.evictions)
+    assert not any("warm" in name for name in f.evictions)
+    log = [e for e in f.h.arbiter.decision_log if e["action"] == "evict"]
+    assert log and log[-1]["victim"] == "default/steady"
+    assert "predicted_badput_s" in log[-1]
+    f.h.close()
+
+
+def test_no_signal_keeps_pr6_staleness_ordering_and_admission():
+    """Fallback acceptance: with an empty ledger the feedback arbiter
+    must evict exactly the job the PR 6 arbiter would (the freshest
+    checkpoint), and a brand-new job must never be blocked from
+    admission by the predictor."""
+    f = FeedbackHarness(pools=2, nodes_per_pool=1)
+    f.ckpt = {"stale": {"progress": 100, "step": 0},   # 100 steps at risk
+              "fresh": {"progress": 100, "step": 100}}  # fully covered
+    f.h.create_job(tpu_job("stale", 1))
+    f.h.create_job(tpu_job("fresh", 1))
+    f.converge()
+    f.h.create_job(tpu_job("whale", 1, cls="tpu-high", min_hosts=1))
+    f.converge()
+    # PR 6 contract: the freshest-checkpointed job is the cheap victim
+    assert any("fresh" in name for name in f.evictions)
+    assert not any("stale" in name for name in f.evictions)
+    # admission is never predictor-gated: a new job with zero ledger
+    # history admits the moment capacity exists
+    assert f.job("whale").phase == api.Phase.RUNNING
+    f.h.close()
+
+
+# ---------------------------------------------------------------------------
+# straggler-triggered re-gang
+# ---------------------------------------------------------------------------
+
+def test_persistent_straggler_is_evicted_and_reganged(tmp_path,
+                                                      monkeypatch):
+    trace_path = str(tmp_path / "fb.jsonl")
+    monkeypatch.setattr(trace_mod, "_global", Tracer(path=trace_path))
+    f = FeedbackHarness(straggler_windows=3)
+    f.ckpt["gang"] = {"progress": 7, "step": 4}
+    f.h.create_job(tpu_job("gang", 2, min_hosts=2))
+    f.converge()
+    assert f.job("gang").phase == api.Phase.RUNNING
+    uid_before = f.worker_pods("gang")[0]["metadata"]["uid"]
+    fb = f.feedback
+    # two flagged windows: below M, nothing pending
+    for _ in range(2):
+        assert not fb.observe_straggler("default", "gang", 0, 0.05, 0.01)
+    f.converge()
+    assert f.evictions == []
+    # third consecutive window arms the re-gang; the nudge enqueues the
+    # pass that applies it
+    assert fb.observe_straggler("default", "gang", 0, 0.05, 0.01)
+    f.converge()
+    # ONLY the slow member was evicted, and it was recreated (re-gang)
+    assert f.evictions == ["gang-worker-0"]
+    assert f.job("gang").phase == api.Phase.RUNNING
+    pods = f.worker_pods("gang")
+    assert len(pods) == 2
+    assert pods[0]["metadata"]["uid"] != uid_before
+    # budget-free: booked as a scheduler preemption
+    job = f.job("gang")
+    assert int(job.status.get("schedPreemptions") or 0) == 1
+    assert int(job.status.get("preemptionRestarts") or 0) == 0
+    assert f.events("SchedFeedbackRegang")
+    assert fb.counts() == {"regang": 1}
+    # steps survived: the drain checkpoint covered all progress
+    assert f.ckpt["gang"]["step"] == f.ckpt["gang"]["progress"]
+    # hysteresis: the streak was consumed — the replacement needs M
+    # fresh windows before another re-gang can fire
+    assert not fb.observe_straggler("default", "gang", 0, 0.05, 0.01)
+    f.converge()
+    assert len(f.evictions) == 1
+    # the decision is reconstructable from trace alone
+    trace_mod.tracer().close()
+    entries = decision_entries(load_trace(trace_path))
+    regangs = [e for e in entries if e["action"] == "regang"]
+    assert len(regangs) == 1
+    assert regangs[0]["worker"] == 0
+    assert regangs[0]["straggler_windows"] == 3
+    assert decision_violations(entries) == []
+    f.h.close()
+
+
+def test_recovered_straggler_drops_pending_regang():
+    """A healthy window for the flagged member clears both the streak
+    and an armed-but-unapplied decision — the loop never churns a gang
+    that healed on its own."""
+    fb = FeedbackController(straggler_windows=2)
+    assert not fb.observe_straggler("d", "j", 1, 0.05, 0.01)
+    assert fb.observe_straggler("d", "j", 1, 0.05, 0.01)
+    assert fb.pending_remediation("d", "j")["action"] == "regang"
+    fb.observe_straggler("d", "j", 1, 0.01, 0.01)  # healthy window
+    assert fb.pending_remediation("d", "j") is None
+    assert fb.counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# backend-degradation auto-remediation
+# ---------------------------------------------------------------------------
+
+def test_degradation_triggers_budget_free_reschedule():
+    f = FeedbackHarness()
+    f.ckpt["fallback"] = {"progress": 9, "step": 8}
+    f.h.create_job(tpu_job("fallback", 2, min_hosts=1))
+    f.converge()
+    assert f.job("fallback").phase == api.Phase.RUNNING
+    led = f.h.job_metrics.ledger
+    for _ in range(3):
+        led.observe_throughput("default", "fallback", 151_000.0)
+    # the silent CPU-fallback resume: detector fires on one sample, the
+    # nudge (scraper-side) enqueues the remediation pass
+    assert led.observe_throughput("default", "fallback", 0.4)
+    f.feedback.nudge("default", "fallback")
+    f.converge()
+    # the WHOLE gang was drained for a re-schedule, then re-admitted
+    assert len(f.evictions) == 2
+    assert f.job("fallback").phase == api.Phase.RUNNING
+    job = f.job("fallback")
+    assert int(job.status.get("schedPreemptions") or 0) == 1
+    assert int(job.status.get("preemptionRestarts") or 0) == 0
+    assert f.events("SchedFeedbackRemediate")
+    assert f.feedback.counts() == {"remediate": 1}
+    # hysteresis: one remediation per episode — still-degraded samples
+    # do not re-fire until the detector has recovered once
+    led.observe_throughput("default", "fallback", 0.4)
+    f.feedback.nudge("default", "fallback")
+    f.converge()
+    assert f.feedback.counts() == {"remediate": 1}
+    # recovery re-arms: a NEW degradation episode remediates again
+    led.observe_throughput("default", "fallback", 140_000.0)
+    assert f.feedback.pending_remediation("default", "fallback") is None
+    led.observe_throughput("default", "fallback", 0.4)
+    f.feedback.nudge("default", "fallback")
+    f.converge()
+    assert f.feedback.counts() == {"remediate": 2}
+    f.h.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO-burn-driven priority boost
+# ---------------------------------------------------------------------------
+
+class TestPriorityBoost:
+    def _burning_slo(self, clock):
+        spec = SloSpec("goodput", "goodput_ratio", target=0.9,
+                       budget=0.25, fast_window=10, slow_window=40)
+        ev = SloEvaluator([spec], clock=clock)
+        for _ in range(30):
+            ev.observe("goodput_ratio", 0.1)
+            clock.advance(2)
+        ev.evaluate()
+        return ev
+
+    def test_boost_latches_and_rearms(self):
+        clock = FakeClock()
+        led = GoodputLedger(clock=clock)
+        led.observe_phase("default", "burning", "Pending")  # sched_wait
+        clock.advance(5)
+        ev = self._burning_slo(clock)
+        fb = FeedbackController(ledger=led, slo=ev, boost_cap=1)
+        job = api.TpuJob(tpu_job("burning", 1))
+        # both windows hot + job below target -> bounded boost, counted
+        assert fb.priority_boost(job) == 1
+        assert fb.counts() == {"boost": 1}
+        # latched: repeated planning passes see the same boost, ONE count
+        assert fb.priority_boost(job) == 1
+        assert fb.counts() == {"boost": 1}
+        # a healthy job never boosts
+        led.observe_phase("default", "fine", "Running")
+        clock.advance(10)
+        assert fb.priority_boost(api.TpuJob(tpu_job("fine", 1))) == 0
+        # fast window recovers -> boost drops (hysteresis re-arm)
+        for _ in range(30):
+            ev.observe("goodput_ratio", 0.95)
+            clock.advance(1)
+        ev.evaluate()
+        assert fb.priority_boost(job) == 0
+        assert fb.counts() == {"boost": 1}
+
+    def test_boosted_job_bids_ahead_of_fair_share(self):
+        """Arbiter integration, end-to-end through the harness SLO: a
+        burning job's bounded boost lets it displace an equal-priority
+        incumbent it could otherwise only queue behind — the burn ALERT
+        invalidates the plan cache, so the replan happens without any
+        cluster churn."""
+        clock = FakeClock()
+        f = FeedbackHarness(
+            pools=1, nodes_per_pool=1,  # 8 chips: room for one job
+            metrics_clock=clock,
+            slo_specs=[SloSpec("goodput", "goodput_ratio", target=0.9,
+                               budget=0.25)])
+        f.h.create_job(tpu_job("incumbent", 1, min_hosts=1))
+        f.converge()
+        assert f.job("incumbent").phase == api.Phase.RUNNING
+        clock.advance(100)  # 100s of clean goodput: the incumbent is fine
+        f.h.create_job(tpu_job("burning", 1, min_hosts=1))
+        f.converge()
+        # same tier, no capacity: the arrival queues
+        assert f.job("burning").phase != api.Phase.RUNNING
+        clock.advance(50)  # 50s of pure sched_wait: ratio 0, burning
+        # the queued job's ratio burns the goodput SLO budget on both
+        # windows -> alert -> plan invalidated -> boost applies
+        for _ in range(4):
+            f.h.slo.evaluate()
+        assert f.h.slo.burn_rates()[("goodput", "fast")] >= 1.0
+        f.converge()
+        assert f.job("burning").phase == api.Phase.RUNNING
+        assert any("incumbent" in name for name in f.evictions)
+        assert f.feedback.counts().get("boost", 0) >= 1
+        f.h.close()
+
+
+# ---------------------------------------------------------------------------
+# exposition + churn boundedness (decision_log ring, forget_job)
+# ---------------------------------------------------------------------------
+
+def test_feedback_metrics_block_is_valid_exposition():
+    fb = FeedbackController(straggler_windows=1)
+    assert fb.metrics_block() == ""  # nothing decided, nothing emitted
+    fb.observe_straggler("d", "j", 2, 9.0, 1.0)
+    fb.commit_remediation("d", "j", fb.pending_remediation("d", "j"))
+    text = fb.metrics_block()
+    assert parse_exposition(text) == []
+    assert 'tpujob_sched_feedback_total{action="regang"} 1' in text
+
+
+def test_decision_log_is_a_bounded_ring():
+    f = FeedbackHarness()
+    arb = FleetArbiter(f.h.client, decision_log_depth=8)
+    for i in range(50):
+        arb._log({"action": "evict", "victim": "d/j%d" % i})
+    assert len(arb.decision_log) == 8
+    assert arb.decision_log[0]["victim"] == "d/j42"
+    f.h.close()
+
+
+def test_arbiter_and_feedback_state_bounded_under_job_churn():
+    """Satellite: the PR 10 churn-boundedness bar extended to the
+    arbiter — per-job decision counters, the own-write np ledger, and
+    every feedback series must drop on terminal-job GC across 25-job
+    churn; the decision_log is a fixed ring."""
+    f = FeedbackHarness(pools=1, nodes_per_pool=1)
+    led = f.h.job_metrics.ledger
+    for i in range(25):
+        name = "churn-%02d" % i
+        f.h.create_job(tpu_job(name, 1))
+        f.converge()
+        assert f.job(name).phase == api.Phase.RUNNING
+        # exercise per-job feedback state on every job
+        f.feedback.observe_straggler("default", name, 0, 0.05, 0.01)
+        for _ in range(3):
+            led.observe_throughput("default", name, 1000.0)
+        f.h.client.delete(api.KIND, "default", name)
+        f.converge()
+        assert f.feedback.job_count() <= 1
+        assert f.h.arbiter.job_count() <= 1
+    assert f.feedback.job_count() == 0
+    assert f.h.arbiter.job_count() == 0
+    assert f.h.job_metrics.ledger.job_count() == 0
+    assert len(f.h.arbiter.decision_log) <= 256
+    text = f.h.manager.metrics_text()
+    assert 'job="default/churn-' not in text
+    assert parse_exposition(text) == []
+    f.h.close()
