@@ -28,13 +28,21 @@ test-fast:
 # shared parse — opslint's syntactic passes (lock discipline, thread
 # hygiene, reconcile purity, metrics conventions, recompile hazards),
 # the interprocedural dataflow families (OPS6xx buffer ownership &
-# donation, OPS7xx mesh consistency, OPS8xx blocking transfers), the
-# OPS001 stale-suppression audit, and mypy (strict on api/ + analysis/ +
-# sched/) + ruff when installed. Scope: package + scripts/ + bench.py.
-# Emits build/analysis_report.json (machine-readable findings) and
-# fails if the stage blows its 30s wall-clock budget.
+# donation, OPS7xx mesh consistency, OPS8xx blocking transfers, OPS9xx
+# lockset/atomicity — the static half of the race checking whose
+# dynamic half is `make race`, sharing one guard spec and one lock
+# fingerprint format), the OPS001 stale-suppression audit, and mypy
+# (strict on api/ + analysis/ + sched/ + obs/) + ruff when installed.
+# Scope: package + scripts/ + bench.py. Emits build/analysis_report.json
+# (machine-readable findings) and fails if the stage blows its 30s
+# wall-clock budget. Pre-commit lane: `make analyze-changed` re-reports
+# only git-changed files over the same full parse (identical findings
+# on those files, asserted in-suite).
 analyze:
 	$(PY) scripts/analyze_all.py
+
+analyze-changed:
+	$(PY) scripts/analyze_all.py --changed
 
 # the control-plane + data-plane fast tests re-run under the
 # instrumented-lock race/deadlock detector (TPUJOB_RACE_DETECT=1): any
@@ -52,7 +60,8 @@ race:
 	  tests/test_helper.py tests/test_hostport_elastic_server.py \
 	  tests/test_http_client.py tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
-	  tests/test_observability.py tests/test_reconciler.py \
+	  tests/test_observability.py tests/test_ops9xx.py \
+	  tests/test_reconciler.py \
 	  tests/test_recovery.py tests/test_runtime_edge.py \
 	  tests/test_scale_stress.py tests/test_sched.py tests/test_trace.py \
 	  tests/test_websocket.py

@@ -1320,10 +1320,11 @@ class _CanaryPool:
         self._alock = alock
         # make race: the attempt log is shared between the pool thread
         # and the parent's measurement path — every touch must hold
-        # _alock (no-op when the detector is off)
-        from paddle_operator_tpu.analysis import racedetect
+        # _alock, per the declared guard spec (analysis/guards.py — the
+        # same spec OPS9xx proves statically; no-op detector off)
+        from paddle_operator_tpu.analysis import guards
 
-        racedetect.guard_fields(self, "_alock", ["_attempts"])
+        guards.guard_declared(self)
         self.alive = threading.Event()
         self.no_plugin = None
         self.n_probes = 0

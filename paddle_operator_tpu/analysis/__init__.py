@@ -20,11 +20,21 @@ Three halves (see docs/static-analysis.md for the rule catalog):
   order-inversion cycles (potential deadlocks) and long-hold outliers,
   plus a happens-before checker for declared shared fields. Switched on
   over the whole test suite with ``TPUJOB_RACE_DETECT=1`` (``make race``).
+* :mod:`.guards` + :mod:`.ops9xx` — the unified shared-state guard
+  spec (one declaration = a runtime happens-before check AND a static
+  proof obligation) and the interprocedural lockset/atomicity passes
+  (OPS901-904) that discharge it over the whole call graph, emitting
+  lock-creation-site fingerprints the dynamic detector cross-checks.
 
 All stdlib-only; nothing here imports jax or the k8s stack, so the
 tooling lints the operator without executing it.
 """
 
+from .guards import (  # noqa: F401
+    SPECS,
+    GuardSpec,
+    guard_declared,
+)
 from .opslint import (  # noqa: F401
     Finding,
     RULES,

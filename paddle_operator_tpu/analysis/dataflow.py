@@ -169,6 +169,9 @@ class Summary:
     # inside the callee ("asarray": hazardous only for device args)
     persists: FrozenSet[Tuple[str, int]] = frozenset()
     resolved: bool = False
+    # the lockset half of the summary (filled by LocksetModel when the
+    # OPS9xx family runs; None for buffer-only analyses)
+    locks: Optional[Any] = None
 
 
 def _iter_py(paths: Sequence[str]) -> List[str]:
@@ -1127,14 +1130,22 @@ def _merge_envs(a: Dict[str, AbstractValue],
 class Analyzer:
     """Two-phase interprocedural analysis: summaries to a fixpoint
     (bounded rounds — the lattice is tiny and call chains shallow), then
-    a reporting walk with the registered passes."""
+    a reporting walk with the registered passes.
+
+    ``report_paths`` (incremental mode, ``analyze_all --changed``)
+    restricts the REPORTING walk to functions of those modules while
+    the parse, summaries, and whole-program models still cover the full
+    tree — findings for a changed file are identical to a whole-tree
+    run's findings for that file, just cheaper to produce."""
 
     ROUNDS = 3
 
     def __init__(self, project: Project,
-                 passes: Sequence[DataflowPass]) -> None:
+                 passes: Sequence[DataflowPass],
+                 report_paths: Optional[Set[str]] = None) -> None:
         self.project = project
         self.passes = list(passes)
+        self.report_paths = report_paths
 
     def _module_envs(self) -> None:
         """Abstract-evaluate module-level code (the hoisted
@@ -1171,11 +1182,17 @@ class Analyzer:
             if not changed:
                 break
 
+    def _in_report(self, path: str) -> bool:
+        return self.report_paths is None or path in self.report_paths
+
     def run(self) -> List[Finding]:
         self._summarize()
-        findings: List[Finding] = list(self.project.errors)
+        findings: List[Finding] = [f for f in self.project.errors
+                                   if self._in_report(f.path)]
         for key in sorted(self.project.functions):
             fn = self.project.functions[key]
+            if not self._in_report(fn.module.path):
+                continue
             interp = _Interp(self.project, fn, self.passes,
                              summary_mode=False)
             try:
@@ -1190,6 +1207,8 @@ class Analyzer:
             if sweep is None:
                 continue
             for mod in self.project.modules:
+                if not self._in_report(mod.path):
+                    continue
                 findings.extend(sweep(self.project, mod))
         uniq: Dict[Tuple[str, str, int, str, str], Finding] = {}
         for f in findings:
@@ -1220,3 +1239,914 @@ def analyze_source(source: str, passes: Sequence[DataflowPass],
             fh.write(source)
         project = Project([fpath], root=td)
         return Analyzer(project, passes).run()
+
+
+# ---------------------------------------------------------------------------
+# lockset lattice (the OPS9xx concurrency family, analysis/ops9xx.py)
+# ---------------------------------------------------------------------------
+#
+# The abstract value here is a LOCKSET: the set of locks the current
+# thread is known to hold at a program point. Locks are identified by
+# their CREATION SITE — the ``self._lock = threading.Lock()`` line —
+# because that is exactly the identity the runtime race detector
+# (racedetect.py) keys its lock-order graph on, so a static OPS902
+# cycle and a dynamic inversion report carry the same fingerprints and
+# the two tools cross-check. Per function the walk is lexical
+# (``with self._lock:`` scoping plus acquire()/release() pairs); across
+# functions three interprocedural closures carry the lattice:
+#
+# * ``may_acquire``  — locks a call may take, any path (drives the
+#   global acquisition-order graph OPS902 runs Tarjan over);
+# * ``may_block``    — blocking operations a call may reach (OPS904
+#   flags the call site that holds a lock across it);
+# * ``entry_must``   — locks GUARANTEED held on entry to a private
+#   helper, the intersection over all visible call sites (so a helper
+#   only ever called under the lock needs no ``with`` of its own, and
+#   a ``*_locked`` helper's claim is verified at every call site).
+#
+# Posture, as everywhere in this engine: unresolved callees, dynamic
+# receivers, and callbacks contribute nothing — imprecision silences a
+# finding, never invents one.
+
+_LOCK_FACTORIES_STATIC = frozenset((
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "InstrumentedLock", "InstrumentedRLock",
+))
+_THREAD_FACTORIES = frozenset(("Thread",))
+_QUEUE_FACTORIES = frozenset(("Queue", "SimpleQueue", "LifoQueue",
+                              "PriorityQueue"))
+
+#: dotted call names that block the calling thread (OPS904 catalog);
+#: receiver-dependent forms (Thread.join, Queue.get/put) are resolved
+#: structurally in the walker, not by name
+_BLOCKING_CALLS_STATIC = {
+    "time.sleep": "time.sleep",
+    "socket.create_connection": "socket.create_connection",
+    "urllib.request.urlopen": "urlopen",
+    "urlopen": "urlopen",
+    "requests.get": "requests.get",
+    "requests.post": "requests.post",
+    "subprocess.run": "subprocess.run",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+}
+
+_EXEMPT_LOCK_FUNCS = frozenset(("__init__", "__del__", "__enter__",
+                                "__exit__", "__new__"))
+
+
+@dataclass(frozen=True)
+class LockId:
+    """One lock, identified the way racedetect identifies it: by the
+    source line that creates it."""
+
+    owner: str               # "<module path>::<Class>" | "<module path>"
+    attr: str                # attribute / global name holding the lock
+    site: Tuple[str, int]    # (module path, creation line) — the
+    #                          fingerprint shared with racedetect
+
+    def label(self) -> str:
+        return "%s:%d" % self.site
+
+    def name(self) -> str:
+        short = self.owner.rsplit("::", 1)[-1]
+        short = short.rsplit("/", 1)[-1]
+        return "%s.%s" % (short, self.attr)
+
+
+@dataclass
+class ClassLocks:
+    """Lock topology of one class: which attrs hold locks (with
+    aliasing — ``Condition(self._lock)`` guards the same state), which
+    hold threads/queues (OPS904 receivers), and which hold instances of
+    other project classes (cross-object call resolution)."""
+
+    key: str                                  # "<module path>::<Class>"
+    locks: Dict[str, LockId] = field(default_factory=dict)
+    alias: Dict[str, str] = field(default_factory=dict)  # attr -> canonical
+    thread_attrs: Set[str] = field(default_factory=set)
+    queue_attrs: Set[str] = field(default_factory=set)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    assign_lines: Dict[str, int] = field(default_factory=dict)
+
+    def lock_for(self, attr: str) -> Optional[LockId]:
+        canon = self.alias.get(attr, attr)
+        return self.locks.get(canon)
+
+
+@dataclass
+class LockFacts:
+    """Per-function lockset facts from one lexical walk."""
+
+    key: str
+    cls_key: Optional[str]
+    simple: str
+    acquires: Set[LockId] = field(default_factory=set)
+    # (callee key, locks held at the site innermost-last, line)
+    calls: List[Tuple[str, Tuple[LockId, ...], int]] = (
+        field(default_factory=list))
+    # (what, line, held) for unresolvable-but-known-blocking operations
+    blocking: List[Tuple[str, int, Tuple[LockId, ...]]] = (
+        field(default_factory=list))
+    # (self-attr, line, held, is_write, with-block index or None)
+    accesses: List[Tuple[str, int, Tuple[LockId, ...], bool,
+                         Optional[int]]] = field(default_factory=list)
+    # (index, lock, start line, end line) of each `with <lock>:` region
+    lock_blocks: List[Tuple[int, LockId, int, int]] = (
+        field(default_factory=list))
+    # local = <expr containing self.attr read> inside block i:
+    # (local name, attr, block index, line)
+    reads_into: List[Tuple[str, str, int, int]] = (
+        field(default_factory=list))
+    # plain-name loads: name -> sorted lines (OPS903 staleness witness)
+    name_loads: Dict[str, List[int]] = field(default_factory=dict)
+    # (held, acquired) pairs observed lexically
+    order_edges: Set[Tuple[LockId, LockId]] = field(default_factory=set)
+
+
+class _LockHarvest:
+    """Module sweep: class lock topology + module-level locks/threads/
+    queues, built once per project."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.classes: Dict[str, ClassLocks] = {}   # "<path>::<Class>"
+        self.module_locks: Dict[str, Dict[str, LockId]] = {}
+        self.module_alias: Dict[str, Dict[str, str]] = {}
+        self.module_threads: Dict[str, Set[str]] = {}
+        self.module_queues: Dict[str, Set[str]] = {}
+        # class simple name -> [class keys] (unique-name type resolution)
+        self.class_by_name: Dict[str, List[str]] = {}
+        for mod in project.modules:
+            self._module(mod)
+        for key in self.classes:
+            self.class_by_name.setdefault(
+                key.rsplit("::", 1)[-1], []).append(key)
+        # attr types resolve after the class index exists
+        for mod in project.modules:
+            self._attr_types(mod)
+
+    def _module(self, mod: ModuleInfo) -> None:
+        locks: Dict[str, LockId] = {}
+        alias: Dict[str, str] = {}
+        threads: Set[str] = set()
+        queues: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._class(mod, node)
+                continue
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            short = _dotted(node.value.func).rsplit(".", 1)[-1]
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if short in _LOCK_FACTORIES_STATIC:
+                    wrapped = None
+                    for arg in node.value.args:
+                        if isinstance(arg, ast.Name) and arg.id in locks:
+                            wrapped = arg.id
+                    if short == "Condition" and wrapped is not None:
+                        alias[tgt.id] = alias.get(wrapped, wrapped)
+                    else:
+                        locks[tgt.id] = LockId(mod.path, tgt.id,
+                                               (mod.path, node.lineno))
+                elif short in _THREAD_FACTORIES:
+                    threads.add(tgt.id)
+                elif short in _QUEUE_FACTORIES:
+                    queues.add(tgt.id)
+        self.module_locks[mod.path] = locks
+        self.module_alias[mod.path] = alias
+        self.module_threads[mod.path] = threads
+        self.module_queues[mod.path] = queues
+
+    def _class(self, mod: ModuleInfo, cls: ast.ClassDef) -> None:
+        key = "%s::%s" % (mod.path, cls.name)
+        info = ClassLocks(key)
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    attr = _is_self_attr_static(tgt)
+                    if attr is None:
+                        continue
+                    info.assign_lines.setdefault(attr, node.lineno)
+                    if not isinstance(node.value, ast.Call):
+                        continue
+                    short = _dotted(node.value.func).rsplit(".", 1)[-1]
+                    if short in _LOCK_FACTORIES_STATIC:
+                        wrapped = None
+                        for arg in node.value.args:
+                            w = _is_self_attr_static(arg)
+                            if w is not None:
+                                wrapped = w
+                        if short == "Condition" and wrapped is not None:
+                            # either name guards the same state
+                            info.alias[attr] = info.alias.get(wrapped,
+                                                              wrapped)
+                        elif attr not in info.locks:
+                            info.locks[attr] = LockId(
+                                key, attr, (mod.path, node.lineno))
+                    elif short in _THREAD_FACTORIES:
+                        info.thread_attrs.add(attr)
+                    elif short in _QUEUE_FACTORIES:
+                        info.queue_attrs.add(attr)
+        self.classes[key] = info
+
+    def _attr_types(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            key = "%s::%s" % (mod.path, node.name)
+            info = self.classes.get(key)
+            if info is None:
+                continue
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(fn):
+                    if not isinstance(sub, ast.Assign) \
+                            or not isinstance(sub.value, ast.Call):
+                        continue
+                    short = _dotted(sub.value.func).rsplit(".", 1)[-1]
+                    cands = self.class_by_name.get(short, [])
+                    if len(cands) != 1:
+                        continue
+                    for tgt in sub.targets:
+                        attr = _is_self_attr_static(tgt)
+                        if attr is not None:
+                            info.attr_types.setdefault(attr, cands[0])
+
+    def declare_lock(self, cls_key: str, attr: str) -> LockId:
+        """A lock the guard spec declares but no factory call assigns
+        (a lock object passed in, like the bench canary pool's): its
+        identity anchors at the first ``self.<attr> = ...`` line."""
+        info = self.classes.get(cls_key)
+        if info is None:
+            path = cls_key.split("::", 1)[0]
+            return LockId(cls_key, attr, (path, 0))
+        lid = info.lock_for(attr)
+        if lid is not None:
+            return lid
+        path = cls_key.split("::", 1)[0]
+        line = info.assign_lines.get(attr, 0)
+        lid = LockId(cls_key, attr, (path, line))
+        info.locks[attr] = lid
+        return lid
+
+
+def _is_self_attr_static(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _LockWalker:
+    """One function's lexical lockset walk, producing a
+    :class:`LockFacts`. The held stack is a list (innermost last);
+    ``with`` items push for their body, ``.acquire()`` pushes for the
+    rest of the enclosing scope until a matching ``.release()``."""
+
+    def __init__(self, harvest: _LockHarvest, fn: FunctionInfo) -> None:
+        self.h = harvest
+        self.fn = fn
+        self.mod = fn.module
+        qual = fn.qualname.rsplit("::", 1)[-1]
+        first = qual.split(".", 1)[0]
+        cls_key = "%s::%s" % (self.mod.path, first)
+        self.cls = harvest.classes.get(cls_key)
+        self.facts = LockFacts(
+            fn.qualname, self.cls.key if self.cls else None,
+            fn.simple_name)
+        self.held: List[LockId] = []
+        self.local_locks: Dict[str, LockId] = {}   # name aliases
+        self.local_threads: Set[str] = set()
+        self.local_queues: Set[str] = set()
+        self._block_seq = 0
+
+    # -- lock expression resolution -------------------------------------
+
+    def _lock_expr(self, expr: ast.AST) -> Optional[LockId]:
+        attr = _is_self_attr_static(expr)
+        if attr is not None and self.cls is not None:
+            return self.cls.lock_for(attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return self.local_locks[expr.id]
+            mlocks = self.h.module_locks.get(self.mod.path, {})
+            malias = self.h.module_alias.get(self.mod.path, {})
+            return mlocks.get(malias.get(expr.id, expr.id))
+        return None
+
+    def _push(self, lock: LockId) -> None:
+        for h in self.held:
+            if h is lock or h.site == lock.site:
+                continue
+            self.facts.order_edges.add((h, lock))
+        self.held.append(lock)
+        self.facts.acquires.add(lock)
+
+    # -- driving ---------------------------------------------------------
+
+    def run(self) -> LockFacts:
+        for stmt in getattr(self.fn.node, "body", []):
+            self._stmt(stmt)
+        return self.facts
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs walk in their own right, lockless
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed: List[LockId] = []
+            for item in node.items:
+                self._expr(item.context_expr)
+                lock = self._lock_expr(item.context_expr)
+                if lock is not None:
+                    self._push(lock)
+                    pushed.append(lock)
+            end = getattr(node, "end_lineno", None) or node.lineno
+            for lock in pushed:
+                self._block_seq += 1
+                self.facts.lock_blocks.append(
+                    (self._block_seq, lock, node.lineno, end))
+            for stmt in node.body:
+                self._stmt(stmt)
+            # remove OUR pushed entries specifically, not the top of
+            # the stack: a release() inside the block may already have
+            # dropped one (blind pops would underflow), and an
+            # acquire() inside must survive the with-exit — the with's
+            # lock must not leak in its place
+            for lock in pushed:
+                for i in range(len(self.held) - 1, -1, -1):
+                    if self.held[i] is lock:
+                        del self.held[i]
+                        break
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            self._track_assign(node)
+            for tgt in node.targets:
+                self._record_target(tgt)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._expr(node.value)
+            self._record_target(node.target)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value)
+            self._record_target(node.target)
+            attr = _is_self_attr_static(node.target)
+            if attr is not None:
+                self._access(attr, node.target.lineno, False)
+            return
+        if isinstance(node, ast.Expr):
+            call = node.value
+            if isinstance(call, ast.Call) \
+                    and isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in ("acquire", "release"):
+                lock = self._lock_expr(call.func.value)
+                if lock is not None:
+                    if call.func.attr == "acquire":
+                        self._push(lock)
+                    elif self.held and any(h is lock or h.site == lock.site
+                                           for h in self.held):
+                        for i in range(len(self.held) - 1, -1, -1):
+                            if self.held[i].site == lock.site:
+                                del self.held[i]
+                                break
+                    return
+            self._expr(node.value)
+            return
+        # structured statements: walk expression children, then bodies
+        for fname in ("test", "iter", "exc", "cause", "value"):
+            sub = getattr(node, fname, None)
+            if isinstance(sub, ast.expr):
+                self._expr(sub)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._record_target(node.target)
+        for fname in ("body", "orelse", "finalbody"):
+            sub = getattr(node, fname, None)
+            if isinstance(sub, list):
+                for stmt in sub:
+                    if isinstance(stmt, ast.stmt):
+                        self._stmt(stmt)
+        for handler in getattr(node, "handlers", []) or []:
+            for stmt in handler.body:
+                self._stmt(stmt)
+
+    def _track_assign(self, node: ast.Assign) -> None:
+        """Local bookkeeping: lock aliases (``mu = self._lock``),
+        locally created threads/queues, and OPS903 read-into-local
+        records (a guarded attr read banked into a name inside a lock
+        block)."""
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        lock = self._lock_expr(node.value)
+        if lock is not None:
+            for n in names:
+                self.local_locks[n] = lock
+            return
+        if isinstance(node.value, ast.Call):
+            short = _dotted(node.value.func).rsplit(".", 1)[-1]
+            if short in _THREAD_FACTORIES:
+                self.local_threads.update(names)
+            elif short in _QUEUE_FACTORIES:
+                self.local_queues.update(names)
+        blk = self._innermost_block()
+        if blk is None:
+            return
+        for sub in ast.walk(node.value):
+            attr = _is_self_attr_static(sub)
+            if attr is not None:
+                for n in names:
+                    self.facts.reads_into.append(
+                        (n, attr, blk, node.lineno))
+
+    def _innermost_block(self) -> Optional[int]:
+        if not self.held:
+            return None
+        # the lock block entered last whose lock is the innermost held
+        for idx, lock, _s, _e in reversed(self.facts.lock_blocks):
+            if lock is self.held[-1]:
+                return idx
+        return None
+
+    def _record_target(self, tgt: ast.AST) -> None:
+        attr = _is_self_attr_static(tgt)
+        if attr is not None:
+            self._access(attr, tgt.lineno, True)
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = _is_self_attr_static(tgt.value)
+            if base is not None:
+                # self.d[k] = v writes through the container attr
+                self._access(base, tgt.lineno, True)
+            else:
+                self._expr(tgt.value)
+            self._expr(tgt.slice)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for sub in tgt.elts:
+                self._record_target(sub)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._record_target(tgt.value)
+            return
+        if isinstance(tgt, ast.Attribute):
+            self._expr(tgt.value)
+
+    def _access(self, attr: str, line: int, is_write: bool) -> None:
+        self.facts.accesses.append(
+            (attr, line, tuple(self.held), is_write,
+             self._innermost_block()))
+
+    # -- expressions -----------------------------------------------------
+
+    def _expr(self, node: Optional[ast.AST]) -> None:
+        """Pruned expression traversal: closures and nested defs are
+        skipped ENTIRELY (they run later, on another thread as often as
+        not, so the lexical lockset does not cover them — they are
+        walked as functions in their own right, lockless)."""
+        if node is None:
+            return
+        stack: List[ast.AST] = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+            elif isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, ast.Load):
+                self.facts.name_loads.setdefault(
+                    sub.id, []).append(sub.lineno)
+            else:
+                attr = _is_self_attr_static(sub)
+                if attr is not None:
+                    self._access(attr, sub.lineno,
+                                 isinstance(getattr(sub, "ctx", None),
+                                            (ast.Store, ast.Del)))
+                    # the receiver Name ('self') needs no visit
+                    continue
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _call(self, call: ast.Call) -> None:
+        held = tuple(self.held)
+        callee = _dotted(call.func)
+        target = self._resolve(call, callee)
+        if target is not None:
+            self.facts.calls.append((target, held, call.lineno))
+            return
+        what = self._blocking_what(call, callee)
+        if what is not None:
+            self.facts.blocking.append((what, call.lineno, held))
+
+    def _resolve(self, call: ast.Call, callee: str) -> Optional[str]:
+        """Callee -> project function key. self-methods, typed-attribute
+        methods (``self.capacity.snapshot`` when ``self.capacity =
+        FleetCapacity(...)``), imported/module functions, then a
+        project-unique trailing-name fallback; anything ambiguous stays
+        unresolved (and therefore silent)."""
+        parts = callee.split(".") if callee else []
+        if len(parts) >= 2 and parts[0] == "self" \
+                and self.cls is not None:
+            if len(parts) == 2:
+                key = "%s.%s" % (self.cls.key, parts[1])
+                if key in self.h.project.functions:
+                    return key
+            elif len(parts) == 3:
+                tkey = self.cls.attr_types.get(parts[1])
+                if tkey is not None:
+                    mkey = "%s.%s" % (tkey, parts[2])
+                    if mkey in self.h.project.functions:
+                        return mkey
+        if callee and not callee.startswith("self."):
+            target = self.h.project.resolve_call(self.mod, callee)
+            if target is not None:
+                return target.qualname
+        # unique trailing-name fallback (methods included): a method
+        # name defined exactly once project-wide binds through any
+        # receiver — ambiguity stays silent
+        simple = None
+        if isinstance(call.func, ast.Attribute):
+            simple = call.func.attr
+        elif callee:
+            simple = callee.rsplit(".", 1)[-1]
+        if simple:
+            cands = self.h.project.by_name.get(simple, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _blocking_what(self, call: ast.Call,
+                       callee: str) -> Optional[str]:
+        suffix2 = ".".join(callee.split(".")[-2:]) if callee else ""
+        if callee in _BLOCKING_CALLS_STATIC:
+            return _BLOCKING_CALLS_STATIC[callee]
+        if suffix2 in _BLOCKING_CALLS_STATIC:
+            return _BLOCKING_CALLS_STATIC[suffix2]
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        recv = call.func.value
+        recv_attr = _is_self_attr_static(recv)
+        if meth == "join":
+            if recv_attr is not None and self.cls is not None \
+                    and (recv_attr in self.cls.thread_attrs
+                         or "thread" in recv_attr.lower()):
+                return "Thread.join"
+            if isinstance(recv, ast.Name) \
+                    and (recv.id in self.local_threads
+                         or recv.id in self.h.module_threads.get(
+                             self.mod.path, set())):
+                return "Thread.join"
+        elif meth in ("get", "put"):
+            if recv_attr is not None and self.cls is not None \
+                    and recv_attr in self.cls.queue_attrs:
+                return "Queue.%s" % meth
+            if isinstance(recv, ast.Name) \
+                    and (recv.id in self.local_queues
+                         or recv.id in self.h.module_queues.get(
+                             self.mod.path, set())):
+                return "Queue.%s" % meth
+        return None
+
+
+class LocksetModel:
+    """The whole-project lockset analysis: harvest, per-function facts,
+    and the three interprocedural closures. ``declared`` injects the
+    guard spec — ``{module path: {class: [(lock_attr, fields)]}}`` —
+    promoting declared fields to lock-owned even when no guarded write
+    lets the analyzer infer it."""
+
+    ROUNDS = 24
+
+    def __init__(self, project: Project,
+                 declared: Optional[Dict[str, Dict[str, List[
+                     Tuple[str, Tuple[str, ...]]]]]] = None) -> None:
+        self.project = project
+        self.harvest = _LockHarvest(project)
+        self.facts: Dict[str, LockFacts] = {}
+        for key in sorted(project.functions):
+            fn = project.functions[key]
+            try:
+                self.facts[key] = _LockWalker(self.harvest, fn).run()
+            except RecursionError:  # pragma: no cover - degenerate tree
+                continue
+        self.declared = declared or {}
+        # class key -> field attr -> owning LockId (spec wins over
+        # inference; inference requires an unambiguous guarded write)
+        self.owners: Dict[str, Dict[str, LockId]] = {}
+        #: specs whose class/lock/field the tree does not have
+        self.stale_specs: List[Tuple[str, str, str]] = []
+        self._owners()
+        self.call_sites: Dict[str, List[Tuple[str, Tuple[LockId, ...],
+                                              int]]] = {}
+        for key, f in self.facts.items():
+            for callee, held, line in f.calls:
+                self.call_sites.setdefault(callee, []).append(
+                    (key, held, line))
+        self.may_acquire: Dict[str, FrozenSet[LockId]] = {}
+        self.may_block: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        self.entry_must: Dict[str, FrozenSet[LockId]] = {}
+        self.uncalled_private: Set[str] = set()
+        self._closures()
+        # summaries carry the lockset lattice alongside the buffer
+        # lattice (one engine, two abstract domains)
+        for key, summ in project.summaries.items():
+            summ.locks = self.facts.get(key)
+
+    # -- ownership -------------------------------------------------------
+
+    def _owners(self) -> None:
+        inferred: Dict[str, Dict[str, Optional[LockId]]] = {}
+        for key, f in self.facts.items():
+            if f.cls_key is None:
+                continue
+            cls = self.harvest.classes.get(f.cls_key)
+            if cls is None:
+                continue
+            if f.simple in _EXEMPT_LOCK_FUNCS:
+                continue
+            per = inferred.setdefault(f.cls_key, {})
+            for attr, _line, held, is_write, _blk in f.accesses:
+                if not is_write or not held or cls.lock_for(attr):
+                    continue
+                # written under two different locks: ambiguous, drop
+                prev = per.get(attr, held[-1])
+                per[attr] = held[-1] if prev is not None \
+                    and prev.site == held[-1].site else None
+        for cls_key, per in inferred.items():
+            out = self.owners.setdefault(cls_key, {})
+            for attr, lock in per.items():
+                if lock is not None:
+                    out[attr] = lock
+        # declared specs override / extend inference
+        for path, by_cls in sorted(self.declared.items()):
+            in_tree = any(m.path == path for m in self.project.modules)
+            for cls_name, entries in sorted(by_cls.items()):
+                cls_key = "%s::%s" % (path, cls_name)
+                info = self.harvest.classes.get(cls_key)
+                if info is None:
+                    if in_tree:
+                        self.stale_specs.append(
+                            (path, cls_name, "class missing"))
+                    continue
+                for lock_attr, fields in entries:
+                    if in_tree and lock_attr not in info.assign_lines \
+                            and info.lock_for(lock_attr) is None:
+                        self.stale_specs.append(
+                            (path, cls_name,
+                             "lock %s never assigned" % lock_attr))
+                        continue
+                    lid = self.harvest.declare_lock(cls_key, lock_attr)
+                    out = self.owners.setdefault(cls_key, {})
+                    for fld in fields:
+                        if in_tree and fld not in info.assign_lines \
+                                and not self._field_seen(cls_key, fld):
+                            self.stale_specs.append(
+                                (path, cls_name,
+                                 "field %s never touched" % fld))
+                            continue
+                        out[fld] = lid
+
+    def _field_seen(self, cls_key: str, attr: str) -> bool:
+        for key, f in self.facts.items():
+            if f.cls_key != cls_key:
+                continue
+            for a, _line, _held, _w, _blk in f.accesses:
+                if a == attr:
+                    return True
+        return False
+
+    # -- closures --------------------------------------------------------
+
+    def _closures(self) -> None:
+        keys = sorted(self.facts)
+        for key in keys:
+            self.may_acquire[key] = frozenset(self.facts[key].acquires)
+            blocks: Dict[str, Tuple[str, int]] = {}
+            for what, line, _held in self.facts[key].blocking:
+                blocks.setdefault(
+                    what, (self.facts[key].key.split("::", 1)[0], line))
+            self.may_block[key] = blocks
+        for _ in range(self.ROUNDS):
+            changed = False
+            for key in keys:
+                acq = set(self.may_acquire[key])
+                blk = dict(self.may_block[key])
+                for callee, _held, _line in self.facts[key].calls:
+                    acq |= self.may_acquire.get(callee, frozenset())
+                    for what, site in self.may_block.get(callee,
+                                                         {}).items():
+                        blk.setdefault(what, site)
+                if len(acq) != len(self.may_acquire[key]):
+                    self.may_acquire[key] = frozenset(acq)
+                    changed = True
+                if len(blk) != len(self.may_block[key]):
+                    self.may_block[key] = blk
+                    changed = True
+            if not changed:
+                break
+        self._required_fixpoint(keys)
+        self._entry_must(keys)
+
+    def _required_fixpoint(self, keys: List[str]) -> None:
+        """The transitive lock requirement a ``*_locked`` name claims:
+        its own uncovered owned-field accesses, plus whatever any
+        ``*_locked`` callee requires that the call site does not cover
+        lexically — a thin wrapper around a locked helper carries the
+        helper's obligation out to ITS callers."""
+        self.required: Dict[str, FrozenSet[LockId]] = {
+            key: self._own_required(key) for key in keys}
+        locked_keys = [k for k in keys
+                       if self.facts[k].simple.endswith("_locked")]
+        for _ in range(self.ROUNDS):
+            changed = False
+            for key in locked_keys:
+                cur = set(self.required[key])
+                before = len(cur)
+                for callee, held, _line in self.facts[key].calls:
+                    cf = self.facts.get(callee)
+                    if cf is None or not cf.simple.endswith("_locked"):
+                        continue
+                    for lock in self.required.get(callee, frozenset()):
+                        if not any(h.site == lock.site for h in held):
+                            cur.add(lock)
+                if len(cur) != before:
+                    self.required[key] = frozenset(cur)
+                    changed = True
+            if not changed:
+                break
+
+    def is_nested(self, key: str) -> bool:
+        """A def inside another def: lexically unreachable from outside
+        the project, so (like privates) its entry lockset is inferable
+        from visible call sites — a closure invoked inline under a lock
+        keeps the lock, one handed to a thread/callback has no visible
+        call site and stays out of every proof."""
+        path, qual = key.split("::", 1)
+        if "." not in qual:
+            return False
+        head, rest = qual.split(".", 1)
+        if ("%s::%s" % (path, head)) in self.harvest.classes:
+            return "." in rest
+        return True
+
+    def _entry_must(self, keys: List[str]) -> None:
+        """Locks guaranteed held at entry: `_locked` helpers ASSUME the
+        locks their owned-field accesses require (call sites verify the
+        claim, ops9xx); other private helpers (and nested defs) take
+        the intersection over every visible call site; public names
+        start empty."""
+        TOP = None  # lattice top: intersection identity
+        state: Dict[str, Optional[FrozenSet[LockId]]] = {}
+        assumed: Dict[str, FrozenSet[LockId]] = {}
+        for key in keys:
+            f = self.facts[key]
+            if f.simple.endswith("_locked"):
+                req = self.required.get(key, frozenset())
+                assumed[key] = req
+                state[key] = req
+            elif (f.simple.startswith("_")
+                  and not f.simple.startswith("__")) \
+                    or self.is_nested(key):
+                if self.call_sites.get(key):
+                    state[key] = TOP
+                else:
+                    state[key] = frozenset()
+                    self.uncalled_private.add(key)
+            else:
+                state[key] = frozenset()
+        for _ in range(self.ROUNDS):
+            changed = False
+            for key in keys:
+                if key in assumed or state[key] == frozenset():
+                    continue  # assumed, or already at bottom
+                sites = self.call_sites.get(key, [])
+                if not sites:
+                    continue
+                meet: Optional[FrozenSet[LockId]] = TOP
+                for caller, held, _line in sites:
+                    eff = state.get(caller, frozenset())
+                    if eff is TOP:
+                        continue  # caller unresolved: no constraint yet
+                    site_set = frozenset(held) | eff
+                    meet = site_set if meet is TOP else (meet & site_set)
+                if meet is not TOP and meet != state[key]:
+                    state[key] = meet
+                    changed = True
+            if not changed:
+                break
+        for key in keys:
+            v = state.get(key)
+            if v is TOP:
+                # a private cluster no public path ever reaches: treat
+                # as uncalled (no runtime path exists, so no finding)
+                self.uncalled_private.add(key)
+                v = frozenset()
+            self.entry_must[key] = v if v is not None else frozenset()
+
+    def required_locks(self, key: str) -> FrozenSet[LockId]:
+        """What this function's entry must provide: the transitive
+        ``*_locked`` claim when computed, else its own uncovered
+        owned-field accesses."""
+        got = getattr(self, "required", {}).get(key)
+        if got is not None:
+            return got
+        return self._own_required(key)
+
+    def _own_required(self, key: str) -> FrozenSet[LockId]:
+        """Owned-field accesses in ``key`` with no lexical cover: the
+        locks its entry must provide (what a ``*_locked`` name claims).
+        For a ``*_locked`` method of a single-lock class that touches
+        instance state, the name alone IS the claim — the class's one
+        lock is required even when no guarded write taught the
+        inference which lock owns which field."""
+        f = self.facts.get(key)
+        if f is None or f.cls_key is None:
+            return frozenset()
+        owners = self.owners.get(f.cls_key, {})
+        out: Set[LockId] = set()
+        for attr, _line, held, _w, _blk in f.accesses:
+            lock = owners.get(attr)
+            if lock is None:
+                continue
+            if not any(h.site == lock.site for h in held):
+                out.add(lock)
+        if not out and f.simple.endswith("_locked"):
+            cls = self.harvest.classes.get(f.cls_key)
+            if cls is not None and len(cls.locks) == 1:
+                only = next(iter(cls.locks.values()))
+                touches_state = any(
+                    cls.lock_for(attr) is None
+                    for attr, _l, _h, _w, _b in f.accesses)
+                if touches_state:
+                    out.add(only)
+        return frozenset(out)
+
+    def effective_entry(self, key: str) -> FrozenSet[LockId]:
+        return self.entry_must.get(key, frozenset())
+
+    # -- the global acquisition-order graph ------------------------------
+
+    def order_graph(self) -> Tuple[Dict[Tuple[str, int],
+                                        Set[Tuple[str, int]]],
+                                   Dict[Tuple[Tuple[str, int],
+                                              Tuple[str, int]], str]]:
+        """Site graph + one example per edge, the same shape racedetect
+        builds at runtime — edges from lexical nesting plus held-across-
+        call composition with the may_acquire closure."""
+        graph: Dict[Tuple[str, int], Set[Tuple[str, int]]] = {}
+        example: Dict[Tuple[Tuple[str, int], Tuple[str, int]], str] = {}
+
+        def add(src: LockId, dst: LockId, note: str) -> None:
+            if src.site == dst.site:
+                return
+            succ = graph.setdefault(src.site, set())
+            if dst.site not in succ:
+                succ.add(dst.site)
+                example[(src.site, dst.site)] = note
+        for key in sorted(self.facts):
+            f = self.facts[key]
+            path = key.split("::", 1)[0]
+            for src, dst in sorted(
+                    f.order_edges,
+                    key=lambda e: (e[0].site, e[1].site)):
+                add(src, dst, "%s holds %s then takes %s"
+                    % (f.simple, src.label(), dst.label()))
+            for callee, held, line in f.calls:
+                if not held:
+                    continue
+                for dst in sorted(self.may_acquire.get(callee,
+                                                       frozenset()),
+                                  key=lambda l: l.site):
+                    for src in held:
+                        add(src, dst,
+                            "%s:%d holds %s and calls %s which may "
+                            "acquire %s"
+                            % (path, line, src.label(),
+                               callee.rsplit("::", 1)[-1], dst.label()))
+        return graph, example
+
+
+def lock_cycles(graph: Dict[Tuple[str, int], Set[Tuple[str, int]]]
+                ) -> List[List[Tuple[str, int]]]:
+    """Cycles over a creation-site graph. LITERALLY the runtime
+    detector's algorithm — one shared Tarjan (racedetect.tarjan_cycles)
+    serves both checkers, so the static and dynamic reports can never
+    drift on what counts as a cycle."""
+    from .racedetect import tarjan_cycles
+
+    return tarjan_cycles(graph)

@@ -4,10 +4,11 @@
 (``make analyze``) drive: the syntactic opslint passes (OPS1xx–5xx),
 the package-wide metrics inventory (OPS4xx), and the interprocedural
 dataflow families (OPS6xx buffer ownership, OPS7xx mesh consistency,
-OPS8xx blocking transfers) all run over ONE :class:`dataflow.Project`
-parse, share the suppression-comment + baseline machinery, and feed the
-OPS001 stale-suppression audit — a pragma or baseline fingerprint that
-silences nothing is itself a finding, so the suppression surface can
+OPS8xx blocking transfers, OPS9xx lockset/atomicity) all run over ONE
+:class:`dataflow.Project` parse, share the suppression-comment +
+baseline machinery, and feed the OPS001 stale-suppression audit — a
+pragma, baseline fingerprint, or guard-spec entry that silences or
+checks nothing is itself a finding, so the suppression surface can
 only shrink.
 
 Determinism contract (tested): two runs over an unchanged tree produce
@@ -19,9 +20,9 @@ iteration order, filesystem walk order is normalized by
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from . import dataflow, ops6xx, ops7xx, ops8xx, opslint
+from . import dataflow, ops6xx, ops7xx, ops8xx, ops9xx, opslint
 from .opslint import Finding
 
 # the complete rule catalog across every family (docs/static-analysis.md)
@@ -30,36 +31,52 @@ ALL_RULES.update(opslint.RULES)
 ALL_RULES.update(ops6xx.RULES)
 ALL_RULES.update(ops7xx.RULES)
 ALL_RULES.update(ops8xx.RULES)
+ALL_RULES.update(ops9xx.RULES)
 
 # rule id -> family label for the machine-readable report
 def family_of(rule: str) -> str:
     if rule in ops6xx.RULES or rule in ops7xx.RULES \
-            or rule in ops8xx.RULES:
+            or rule in ops8xx.RULES or rule in ops9xx.RULES:
         return "dataflow"
     return "opslint"
 
 
 def dataflow_passes() -> List[dataflow.DataflowPass]:
     return (ops6xx.make_passes() + ops7xx.make_passes()
-            + ops8xx.make_passes())
+            + ops8xx.make_passes() + ops9xx.make_passes())
 
 
 def run_all(paths: Sequence[str], root: Optional[str] = None,
             axis_paths: Sequence[str] = (),
-            rules: Optional[Iterable[str]] = None) -> List[Finding]:
+            rules: Optional[Iterable[str]] = None,
+            report_paths: Optional[Set[str]] = None) -> List[Finding]:
     """All families over ``paths``; suppression pragmas applied; stale
     pragmas reported as OPS001. Baseline handling is the caller's
-    (CLI) job — fingerprints of the returned findings feed it."""
+    (CLI) job — fingerprints of the returned findings feed it.
+
+    ``report_paths`` (incremental mode): parse and summarize the whole
+    scope but REPORT only for those repo-relative files. The contract —
+    asserted in-suite — is that the result equals a whole-tree run's
+    findings restricted to those files."""
     project = dataflow.Project(paths, root=root, axis_paths=axis_paths)
+
+    def in_report(path: str) -> bool:
+        return report_paths is None or path in report_paths
 
     raw: List[Finding] = []
     inv = opslint._MetricsInventory()
     for mod in project.modules:
+        # metrics families resolve package-wide: collect from EVERY
+        # module even in incremental mode, report per-file below
+        opslint._METRICS_PASS.collect(mod.path, mod.tree, inv)
+        if not in_report(mod.path):
+            continue
         for p in opslint._AST_PASSES:
             raw.extend(p.run(mod.path, mod.tree, mod.source))
-        opslint._METRICS_PASS.collect(mod.path, mod.tree, inv)
-    raw.extend(opslint._METRICS_PASS.finish(inv))
-    raw.extend(dataflow.Analyzer(project, dataflow_passes()).run())
+    raw.extend(f for f in opslint._METRICS_PASS.finish(inv)
+               if in_report(f.path))
+    raw.extend(dataflow.Analyzer(project, dataflow_passes(),
+                                 report_paths=report_paths).run())
 
     # -- suppression + OPS001 stale-pragma audit ------------------------
     kept: List[Finding] = []
@@ -67,7 +84,8 @@ def run_all(paths: Sequence[str], root: Optional[str] = None,
     by_file: Dict[str, List[Finding]] = {}
     for f in raw:
         by_file.setdefault(f.path, []).append(f)
-    mod_by_path = {m.path: m for m in project.modules}
+    mod_by_path = {m.path: m for m in project.modules
+                   if in_report(m.path)}
     for path in sorted(mod_by_path):
         mod = mod_by_path[path]
         smap = opslint._suppressed_lines(mod.source)

@@ -1,0 +1,366 @@
+"""OPS9xx — interprocedural lockset & atomicity analysis.
+
+The dynamic race detector (:mod:`.racedetect`) only judges
+interleavings a test actually schedules, and the syntactic OPS101 pass
+sees one function at a time: a helper that touches
+``FeedbackController._streaks`` is fine per-function, but the *call
+chain* that reaches it from a bare notify path with an empty lockset is
+invisible to both until chaos happens to schedule it. These passes lift
+the race checks into the dataflow engine's lockset lattice
+(:class:`~.dataflow.LocksetModel`) so the whole call graph is the
+unit of analysis — and they consume the same declarative guard spec
+(:mod:`.guards`) the runtime checker enforces, so one declaration buys
+a dynamic happens-before check *and* a whole-program static proof
+obligation.
+
+Rules:
+
+* **OPS901 unguarded-reachable** — an access to a lock-owned field
+  (guard-spec-declared, or inferred from a guarded write) reachable
+  with an empty lockset: either the enclosing method can be entered
+  without the owning lock (no lexical ``with``, and the interprocedural
+  entry-must analysis cannot prove every call path holds it), or a
+  ``*_locked``-convention helper is CALLED from a site that does not
+  hold the lock its name claims.
+* **OPS902 static-lock-inversion** — a cycle in the global lock
+  acquisition-order graph composed across *all* call paths via function
+  summaries. Sites are creation-site fingerprints (``path:line`` of the
+  ``threading.Lock()`` assignment) — the same identity racedetect's
+  runtime graph uses, so the static and dynamic reports cross-check.
+* **OPS903 check-then-act** — a guarded read banked into a local, the
+  lock released, then a later re-acquisition of the same lock writes
+  the same field while the stale local is still consulted: the
+  classic lost-update window (fix: one atomic critical section).
+* **OPS904 blocking-under-lock** — a known-blocking operation
+  (``time.sleep``, ``Thread.join``, ``Queue.get/put``, HTTP,
+  subprocess) reachable while a lock is held, directly or through a
+  call chain: every other thread needing that lock now waits on the
+  slow operation too — the deadlock/latency hazard class.
+
+Posture: conservative against false positives — unresolved callees,
+callbacks, and dynamic receivers contribute nothing; private helpers
+no public path reaches are skipped; suppression pragmas and the
+baseline ride the shared engine machinery and feed the OPS001 audit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import guards, opslint
+from .dataflow import (
+    _EXEMPT_LOCK_FUNCS, DataflowPass, LocksetModel, ModuleInfo, Project,
+    lock_cycles,
+)
+from .opslint import Finding
+
+RULES: Dict[str, Tuple[str, str]] = {
+    "OPS901": (
+        "unguarded-reachable",
+        "lock-owned field (declared in the guard spec or inferred from "
+        "guarded writes) is reachable through a call chain with an "
+        "empty lockset — or a *_locked helper is called from a site "
+        "not holding the lock its name claims",
+    ),
+    "OPS902": (
+        "static-lock-inversion",
+        "cycle in the static lock acquisition-order graph composed "
+        "across all call paths (AB/BA): threads interleaving those "
+        "paths can deadlock — creation-site fingerprints match the "
+        "dynamic racedetect report",
+    ),
+    "OPS903": (
+        "check-then-act",
+        "guarded read banked into a local, lock released, then a later "
+        "critical section on the same lock writes the field while the "
+        "stale local is consulted — merge into one atomic section",
+    ),
+    "OPS904": (
+        "blocking-under-lock",
+        "blocking operation (sleep/join/Queue.get/HTTP/subprocess) "
+        "reachable while a lock is held: every waiter on that lock "
+        "stalls behind it — release first, or bound the wait",
+    ),
+}
+opslint.RULES.update(RULES)  # findings render through the shared catalog
+
+
+def _declared_spec() -> Dict[str, Dict[str, List[Tuple[str,
+                                                       Tuple[str, ...]]]]]:
+    out: Dict[str, Dict[str, List[Tuple[str, Tuple[str, ...]]]]] = {}
+    for path, by_cls in guards.specs_by_path().items():
+        for cls, specs in by_cls.items():
+            out.setdefault(path, {})[cls] = [
+                (s.lock_attr, s.fields) for s in specs]
+    return out
+
+
+class ConcurrencyPass(DataflowPass):
+    """Whole-project sweep: builds one :class:`LocksetModel` per
+    project parse, computes every OPS9xx finding, and hands them out
+    module by module through the engine's ``sweep_module`` hook."""
+
+    rule_ids = ("OPS901", "OPS902", "OPS903", "OPS904")
+
+    def __init__(self) -> None:
+        self._project: Optional[Project] = None
+        self._by_path: Dict[str, List[Finding]] = {}
+
+    def sweep_module(self, project: Project,
+                     mod: ModuleInfo) -> List[Finding]:
+        if self._project is not project:
+            self._project = project
+            self._by_path = self._analyze(project)
+        return list(self._by_path.get(mod.path, ()))
+
+    # -- the analysis ----------------------------------------------------
+
+    def _analyze(self, project: Project) -> Dict[str, List[Finding]]:
+        model = LocksetModel(project, declared=_declared_spec())
+        findings: List[Finding] = []
+        findings.extend(self._spec_audit(model))
+        findings.extend(self._ops901(model))
+        findings.extend(self._ops902(model))
+        findings.extend(self._ops903(model))
+        findings.extend(self._ops904(model))
+        out: Dict[str, List[Finding]] = {}
+        for f in findings:
+            out.setdefault(f.path, []).append(f)
+        return out
+
+    # -- guard-spec staleness (rides the OPS001 audit family) ------------
+
+    @staticmethod
+    def _spec_audit(model: LocksetModel) -> List[Finding]:
+        out = []
+        for path, cls, why in sorted(set(model.stale_specs)):
+            out.append(Finding(
+                "OPS001", path, 0,
+                "guard spec entry for %s is stale (%s): the declared "
+                "contract checks nothing — fix analysis/guards.py so "
+                "the spec tracks reality" % (cls, why),
+                symbol="guardspec.%s.%s" % (cls, why.split()[0])))
+        return out
+
+    # -- OPS901 ----------------------------------------------------------
+
+    def _ops901(self, model: LocksetModel) -> List[Finding]:
+        out: List[Finding] = []
+        for key in sorted(model.facts):
+            f = model.facts[key]
+            if f.cls_key is None or f.simple in _EXEMPT_LOCK_FUNCS:
+                continue
+            owners = model.owners.get(f.cls_key, {})
+            path = key.split("::", 1)[0]
+            entry = model.entry_must.get(key, frozenset())
+            locked_conv = f.simple.endswith("_locked")
+            if owners and not locked_conv \
+                    and key not in model.uncalled_private:
+                seen: Set[Tuple[str, int]] = set()
+                for attr, line, held, is_write, _blk in f.accesses:
+                    lock = owners.get(attr)
+                    if lock is None or (attr, line) in seen:
+                        continue
+                    eff = set(held) | set(entry)
+                    if any(h.site == lock.site for h in eff):
+                        continue
+                    seen.add((attr, line))
+                    out.append(Finding(
+                        "OPS901", path, line,
+                        "%s.%s is owned by %s (%s) but is %s here on a "
+                        "path provably reachable with an empty lockset"
+                        "%s: hoist the lock, or make this a *_locked "
+                        "helper and lock every call site"
+                        % (f.cls_key.rsplit("::", 1)[-1], attr,
+                           lock.name(), lock.label(),
+                           "written" if is_write else "read",
+                           self._chain_note(model, key, lock)),
+                        symbol="%s.%s.%s" % (
+                            f.cls_key.rsplit("::", 1)[-1], f.simple,
+                            attr)))
+            # verify the *_locked claim at every visible call site
+            if locked_conv:
+                required = model.required_locks(key)
+                for caller, held, line in sorted(
+                        model.call_sites.get(key, ()),
+                        key=lambda s: (s[0], s[2])):
+                    c_entry = model.entry_must.get(caller, frozenset())
+                    if caller in model.uncalled_private:
+                        continue
+                    eff_sites = set(held) | set(c_entry)
+                    for lock in sorted(required, key=lambda l: l.site):
+                        if any(h.site == lock.site for h in eff_sites):
+                            continue
+                        cpath = caller.split("::", 1)[0]
+                        out.append(Finding(
+                            "OPS901", cpath, line,
+                            "%s follows the *_locked convention "
+                            "(touches state owned by %s, %s) but this "
+                            "call site does not hold that lock — take "
+                            "it first, or re-gang the helper"
+                            % (f.simple, lock.name(), lock.label()),
+                            symbol="%s.call.%s" % (
+                                caller.rsplit("::", 1)[-1], f.simple)))
+        return out
+
+    @staticmethod
+    def _chain_note(model: LocksetModel, key: str, lock) -> str:
+        """One witness: a shortest public entry into ``key`` along call
+        edges that never provide ``lock`` (BFS over reverse call edges)
+        so the finding names the actual bare path — not some unrelated
+        caller that does hold the lock."""
+        def covered(caller: str, held) -> bool:
+            eff = set(held) | set(model.entry_must.get(caller,
+                                                       frozenset()))
+            return any(h.site == lock.site for h in eff)
+
+        simple = key.rsplit("::", 1)[-1].rsplit(".", 1)[-1]
+        if not simple.startswith("_"):
+            return " (public entry)"
+        seen = {key}
+        frontier = [(key, [key])]
+        while frontier:
+            cur, chain = frontier.pop(0)
+            for caller, held, _line in model.call_sites.get(cur, []):
+                if caller in seen or covered(caller, held):
+                    continue
+                seen.add(caller)
+                cs = caller.rsplit("::", 1)[-1].rsplit(".", 1)[-1]
+                if not cs.startswith("_"):
+                    names = " -> ".join(
+                        c.rsplit("::", 1)[-1]
+                        for c in reversed(chain + [caller]))
+                    return " (e.g. via %s)" % names
+                frontier.append((caller, chain + [caller]))
+        return ""
+
+    # -- OPS902 ----------------------------------------------------------
+
+    def _ops902(self, model: LocksetModel) -> List[Finding]:
+        graph, example = model.order_graph()
+        out: List[Finding] = []
+        for cyc in lock_cycles(graph):
+            detail = []
+            for i, site in enumerate(cyc):
+                nxt = cyc[(i + 1) % len(cyc)]
+                ex = example.get((site, nxt))
+                if ex is None:
+                    for other in cyc:
+                        ex = example.get((site, other))
+                        if ex:
+                            break
+                if ex:
+                    detail.append(ex)
+            labels = ["%s:%d" % s for s in cyc]
+            out.append(Finding(
+                "OPS902", cyc[0][0], cyc[0][1],
+                "static lock-order inversion: cycle over %s — %s. "
+                "Fingerprints are lock creation sites, matching the "
+                "dynamic racedetect report"
+                % (" -> ".join(labels + [labels[0]]),
+                   "; ".join(detail) or "interleaved orders"),
+                symbol="cycle.%s" % "+".join(labels)))
+        return out
+
+    # -- OPS903 ----------------------------------------------------------
+
+    def _ops903(self, model: LocksetModel) -> List[Finding]:
+        out: List[Finding] = []
+        for key in sorted(model.facts):
+            f = model.facts[key]
+            if f.cls_key is None or not f.reads_into:
+                continue
+            owners = model.owners.get(f.cls_key, {})
+            if not owners:
+                continue
+            blocks = {idx: (lock, start, end)
+                      for idx, lock, start, end in f.lock_blocks}
+            path = key.split("::", 1)[0]
+            emitted: Set[int] = set()
+            for var, attr, blk_idx, _read_line in f.reads_into:
+                lock = owners.get(attr)
+                blk = blocks.get(blk_idx)
+                if lock is None or blk is None \
+                        or blk[0].site != lock.site:
+                    continue
+                _lk, _start, read_end = blk
+                # a later, separate critical section on the SAME lock
+                # writing the SAME field...
+                for idx, wlock, wstart, wend in f.lock_blocks:
+                    if idx == blk_idx or wstart <= read_end \
+                            or wlock.site != lock.site:
+                        continue
+                    writes = [line for a, line, _h, w, b in f.accesses
+                              if a == attr and w and b == idx]
+                    if not writes:
+                        continue
+                    # ...while the banked local feeds the second
+                    # section — consulted inside it, or in the guard
+                    # directly above it (`if v: with lock:`). A local
+                    # merely used elsewhere after release (snapshot-
+                    # then-report, disjoint branches) is not an act.
+                    stale_uses = [ln for ln in
+                                  f.name_loads.get(var, [])
+                                  if wstart - 1 <= ln <= wend]
+                    if not stale_uses:
+                        continue
+                    wline = min(writes)
+                    if wline in emitted:
+                        continue
+                    emitted.add(wline)
+                    out.append(Finding(
+                        "OPS903", path, wline,
+                        "check-then-act on %s.%s: read under %s (%s) "
+                        "banked into %r, lock released, then this "
+                        "second critical section writes the field "
+                        "while the stale value is consulted (line %d) "
+                        "— merge into one atomic section"
+                        % (f.cls_key.rsplit("::", 1)[-1], attr,
+                           lock.name(), lock.label(), var,
+                           stale_uses[0]),
+                        symbol="%s.%s.%s" % (
+                            f.cls_key.rsplit("::", 1)[-1], f.simple,
+                            attr)))
+        return out
+
+    # -- OPS904 ----------------------------------------------------------
+
+    def _ops904(self, model: LocksetModel) -> List[Finding]:
+        out: List[Finding] = []
+        for key in sorted(model.facts):
+            f = model.facts[key]
+            path = key.split("::", 1)[0]
+            seen: Set[Tuple[str, int]] = set()
+            for what, line, held in f.blocking:
+                if not held or (what, line) in seen:
+                    continue
+                seen.add((what, line))
+                out.append(Finding(
+                    "OPS904", path, line,
+                    "%s while holding %s (%s): every thread waiting on "
+                    "that lock stalls behind the blocking operation — "
+                    "release the lock first, or bound the wait"
+                    % (what, held[-1].name(), held[-1].label()),
+                    symbol="%s.%s" % (f.simple, what)))
+            for callee, held, line in f.calls:
+                if not held:
+                    continue
+                blk = model.may_block.get(callee, {})
+                for what in sorted(blk):
+                    wpath, wline = blk[what]
+                    if (what, line) in seen:
+                        continue
+                    seen.add((what, line))
+                    out.append(Finding(
+                        "OPS904", path, line,
+                        "call to %s may block (%s at %s:%d) while "
+                        "holding %s (%s): release the lock before the "
+                        "blocking call, or bound the wait"
+                        % (callee.rsplit("::", 1)[-1], what, wpath,
+                           wline, held[-1].name(), held[-1].label()),
+                        symbol="%s.call.%s" % (f.simple, what)))
+        return out
+
+
+def make_passes() -> List[DataflowPass]:
+    return [ConcurrencyPass()]

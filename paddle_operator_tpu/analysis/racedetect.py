@@ -64,6 +64,67 @@ def _is_project_frame(depth: int) -> bool:
     return any(m in fname for m in _PROJECT_MARKERS)
 
 
+def tarjan_cycles(graph: Dict[Tuple[str, int], Set[Tuple[str, int]]]
+                  ) -> List[List[Tuple[str, int]]]:
+    """Tarjan SCCs over a creation-site graph; any SCC with >1 node is
+    a potential-deadlock cycle. THE single implementation both checkers
+    use — the runtime registry here and the static OPS902 pass
+    (``analysis.dataflow.lock_cycles`` delegates) — so the two reports
+    can never disagree on what counts as a cycle. Same-site pairs never
+    enter either graph (reentrancy is not an ordering signal), so the
+    >1-node criterion is exhaustive."""
+    index: Dict[Tuple[str, int], int] = {}
+    low: Dict[Tuple[str, int], int] = {}
+    onstack: Set[Tuple[str, int]] = set()
+    stack: List[Tuple[str, int]] = []
+    out: List[List[Tuple[str, int]]] = []
+    counter = [0]
+
+    def strongconnect(v: Tuple[str, int]) -> None:
+        # iterative DFS (the graph is tiny, but recursion limits are
+        # not worth the risk in a session-end hook)
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
 @dataclass
 class RaceReport:
     inversions: List[str] = field(default_factory=list)
@@ -202,61 +263,9 @@ class Registry:
     # -- reporting ------------------------------------------------------
 
     def _cycles(self) -> List[List[Tuple[str, int]]]:
-        """Tarjan SCCs over the site graph; any SCC with >1 node (or a
-        self-edge) is a potential-deadlock cycle."""
-        index: Dict[Tuple[str, int], int] = {}
-        low: Dict[Tuple[str, int], int] = {}
-        onstack: Set[Tuple[str, int]] = set()
-        stack: List[Tuple[str, int]] = []
-        out: List[List[Tuple[str, int]]] = []
-        counter = [0]
-
         with self._mu:
             graph = {k: set(v) for k, v in self._graph.items()}
-
-        def strongconnect(v: Tuple[str, int]) -> None:
-            # iterative DFS (the graph is tiny, but recursion limits are
-            # not worth the risk in a session-end hook)
-            work = [(v, iter(sorted(graph.get(v, ()))))]
-            index[v] = low[v] = counter[0]
-            counter[0] += 1
-            stack.append(v)
-            onstack.add(v)
-            while work:
-                node, it = work[-1]
-                advanced = False
-                for w in it:
-                    if w not in index:
-                        index[w] = low[w] = counter[0]
-                        counter[0] += 1
-                        stack.append(w)
-                        onstack.add(w)
-                        work.append((w, iter(sorted(graph.get(w, ())))))
-                        advanced = True
-                        break
-                    elif w in onstack:
-                        low[node] = min(low[node], index[w])
-                if advanced:
-                    continue
-                work.pop()
-                if work:
-                    parent = work[-1][0]
-                    low[parent] = min(low[parent], low[node])
-                if low[node] == index[node]:
-                    scc = []
-                    while True:
-                        w = stack.pop()
-                        onstack.discard(w)
-                        scc.append(w)
-                        if w == node:
-                            break
-                    if len(scc) > 1:
-                        out.append(sorted(scc))
-
-        for v in sorted(graph):
-            if v not in index:
-                strongconnect(v)
-        return out
+        return tarjan_cycles(graph)
 
     def report(self) -> RaceReport:
         rep = RaceReport()

@@ -89,12 +89,12 @@ class _CacheState:
 _state = _CacheState()
 _monitoring_hooked = False
 
-# make race (TPUJOB_RACE_DETECT=1): every access of the guarded fields
+# make race (TPUJOB_RACE_DETECT=1): every access of the declared guard
+# fields (analysis/guards.py — the same spec OPS9xx proves statically)
 # must hold _lock; no-op with the detector off (see analysis/racedetect)
-from .analysis import racedetect as _racedetect  # noqa: E402
+from .analysis import guards as _guards  # noqa: E402
 
-_racedetect.guard_fields(_state, "_lock",
-                         ["memo", "stats", "enabled_dir"])
+_guards.guard_declared(_state)
 
 
 def cache_enabled() -> bool:

@@ -12,7 +12,7 @@ too — everything stdlib-only, nothing imports jax.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..k8s.runtime import fold_suffix
 
@@ -35,7 +35,7 @@ def format_value(v: float) -> str:
     return "%d" % v if v == int(v) else "%.6f" % v
 
 
-def http_respond(req, code: int, body: bytes,
+def http_respond(req: Any, code: int, body: bytes,
                  ctype: str = "text/plain") -> None:
     """The one response-writer for this package's stdlib HTTP handlers
     (probes, metrics, worker exposition): headers + body with the

@@ -501,7 +501,7 @@ class ObservedEventRecorder:
     and the process trace, so the k8s Event stream and the JSONL timeline
     can never diverge."""
 
-    def __init__(self, inner, job_metrics: "JobMetrics"):
+    def __init__(self, inner: Any, job_metrics: "JobMetrics") -> None:
         self._inner = inner
         self._obs = job_metrics
 

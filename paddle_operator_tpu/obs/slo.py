@@ -150,12 +150,16 @@ class SloEvaluator:
         self._burn: Dict[Tuple[str, str], float] = {}
         self._alerting: set = set()
         # pull sources: fn() -> iterable of (objective, value); drained
-        # at every evaluation (scrape)
+        # at every evaluation (scrape). Lock-owned like the rest of the
+        # evaluator state: sources are registered after the evaluator is
+        # live (the manager wires them as subsystems come up) while
+        # scrape threads iterate the list.
         self._sources: List[Callable[[], Iterable[Tuple[str, float]]]] = []
 
     def add_source(self, fn: Callable[[], Iterable[Tuple[str, float]]]
                    ) -> None:
-        self._sources.append(fn)
+        with self._lock:
+            self._sources.append(fn)
 
     def observe(self, objective: str, value: float,
                 t: Optional[float] = None) -> None:
@@ -170,7 +174,9 @@ class SloEvaluator:
     def evaluate(self, now: Optional[float] = None) -> List[dict]:
         """Drain the pull sources, recompute every (slo, window) burn
         rate, and fire/clear alerts. Returns the alerts fired THIS call."""
-        for src in list(self._sources):
+        with self._lock:
+            sources = list(self._sources)
+        for src in sources:  # drained outside the lock: sources may be slow
             for objective, value in src():
                 self.observe(objective, value)
         if now is None:

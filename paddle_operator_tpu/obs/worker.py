@@ -247,14 +247,14 @@ class WorkerMetricsServer:
         self._counters: Dict[str, int] = {}
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 if self.path != "/metrics":
                     http_respond(self, 404, b"")
                     return
                 http_respond(self, 200, outer.metrics_text().encode(),
                              ctype="text/plain; version=0.0.4")
 
-            def log_message(self, *a):
+            def log_message(self, *a: Any) -> None:
                 pass
 
         self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)),

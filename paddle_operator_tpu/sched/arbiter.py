@@ -182,7 +182,7 @@ class FleetArbiter:
         self._clock = clock if clock is not None else _monotonic
         self._plan_t: Optional[float] = None
         # np the arbiter last WROTE per job: lets a replan distinguish
-        # its own shrink from a user's spec edit (see _desired_np).
+        # its own shrink from a user's spec edit (see _desired_np_locked).
         # In-memory only — after an operator restart the parked
         # annotation is simply trusted again.
         self._written_np: Dict[Tuple[str, str], int] = {}
@@ -230,7 +230,7 @@ class FleetArbiter:
                     and key not in self._plan.skipped
                     and job.phase not in (api.Phase.COMPLETED,
                                           api.Phase.FAILED)
-                    and job_chip_demand(job, self._desired_np(job)) > 0):
+                    and job_chip_demand(job, self._desired_np_locked(job)) > 0):
                 # A chip-demanding job the cached plan has never seen —
                 # created inside the rv/TTL cache window — must not
                 # slip through unarbitrated (a full fleet would
@@ -342,8 +342,8 @@ class FleetArbiter:
                     < self._replan_interval):
                 return  # real apiserver: bound full-fleet replans
         with tracer().span("sched_pass", mode=self.mode) as span:
-            plan = self._compute_plan()
-            self._apply_plan(plan)
+            plan = self._compute_plan_locked()
+            self._apply_plan_locked(plan)
             states = [t.state for t in plan.targets.values()]
             span.set(jobs=len(plan.targets),
                      admitted=sum(1 for s in states
@@ -406,7 +406,7 @@ class FleetArbiter:
             out.append(pod)
         return out
 
-    def _desired_np(self, job: api.TpuJob) -> int:
+    def _desired_np_locked(self, job: api.TpuJob) -> int:
         """The user's np: the parked original when the arbiter shrank
         the job, else the current spec. If spec.worker.replicas differs
         from what the arbiter itself last wrote, the USER edited it
@@ -420,7 +420,7 @@ class FleetArbiter:
             return cur
         written = self._written_np.get((job.namespace, job.name))
         if written is not None and cur != written:
-            return cur  # user edit wins; _align_np re-parks or clears
+            return cur  # user edit wins; _align_np_locked re-parks or clears
         try:
             return max(cur, int(parked))
         except ValueError:
@@ -442,7 +442,7 @@ class FleetArbiter:
             lo = 1
         return max(1, lo)
 
-    def _compute_plan(self) -> _Plan:
+    def _compute_plan_locked(self) -> _Plan:
         snap = self.capacity.snapshot()
         plan = _Plan(snapshot=snap)
         jobs = self._jobs()
@@ -453,7 +453,7 @@ class FleetArbiter:
         for job in jobs:
             if job.phase in (api.Phase.COMPLETED, api.Phase.FAILED):
                 continue
-            if job_chip_demand(job, self._desired_np(job)) <= 0:
+            if job_chip_demand(job, self._desired_np_locked(job)) <= 0:
                 continue  # non-TPU / zero workers: not arbitrated
             key = (job.namespace, job.name)
             all_pods = self._worker_pods(job)
@@ -470,7 +470,7 @@ class FleetArbiter:
                 # in that window would transiently exceed the fleet
                 completing_live += max(
                     len(pods) * job.tpu_chips_per_host(),
-                    job_chip_demand(job, self._desired_np(job)))
+                    job_chip_demand(job, self._desired_np_locked(job)))
                 plan.skipped.add(key)
                 continue
             live_chips[key] = len(pods) * job.tpu_chips_per_host()
@@ -493,7 +493,7 @@ class FleetArbiter:
             # capacity unknown: admit everything (pre-arbiter behavior)
             for job in candidates:
                 key = (job.namespace, job.name)
-                np = self._desired_np(job)
+                np = self._desired_np_locked(job)
                 plan.targets[key] = _Target(
                     ADMIT, np, np, job_chip_demand(job, np), prios[key])
             return plan
@@ -507,11 +507,11 @@ class FleetArbiter:
         placeable = []
         for job in candidates:
             key = (job.namespace, job.name)
-            chips = job_chip_demand(job, self._desired_np(job))
+            chips = job_chip_demand(job, self._desired_np_locked(job))
             per_slice = chips // job.tpu_num_slices()
             if job.tpu.get("topology") and per_slice > snap.slice_chips:
                 plan.targets[key] = _Target(
-                    QUEUE, 0, self._desired_np(job), chips, prios[key],
+                    QUEUE, 0, self._desired_np_locked(job), chips, prios[key],
                     reason="unplaceable: topology needs a %d-chip slice "
                            "but the largest pool has %d chips"
                            % (per_slice, snap.slice_chips))
@@ -519,9 +519,9 @@ class FleetArbiter:
             placeable.append(job)
         candidates = placeable
         if self.mode == "fifo":
-            self._plan_fifo(plan, candidates, live_chips, total_live)
+            self._plan_fifo_locked(plan, candidates, live_chips, total_live)
         else:
-            self._plan_fair(plan, candidates, live_chips, draining,
+            self._plan_fair_locked(plan, candidates, live_chips, draining,
                             total_live, prios)
         # prune the own-write ledger to live arbitrated jobs so memory
         # stays bounded across job churn
@@ -549,7 +549,7 @@ class FleetArbiter:
                 return
             self.free -= need
 
-    def _plan_fifo(self, plan: _Plan, candidates: List[api.TpuJob],
+    def _plan_fifo_locked(self, plan: _Plan, candidates: List[api.TpuJob],
                    live_chips: Dict[Tuple[str, str], int],
                    total_live: int) -> None:
         """The naive baseline: arrival order, gang-or-nothing, stop at
@@ -560,7 +560,7 @@ class FleetArbiter:
         blocked = False
         for job in sorted(candidates, key=arrival_key):
             key = (job.namespace, job.name)
-            np = self._desired_np(job)
+            np = self._desired_np_locked(job)
             chips = job_chip_demand(job, np)
             prio = effective_priority(job)
             if not blocked and chips <= remaining:
@@ -575,7 +575,7 @@ class FleetArbiter:
                                         "(FIFO order)")
             plan.targets[key] = target
 
-    def _plan_fair(self, plan: _Plan, candidates: List[api.TpuJob],
+    def _plan_fair_locked(self, plan: _Plan, candidates: List[api.TpuJob],
                    live_chips: Dict[Tuple[str, str], int],
                    draining: Dict[Tuple[str, str], bool],
                    total_live: int,
@@ -606,7 +606,7 @@ class FleetArbiter:
             key = (job.namespace, job.name)
             if (job.elastic is None and live_chips.get(key, 0) > 0
                     and not draining.get(key)):
-                np = self._desired_np(job)
+                np = self._desired_np_locked(job)
                 chips = job_chip_demand(job, np)
                 plan.targets[key] = _Target(ADMIT, np, np, chips,
                                             prios[key])
@@ -638,7 +638,7 @@ class FleetArbiter:
                 if (prios[okey] < prio
                         and live_chips.get(okey, 0) > 0
                         and not draining.get(okey)):
-                    onp = self._desired_np(other)
+                    onp = self._desired_np_locked(other)
                     floor = self._min_np(other)
                     guarantee = ((min(floor, onp) if floor is not None
                                   else onp)
@@ -686,7 +686,7 @@ class FleetArbiter:
                 -victim[(j.namespace, j.name)][0], arrival_key(j)))
             for job in running:
                 key = (job.namespace, job.name)
-                np = self._desired_np(job)
+                np = self._desired_np_locked(job)
                 cph = job.tpu_chips_per_host()
                 min_np = self._min_np(job)
                 # WATER-FILLING shrink-before-evict: every malleable
@@ -737,9 +737,9 @@ class FleetArbiter:
                     growth.append((prio, job))
             for job in fair_order(queued, table,
                                   lambda j: job_chip_demand(
-                                      j, self._desired_np(j))):
+                                      j, self._desired_np_locked(j))):
                 key = (job.namespace, job.name)
-                np = self._desired_np(job)
+                np = self._desired_np_locked(job)
                 chips = job_chip_demand(job, np)
                 min_np = self._min_np(job)
                 cph = job.tpu_chips_per_host()
@@ -814,19 +814,19 @@ class FleetArbiter:
     # acting on the plan
     # ------------------------------------------------------------------
 
-    def _apply_plan(self, plan: _Plan) -> None:
+    def _apply_plan_locked(self, plan: _Plan) -> None:
         for key, target in sorted(plan.targets.items()):
             try:
                 if target.state in (ADMIT, SHRINK):
-                    self._align_np(key, target)
+                    self._align_np_locked(key, target)
                 elif target.state == EVICT:
-                    self._evict(key, target)
+                    self._evict_locked(key, target)
             except (ApiError, NotFoundError):
                 # a failed write is retried by the next pass (the plan is
                 # recomputed from cluster state, nothing is lost)
                 continue
 
-    def _align_np(self, key: Tuple[str, str], target: _Target) -> None:
+    def _align_np_locked(self, key: Tuple[str, str], target: _Target) -> None:
         """Make spec.worker.replicas match the allocation, parking or
         restoring the job's own np through ANNOT_RESTORE_NP. No-op when
         already aligned (plan stability depends on that)."""
@@ -839,7 +839,7 @@ class FleetArbiter:
             worker = job.spec.get(api.RES_WORKER)
             if worker is None:
                 return
-            if self._desired_np(job) != target.desired_np:
+            if self._desired_np_locked(job) != target.desired_np:
                 # The user edited replicas after this plan was computed
                 # (the conflict-retry would otherwise re-apply the
                 # planned np right over their edit and park a stale
@@ -891,7 +891,7 @@ class FleetArbiter:
         target.ready = False
         target.reason = "awaiting resize to allocated np"
 
-    def _evict(self, key: Tuple[str, str], target: _Target) -> None:
+    def _evict_locked(self, key: Tuple[str, str], target: _Target) -> None:
         """Stamp the victim and drain its gang through the evictor. The
         reconciler's drain handler sees ANNOT_SCHED_EVICT and books the
         incident as a scheduler preemption (no restart budget spent)."""

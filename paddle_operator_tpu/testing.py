@@ -171,65 +171,23 @@ class OperatorHarness:
             queue = self.controller.queue
             fb.notify = lambda ns, name: queue.add((ns, name),
                                                    lane="high")
-        # Under TPUJOB_RACE_DETECT (make race) declare the shared fields
-        # the PR 2/3 incidents were about: every access must hold the
-        # owning lock or the session fails (happens-before checker —
-        # no-op when the detector is off, see analysis/racedetect.py).
-        from .analysis import racedetect
+        # Under TPUJOB_RACE_DETECT (make race) apply the DECLARED guard
+        # spec (analysis/guards.py) to every shared-state holder: the
+        # same one declaration the static OPS9xx passes prove over the
+        # whole call graph becomes a runtime happens-before check here —
+        # every access must hold the owning lock or the session fails
+        # (no-op when the detector is off).
+        from .analysis import guards, racedetect
 
         if racedetect.enabled():
-            racedetect.guard_fields(self.job_metrics, "_lock", [
-                "_phase", "_hist", "_hist_sum", "_hist_count",
-                "_restarts", "_resizes", "_barrier_wait", "_releases",
-                "_drains", "_sched_evictions", "_gang_stranded",
-                "_ckpt_saves", "_ckpt_corrupt", "_ckpt_restore_step",
-                "_first_seen", "_ttr_done", "_ttr_pending"])
-            # the goodput ledger's whole segment/detector state is
-            # lock-owned: an unlocked touch is exactly the torn-
-            # attribution class of bug the conservation invariant exists
-            # to catch
-            racedetect.guard_fields(self.job_metrics.ledger, "_lock", [
-                "_state", "_buckets", "_pending", "_episodes", "_ran",
-                "_finished", "_first", "_last", "_tput", "_degraded",
-                "_degraded_total"])
-            if self.slo is not None:
-                racedetect.guard_fields(self.slo, "_lock", [
-                    "_samples", "_burn", "_alerting"])
-            if self.arbiter is not None:
-                # decision_log is deliberately unguarded: the chaos
-                # auditor and tests read it post-quiescence without the
-                # lock (all writes happen inside _replan_locked)
-                racedetect.guard_fields(self.arbiter, "_lock", [
-                    "_plan", "_plan_rv", "_plan_t", "_passes",
-                    "_preempts", "_shrinks", "_written_np"])
-                fb = getattr(self.arbiter, "feedback", None)
-                if fb is not None:
-                    # the feedback loop's whole decision state is
-                    # lock-owned: an unlocked touch of a streak table or
-                    # the pending-action map is exactly the lost/double-
-                    # remediation class of bug
-                    racedetect.guard_fields(fb, "_lock", [
-                        "_streaks", "_pending", "_remediated",
-                        "_boosted", "_counts", "_commits"])
-            racedetect.guard_fields(self.reconciler, "_err_lock",
-                                    ["_err_streak", "_err_hit"])
-            racedetect.guard_fields(self.reconciler, "_warn_lock",
-                                    ["_sched_queued",
-                                     "_exec_release_warned",
-                                     "_preempt_handled"])
-            # the parallel workqueue's whole state is lock-owned: with
-            # reconcile_workers > 1 an unlocked touch of the lane maps or
-            # the active/dirty sets is exactly the key-loss class of bug
-            # the PR 2 wedge was
-            racedetect.guard_fields(self.controller.queue, "_lock", [
-                "_lanes", "_lane_of", "_deferred", "_active", "_dirty",
-                "_high_streak", "_pops", "_max_high_depth",
-                "_max_normal_behind_high"])
-            racedetect.guard_fields(self.controller, "_mlock", [
-                "_hist", "_hist_sum", "_hist_count", "_failures"])
-            if self.coord_server is not None:
-                racedetect.guard_fields(self.coord_server, "_barrier_lock",
-                                        ["_first_denied", "_released_pods"])
+            for obj in (self.job_metrics, self.job_metrics.ledger,
+                        self.slo, self.arbiter,
+                        getattr(self.arbiter, "feedback", None)
+                        if self.arbiter is not None else None,
+                        self.reconciler, self.controller.queue,
+                        self.controller, self.coord_server):
+                if obj is not None:
+                    guards.guard_declared(obj)
 
     def _slo_alert(self, spec, burn_fast, burn_slow, message) -> None:
         """An SLO's fast+slow burn windows both exceeded threshold:

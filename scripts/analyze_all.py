@@ -10,9 +10,17 @@ enforces a wall-clock budget so the analysis stage stays fast enough to
 sit inside ``make verify``.
 
     python scripts/analyze_all.py                    # full gate
+    python scripts/analyze_all.py --changed          # git-diff scope
     python scripts/analyze_all.py --list-rules
     python scripts/analyze_all.py --out report.json
     python scripts/analyze_all.py --prune-baseline   # drop stale entries
+
+``--changed [REF]`` is the pre-commit lane: the whole tree is still
+parsed and summarized (interprocedural findings need the full call
+graph), but only files changed vs REF (default HEAD; plus untracked)
+are re-reported — identical findings on those files to a full run,
+asserted in-suite. Baseline-staleness and the mypy/ruff stages are
+skipped (a partial report has no opinion on the rest of the tree).
 
 Exit: 1 on any non-baselined finding (stale pragmas and stale baseline
 entries included), or on budget overrun.
@@ -68,12 +76,37 @@ def _run_optional_tool(module: str, args, findings_out, repo=REPO):
     return proc.returncode
 
 
+def changed_files(repo=REPO, ref="HEAD"):
+    """Repo-relative .py files changed vs ``ref`` (worktree, staged,
+    and untracked). Empty set on a clean tree; None when git is
+    unavailable (callers fall back to a full run)."""
+    out = set()
+    for args in (["git", "diff", "--name-only", ref, "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(args, cwd=repo, capture_output=True,
+                                  text=True)
+        except OSError:
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return {f for f in out if f.endswith(".py")}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="all static-analysis families + JSON report")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/trees to analyze (default: package + "
                          "scripts/ + bench.py)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="incremental mode: report findings only for "
+                         "files changed vs REF (default HEAD) plus "
+                         "untracked files, over the full shared parse "
+                         "— the pre-commit lane")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--update-baseline", action="store_true",
@@ -104,9 +137,33 @@ def main(argv=None) -> int:
     rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None
     paths = args.paths or engine.default_paths()
 
+    if args.changed is not None and (args.update_baseline
+                                     or args.prune_baseline):
+        # a partial report would rewrite the baseline as if every
+        # finding elsewhere had vanished
+        print("analyze: --changed cannot combine with baseline rewrites")
+        return 2
+
+    report_paths = None
+    if args.changed is not None:
+        changed = changed_files(ref=args.changed)
+        if changed is None:
+            print("analyze: --changed: git unavailable; running full")
+        else:
+            report_paths = {f for f in changed
+                            if engine._in_scope(f, paths, REPO)}
+            if not report_paths:
+                print("analyze: --changed: no changed files in scope "
+                      "(vs %s); clean" % args.changed)
+                return 0
+            print("analyze: --changed: reporting %d file(s): %s"
+                  % (len(report_paths),
+                     ", ".join(sorted(report_paths))))
+
     t0 = time.perf_counter()
     findings = engine.run_all(paths, root=REPO,
-                              axis_paths=engine.axis_paths(), rules=rules)
+                              axis_paths=engine.axis_paths(), rules=rules,
+                              report_paths=report_paths)
     elapsed = time.perf_counter() - t0
 
     if args.update_baseline or args.prune_baseline:
@@ -124,9 +181,12 @@ def main(argv=None) -> int:
     baseline = ({} if args.no_baseline
                 else opslint.load_baseline(args.baseline))
     new, accepted = opslint.apply_baseline(findings, baseline)
-    stale = engine.stale_baseline_findings(
-        findings, baseline, args.baseline, scope=paths, root=REPO,
-        rules=rules)
+    # a --changed run reports a slice of the tree: it has no opinion on
+    # whether baseline entries elsewhere went stale
+    stale = [] if report_paths is not None else \
+        engine.stale_baseline_findings(
+            findings, baseline, args.baseline, scope=paths, root=REPO,
+            rules=rules)
     new.extend(stale)
     new.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol, f.message))
 
@@ -149,10 +209,11 @@ def main(argv=None) -> int:
     }
 
     rc = 0
-    if not args.skip_tools:
+    if not args.skip_tools and report_paths is None:
         rc |= _run_optional_tool("mypy", [
             "mypy", "paddle_operator_tpu/api", "paddle_operator_tpu/analysis",
-            "paddle_operator_tpu/sched", "scripts", "bench.py",
+            "paddle_operator_tpu/sched", "paddle_operator_tpu/obs",
+            "scripts", "bench.py",
         ], report["findings"]) and 1
         rc |= _run_optional_tool("ruff", [
             "ruff", "check", "paddle_operator_tpu", "scripts", "bench.py",
