@@ -57,6 +57,7 @@ race:
 	  tests/test_control_plane.py tests/test_coordination.py \
 	  tests/test_data.py tests/test_elastic_e2e.py tests/test_fake_client.py \
 	  tests/test_feedback.py tests/test_goodput.py \
+	  tests/test_hardware.py \
 	  tests/test_helper.py tests/test_hostport_elastic_server.py \
 	  tests/test_http_client.py tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
@@ -98,6 +99,11 @@ sched:
 #                  then rebuild the goodput waterfall from a goodput_audit
 #                  run's trace and re-check the conservation invariant
 #                  (wall == goodput + Σ badput) offline
+#                  ... and the hardware-efficiency lane (ISSUE 13): the
+#                  fleet MFU/roofline picture rebuilt from the trace's
+#                  hardware_block / mfu_sample events, hardware-block
+#                  conservation (total_flops == flops_per_step x steps)
+#                  and MFU-collapse reconstructability re-checked offline
 #   metrics-lint — strict text-exposition validation of a live
 #                  Manager.metrics_text() AND WorkerMetricsServer
 #                  .metrics_text() with every provider registered,
@@ -109,6 +115,7 @@ obs:
 	$(PY) scripts/obs_report.py --chaos preemption_burst --seed 1
 	$(PY) scripts/obs_report.py --chaos goodput_audit --seed 1
 	$(PY) scripts/obs_report.py --chaos multi_tenant --seed 1 --decisions
+	$(PY) scripts/obs_report.py --chaos goodput_audit --seed 1 --hardware
 
 metrics-lint:
 	$(PY) scripts/metrics_lint.py --selftest

@@ -74,8 +74,44 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 NOMINAL_TARGET_IMAGES_PER_SEC = 800.0
 
 # ResNet-50 at 224^2: ~4.1 GFLOP forward per image (2 x MACs); training
-# fwd+bwd ~3x forward. Used for the MFU numerator.
+# fwd+bwd ~3x forward. ANALYTIC FALLBACK for the MFU numerator only —
+# the headline figure now comes from the compiled step's own
+# cost_analysis() (obs.hardware.step_cost_of), stamped mfu_source so the
+# artifact says which one it is.
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 12.4e9
+
+
+def _array_backend(x):
+    """The platform an array ACTUALLY lives on — the MFU stamp must name
+    the backend that ran the step, not what default_backend() claims."""
+    try:
+        return sorted({d.platform for d in x.devices()})[0]
+    except Exception:
+        try:
+            return x.device().platform  # older jax
+        except Exception:
+            return ""
+
+
+def _mfu_fields(rate_per_sec, flops_per_unit, calib_tflops,
+                calib_backend, step_backend, source):
+    """MFU stamped with provenance (the r05 fix): ``mfu_backend`` is the
+    backend the step ran on, ``mfu_source`` where the numerator came
+    from (cost_analysis | analytic). When the step and the calibration
+    ran on DIFFERENT backends the field is suppressed and flagged — an
+    MFU dividing by a ceiling the step never ran against is the exact
+    bug that made r05's number meaningless."""
+    out = {"mfu_backend": step_backend or calib_backend,
+           "mfu_source": source}
+    if step_backend and calib_backend and step_backend != calib_backend:
+        out["mfu_suppressed"] = (
+            "calibration backend %r != step backend %r: refusing to "
+            "divide by a ceiling the step never ran against"
+            % (calib_backend, step_backend))
+        return out
+    out["mfu"] = round(rate_per_sec * flops_per_unit
+                       / (calib_tflops * 1e12), 4)
+    return out
 
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
@@ -279,8 +315,12 @@ def child_main():
         dt = time.perf_counter() - t0
         dt_c = dt if dt_c is None else min(dt_c, dt)
     calib_tflops = 2.0 * calib_dim ** 3 * calib_iters / dt_c / 1e12
+    # the backend the ceiling was MEASURED on — every MFU below must be
+    # stamped with (and agree with) the backend that ran its step
+    calib_backend = _array_backend(a) or backend
     _log("calibration: %.1f TFLOP/s sustained over %d chained %d^3 "
-         "bf16 matmuls" % (calib_tflops, calib_iters, calib_dim))
+         "bf16 matmuls (backend=%s)"
+         % (calib_tflops, calib_iters, calib_dim, calib_backend))
 
     from paddle_operator_tpu.models import resnet
     from paddle_operator_tpu.ops import optim
@@ -345,6 +385,24 @@ def child_main():
     dispatch_rate = batch * STEPS / (time.perf_counter() - t0)
     float(metrics["loss"])  # drain the real work before the next stage
 
+    # MFU numerator from the compiled step ITSELF (cost_analysis on the
+    # lowered executable — a trace-only probe, no second compile), with
+    # the hard-coded per-image constant demoted to a stamped analytic
+    # fallback; the backend the step ran on is read off the step's own
+    # output array, not assumed
+    from paddle_operator_tpu.obs import hardware as obs_hw
+
+    step_cost = obs_hw.step_cost_of(step, state, batch_data)
+    if step_cost is not None:
+        flops_per_image = step_cost.flops / batch
+        mfu_source = step_cost.source
+    else:
+        flops_per_image = RESNET50_TRAIN_FLOPS_PER_IMAGE
+        mfu_source = "analytic"
+    step_backend = _array_backend(metrics["loss"]) or backend
+    _log("step cost: %.3g FLOP/image (%s), step backend=%s"
+         % (flops_per_image, mfu_source, step_backend))
+
     result = {
         "metric": "resnet50_train_images_per_sec",
         "value": round(images_per_sec, 2),
@@ -357,14 +415,17 @@ def child_main():
         "window_images_per_sec": [round(r, 1) for r in window_rates],
         "dispatch_rate_images_per_sec": round(dispatch_rate, 1),
         "calib_matmul_tflops": round(calib_tflops, 1),
+        "flops_per_image": round(flops_per_image, 0),
         # model FLOPs achieved / the same-session readback-synced matmul
         # ceiling. Both sides measure true device completion, but the
         # numerator's per-dispatch steps still pay any link round-trip the
         # single-dispatch calibration doesn't — the `fused` entry quantifies
         # that overhead in-artifact (fused ≈ headline ⇒ negligible). Read
-        # against real-hardware MFU only when that holds.
-        "mfu": round(images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE
-                     / (calib_tflops * 1e12), 4),
+        # against real-hardware MFU only when that holds. Stamped with
+        # mfu_backend/mfu_source and SUPPRESSED when the calibration and
+        # the step ran on different backends (the r05 bug class).
+        **_mfu_fields(images_per_sec, flops_per_image, calib_tflops,
+                      calib_backend, step_backend, mfu_source),
         # Startup-tax ledger (PR 8): per-stage wall next to the cache
         # ledger, so BENCH_r*.json diffs separate startup regressions from
         # steady-state ones. `cache` is the rung that served this process
@@ -403,6 +464,26 @@ def child_main():
             "bench_overhead": round(bench_overhead, 3),
         },
     }
+    # Hardware-efficiency block (ISSUE 13): the same self-conserving
+    # shape the runner reports in result["hardware"] — chip capability
+    # from the registry (TPU generations) or the measured matmul ceiling
+    # (CPU/unknown), per-step cost from cost_analysis, live HBM sample,
+    # roofline class. total_flops == flops_per_step x steps by
+    # construction; obs_report --hardware re-checks it offline.
+    try:
+        hw_dev = jax.devices()[0]
+        plane = obs_hw.HardwarePlane(
+            obs_hw.resolve_chip(hw_dev,
+                                calibrated_flops=calib_tflops * 1e12),
+            step_cost if step_cost is not None
+            else obs_hw.analytic_cost(
+                RESNET50_TRAIN_FLOPS_PER_IMAGE * batch),
+            device=hw_dev)
+        plane.record(3 * STEPS, measured_s)
+        plane.sample_hbm()
+        result["goodput"]["hardware"] = plane.block()
+    except Exception as e:  # telemetry must never cost the headline
+        result["goodput"]["hardware_error"] = repr(e)[:200]
     # Emit the core number NOW: extras below can only enrich it, a wedged
     # extra stage loses nothing (the parent keeps the LAST JSON line).
     print(json.dumps(result))
@@ -445,14 +526,19 @@ def child_main():
         # sacrificed. Order overridable without a code change.
         extras = {
             "fused": ("BENCH_FUSED", "fused_measure",
-                      lambda: _fused_bench(batch, params, batch_data,
-                                           calib_tflops, opt, mesh)),
+                      lambda: _fused_bench(
+                          batch, params, batch_data, calib_tflops, opt,
+                          mesh,
+                          flops_per_image=(flops_per_image
+                                           if mfu_source != "analytic"
+                                           else None),
+                          calib_backend=calib_backend)),
             "bert": ("BENCH_BERT", "bert_bench",
-                     lambda: _bert_bench(calib_tflops)),
+                     lambda: _bert_bench(calib_tflops, calib_backend)),
             "gpt": ("BENCH_GPT", "gpt_bench",
-                    lambda: _gpt_bench(calib_tflops)),
+                    lambda: _gpt_bench(calib_tflops, calib_backend)),
             "moe": ("BENCH_MOE", "moe_bench",
-                    lambda: _moe_bench(calib_tflops)),
+                    lambda: _moe_bench(calib_tflops, calib_backend)),
             "attention": ("BENCH_ATTN", "attention_bench",
                           lambda: _attention_bench(backend)),
             "data_pipeline": ("BENCH_PIPELINE", "data_pipeline",
@@ -558,7 +644,8 @@ def _attention_block_sweep(backend):
             "results": results, "best": best}
 
 
-def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
+def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh,
+                 flops_per_image=None, calib_backend=""):
     """K train steps fused into ONE dispatch (`steps_per_call`), same
     optimizer/mesh as the headline and the same host-readback sync. Under
     honest sync this measures how much of the headline step is dispatch
@@ -602,8 +689,12 @@ def _fused_bench(batch, params, batch_data, calib_tflops, opt, mesh):
         "steps_per_call": K,
         "images_per_sec": round(ips, 1),
         "step_ms": round(best * 1000, 3),
-        "mfu": round(ips * RESNET50_TRAIN_FLOPS_PER_IMAGE
-                     / (calib_tflops * 1e12), 4),
+        **_mfu_fields(
+            ips,
+            flops_per_image or RESNET50_TRAIN_FLOPS_PER_IMAGE,
+            calib_tflops, calib_backend,
+            _array_backend(m["loss"]),
+            "cost_analysis" if flops_per_image else "analytic"),
     }
 
 
@@ -612,7 +703,10 @@ def _timed_windows(step, state, batch_data, steps):
     synced by a single host readback of the last step's loss (the ONLY
     sync this backend honors — module docstring). The one place the
     readback-sync methodology lives for the per-model extras, so a future
-    sync fix lands once, not in every bench."""
+    sync fix lands once, not in every bench. Returns ``(best_step_s,
+    step_backend)`` — the backend read off the step's own OUTPUT array,
+    so every per-model MFU stamp names where the steps really ran (a
+    site redirect can make default_backend() lie; the r05 class)."""
     state, m = step(state, batch_data)
     float(m["loss"])  # compile + real completion
     best = None
@@ -623,10 +717,10 @@ def _timed_windows(step, state, batch_data, steps):
         float(m["loss"])
         dt = (time.perf_counter() - t0) / steps
         best = dt if best is None else min(best, dt)
-    return best
+    return best, _array_backend(m["loss"])
 
 
-def _bert_bench(calib_tflops):
+def _bert_bench(calib_tflops, calib_backend=""):
     """BERT-base MLM train step (the BASELINE multi-host acceptance config,
     measured per-chip): fwd+bwd+AdamW at seq 512, host-readback synced.
     MFU numerator: 6 * matmul_params * tokens — the standard transformer
@@ -655,7 +749,7 @@ def _bert_bench(calib_tflops):
     opt = optim.adamw(1e-4, wd_mask=optim.make_wd_mask(params))
     step, state = build_train_step(bert.loss_fn, opt, params, batch_data,
                                    grad_clip=1.0)
-    best = _timed_windows(step, state, batch_data, steps)
+    best, step_backend = _timed_windows(step, state, batch_data, steps)
     seqs_per_sec = batch / best
     flops_per_seq = 6.0 * n_params * seq
     return {
@@ -664,11 +758,12 @@ def _bert_bench(calib_tflops):
         "matmul_params_m": round(n_params / 1e6, 1),
         "seqs_per_sec": round(seqs_per_sec, 1),
         "step_ms": round(best * 1000, 2),
-        "mfu": round(seqs_per_sec * flops_per_seq / (calib_tflops * 1e12), 4),
+        **_mfu_fields(seqs_per_sec, flops_per_seq, calib_tflops,
+                      calib_backend, step_backend, "analytic"),
     }
 
 
-def _gpt_bench(calib_tflops):
+def _gpt_bench(calib_tflops, calib_backend=""):
     """GPT-2-small causal-LM train step at long context (default 2048):
     fwd+bwd+AdamW through the causal flash-attention + RoPE path, host-
     readback synced. First hardware timing for the GPT family (round-3
@@ -713,7 +808,7 @@ def _gpt_bench(calib_tflops):
     loss_fn = partial(gpt.loss_fn, ce_chunk=ce_chunk)
     step, state = build_train_step(loss_fn, opt, params, batch_data,
                                    grad_clip=1.0)
-    best = _timed_windows(step, state, batch_data, steps)
+    best, step_backend = _timed_windows(step, state, batch_data, steps)
     tokens_per_sec = batch * seq / best
     dense_flops = 6.0 * n_matmul * seq          # per sequence
     attn_flops = 3.0 * 2.0 * seq * seq * cfg["hidden"] * cfg["layers"]
@@ -726,8 +821,8 @@ def _gpt_bench(calib_tflops):
         "matmul_params_m": round(n_matmul / 1e6, 1),
         "tokens_per_sec": round(tokens_per_sec, 0),
         "step_ms": round(best * 1000, 2),
-        "mfu": round((batch / best) * flops_per_seq
-                     / (calib_tflops * 1e12), 4),
+        **_mfu_fields(batch / best, flops_per_seq, calib_tflops,
+                      calib_backend, step_backend, "analytic"),
     }
 
     # Chunked-CE perf claim, measured (round-4 verdict item 5): the same
@@ -752,7 +847,7 @@ def _gpt_bench(calib_tflops):
             dense_step, dense_state = build_train_step(
                 partial(gpt.loss_fn, ce_chunk=0), opt, params, batch_data,
                 grad_clip=1.0)
-            dense_best = _timed_windows(
+            dense_best, _db = _timed_windows(
                 dense_step, dense_state, batch_data,
                 int(os.environ.get("BENCH_GPT_CE_DENSE_STEPS", "3")))
             peak_dense = peak_bytes()
@@ -779,7 +874,7 @@ def _gpt_bench(calib_tflops):
     return out
 
 
-def _moe_bench(calib_tflops):
+def _moe_bench(calib_tflops, calib_backend=""):
     """BERT-base with switch-MoE FFNs (8 experts, every 2nd layer) — the
     expert-parallel data path (ops/moe.py dense dispatch/combine einsums)
     timed on hardware for the first time (round-3 verdict item 3).
@@ -811,7 +906,7 @@ def _moe_bench(calib_tflops):
     opt = optim.adamw(1e-4, wd_mask=optim.make_wd_mask(params))
     step, state = build_train_step(bert.loss_fn, opt, params, batch_data,
                                    grad_clip=1.0)
-    best = _timed_windows(step, state, batch_data, steps)
+    best, step_backend = _timed_windows(step, state, batch_data, steps)
     tokens_per_sec = batch * seq / best
 
     # Executed FLOPs per sequence: dense (non-MoE) matmul params via 6ND
@@ -837,7 +932,8 @@ def _moe_bench(calib_tflops):
         "params_m": round(n_total / 1e6, 1),
         "tokens_per_sec": round(tokens_per_sec, 0),
         "step_ms": round(best * 1000, 2),
-        "mfu": round((flops_per_step / best) / (calib_tflops * 1e12), 4),
+        **_mfu_fields(1.0 / best, flops_per_step, calib_tflops,
+                      calib_backend, step_backend, "analytic"),
     }
 
 

@@ -69,10 +69,13 @@ SPECS: Tuple[GuardSpec, ...] = (
               ("_lanes", "_lane_of", "_deferred", "_active", "_dirty",
                "_high_streak", "_pops", "_max_high_depth",
                "_max_normal_behind_high")),
+    GuardSpec("paddle_operator_tpu.obs.hardware", "HardwarePlane", "_lock",
+              ("_steps", "_step_seconds", "_hbm")),
     GuardSpec("paddle_operator_tpu.obs.ledger", "GoodputLedger", "_lock",
               ("_state", "_buckets", "_pending", "_episodes", "_ran",
                "_finished", "_first", "_last", "_tput", "_degraded",
-               "_degraded_total")),
+               "_degraded_total", "_mfu", "_mfu_degraded", "_hw_mfu",
+               "_hw_peak", "_mfu_collapse_total")),
     GuardSpec("paddle_operator_tpu.obs.metrics", "JobMetrics", "_lock",
               ("_phase", "_hist", "_hist_sum", "_hist_count",
                "_restarts", "_resizes", "_barrier_wait", "_releases",
@@ -81,6 +84,10 @@ SPECS: Tuple[GuardSpec, ...] = (
                "_first_seen", "_ttr_done", "_ttr_pending")),
     GuardSpec("paddle_operator_tpu.obs.slo", "SloEvaluator", "_lock",
               ("_samples", "_burn", "_alerting", "_sources")),
+    GuardSpec("paddle_operator_tpu.obs.worker", "WorkerMetricsServer",
+              "_lock",
+              ("_values", "_stages", "_step_stats", "_badput",
+               "_counters", "_hbm")),
     GuardSpec("paddle_operator_tpu.sched.arbiter", "FleetArbiter", "_lock",
               ("_plan", "_plan_rv", "_plan_t", "_passes", "_preempts",
                "_shrinks", "_written_np")),

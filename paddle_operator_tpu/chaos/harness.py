@@ -109,6 +109,16 @@ class ChaosReport:
                    len(self.violations), extra))
 
 
+#: the goodput_audit MFU model (hardware-efficiency plane, ISSUE 13):
+#: a healthy v5e step sits near 0.38 MFU against the 197 TFLOP/s peak;
+#: the per-step cost is sized so the synthetic hardware block emitted
+#: at quiescence reproduces the same figure (1 step per goodput second)
+AUDIT_PEAK_FLOPS = 197e12
+AUDIT_HEALTHY_MFU = 0.38
+AUDIT_FLOPS_PER_STEP = AUDIT_HEALTHY_MFU * AUDIT_PEAK_FLOPS
+AUDIT_BYTES_PER_STEP = 2.5e11
+
+
 class _TickClock:
     """Deterministic clock for the ``goodput_audit`` ledger: one second
     per harness tick, advanced by the run loop — so badput seconds are
@@ -423,11 +433,38 @@ class ChaosHarness:
             # deterministic ledger facts (tick clock): the fingerprint
             # proves a same-seed replay attributes the SAME seconds to
             # the SAME causes, not just that it conserves
-            snap = self.h.job_metrics.ledger.snapshot("default", "audit")
+            ledger = self.h.job_metrics.ledger
+            snap = ledger.snapshot("default", "audit")
             extra["audit_wall_s"] = round(snap["wall"], 3)
             extra["audit_goodput_s"] = round(snap["goodput"], 3)
             for cause, s in sorted(snap["badput"].items()):
                 extra["audit_badput_%s" % cause] = round(s, 3)
+            # hardware-efficiency facts join the fingerprint too: the
+            # healthy-mean MFU (degraded samples excluded) and how many
+            # times the collapse trigger fired are replayable numbers
+            mean = ledger.job_mfu_mean().get("default/audit")
+            if mean is not None:
+                extra["audit_mfu"] = round(mean, 4)
+            extra["audit_mfu_collapses"] = \
+                ledger.mfu_collapse_counts().get("default/audit", 0)
+            # mirror the audit worker's hardware block into the trace
+            # (the runner does this at end-of-run; here the harness
+            # stands in for it) so `obs_report --hardware` rebuilds the
+            # fleet MFU/roofline picture and re-checks conservation
+            # offline — 1 synthetic step per goodput second, priced by
+            # the same per-step cost the MFU feed modeled
+            from ..obs.hardware import (
+                ChipSpec, HardwarePlane, analytic_cost)
+
+            steps = int(snap["goodput"])
+            if steps > 0:
+                plane = HardwarePlane(
+                    ChipSpec("TPU v5e (audit-sim)", "tpu",
+                             AUDIT_PEAK_FLOPS, 819e9, "registry"),
+                    analytic_cost(AUDIT_FLOPS_PER_STEP,
+                                  AUDIT_BYTES_PER_STEP))
+                plane.record(steps, float(steps))
+                plane.emit_trace(job="default/audit")
         if self.drain_workers > 1:
             # the parallel queue's audit counters join the determinism
             # fingerprint: a same-seed replay must make the same lane
@@ -442,10 +479,15 @@ class ChaosHarness:
 
     def _audit_tick(self) -> None:
         """goodput_audit per-tick work: feed the audit job's reported
-        examples/s into the backend-degradation detector (collapsed
-        while a backend_degrade fault is live, healthy otherwise — only
-        while the job is actually Running, like a worker scrape would
-        be), then advance the deterministic ledger clock one second."""
+        examples/s AND MFU into the backend-degradation detector
+        (collapsed while a backend_degrade fault is live, healthy
+        otherwise — only while the job is actually Running, like a
+        worker scrape would be), then advance the deterministic ledger
+        clock one second. The MFU feed models what the runner's
+        hardware plane reports: ~0.38 against the v5e peak when
+        healthy, ~2e-5 when the step silently fell back to CPU — so
+        the MFU-collapse trigger (absolute floor, no primed baseline
+        needed) fires on the SAME faults the eps detector covers."""
         try:
             running = self.h.get_job("audit").phase == api.Phase.RUNNING
         except NotFoundError:
@@ -453,11 +495,15 @@ class ChaosHarness:
         if running:
             if self._degrade_ticks > 0:
                 self._degrade_ticks -= 1
-                eps = 0.4  # the r03–r05 CPU-fallback floor
+                eps = 0.4     # the r03–r05 CPU-fallback floor
+                mfu = 2e-5    # CPU FLOP/s against the TPU peak
             else:
                 eps = 1000.0
+                mfu = AUDIT_HEALTHY_MFU
             self.h.job_metrics.ledger.observe_throughput(
                 "default", "audit", eps)
+            self.h.job_metrics.ledger.observe_mfu(
+                "default", "audit", mfu, peak_flops=AUDIT_PEAK_FLOPS)
         self.clock.advance(1.0)
 
     def _job_states(self) -> Dict[str, dict]:
@@ -521,12 +567,37 @@ class ChaosHarness:
             out.append("straggler badput %.6f != accepted charges %.6f"
                        % (bad.get("straggler", 0.0),
                           self._straggler_moved))
+        mfu_collapses = ledger.mfu_collapse_counts().get(
+            "default/audit", 0)
+        mfu_mean = ledger.job_mfu_mean().get("default/audit")
         if counts.get("backend_degrade"):
             evs = [e for e in self.h.client.all_objects("Event")
                    if e.get("reason") == "BackendDegraded"]
             if not evs:
                 out.append("backend degradation injected but the "
                            "detector emitted no BackendDegraded Event")
+            # the MFU-collapse trigger (second trigger, ISSUE 13): the
+            # same fault must fire it — absolute floor, so it does not
+            # need the eps baseline primed
+            if mfu_collapses <= 0:
+                out.append("backend degradation injected but the MFU-"
+                           "collapse trigger never fired")
+            if not any(e.get("reason") == "MfuCollapse"
+                       for e in self.h.client.all_objects("Event")):
+                out.append("MFU collapse fired but emitted no "
+                           "MfuCollapse Event")
+            # never-normalize mirror: the degraded samples must be
+            # EXCLUDED from the healthy MFU baseline/mean — a mean
+            # dragged toward the collapsed value is a poisoned baseline
+            if mfu_mean is not None and \
+                    mfu_mean < 0.9 * AUDIT_HEALTHY_MFU:
+                out.append("MFU baseline poisoned by degraded samples: "
+                           "healthy mean %.4f < healthy value %.4f"
+                           % (mfu_mean, AUDIT_HEALTHY_MFU))
+        elif mfu_collapses:
+            out.append("MFU-collapse trigger fired %d time(s) with no "
+                       "backend_degrade fault injected (false positive)"
+                       % mfu_collapses)
         by = snaps.get("bystander", {}).get("badput", {})
         stray = set(by) - {"sched_wait"}
         if stray:

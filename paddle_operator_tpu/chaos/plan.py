@@ -355,7 +355,12 @@ def _goodput_audit(rng: random.Random, quick: bool
     clocked first→last bound), that every injected cause shows up in
     its own bucket, and that the degradation detector fired its Event —
     so the whole attribution plane replays byte-identically from the
-    seed, badput seconds included."""
+    seed, badput seconds included. The hardware-efficiency leg
+    (ISSUE 13) rides the same ticks: the harness feeds the audit job's
+    MFU (collapsed while a ``backend_degrade`` fault is live), the
+    audit asserts the MFU-collapse trigger fired with the healthy
+    baseline unpoisoned, and a synthetic hardware block is mirrored to
+    trace for the ``obs_report --hardware`` rebuild."""
     events: List[FaultEvent] = []
     drain_at = rng.randint(4, 8)
     events.append(FaultEvent(drain_at, "graceful_drain",

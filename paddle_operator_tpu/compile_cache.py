@@ -414,6 +414,56 @@ def _aot_path(fingerprint: str) -> Optional[str]:
     return os.path.join(d, fingerprint + ".aotx")
 
 
+def _cost_path(fingerprint: str) -> Optional[str]:
+    """Sidecar path for a fingerprinted step's persisted cost-analysis
+    figures (same dir + key as the AOT executable it describes)."""
+    if not fingerprint:
+        return None
+    p = _aot_path(fingerprint)
+    if not p:
+        return None
+    return p[: -len(".aotx")] + ".cost.json"
+
+
+def load_step_cost(fingerprint: str) -> Optional[Dict[str, Any]]:
+    """Persisted ``{"flops", "bytes", "source"}`` for a fingerprinted
+    step — the hardware-efficiency plane's warm-restart rung: a
+    cache-served executable must not pay a fresh trace just to learn
+    its own FLOPs (the probe would hand back part of the startup tax
+    the AOT rung removed). None on miss/corruption, never raises."""
+    path = _cost_path(fingerprint)
+    if not path or not os.path.exists(path):
+        return None
+    import json
+
+    try:
+        with open(path) as fh:
+            raw = json.load(fh)
+        return raw if isinstance(raw, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def save_step_cost(fingerprint: str, cost: Dict[str, Any]) -> None:
+    """Persist a probed step cost next to the AOT executable (atomic
+    publish, same tmp+rename discipline as the executables)."""
+    path = _cost_path(fingerprint)
+    if not path:
+        return
+    import json
+
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(cost, fh)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
 def _try_load_aot(path: str) -> Optional[Callable]:
     if not path or not os.path.exists(path):
         return None

@@ -415,6 +415,9 @@ def main(argv=None):
         slo.add_source(lambda: [
             ("time_to_running", s)
             for s in job_metrics.pop_time_to_running_samples()])
+        slo.add_source(lambda: [
+            ("mfu", v)
+            for v in job_metrics.ledger.job_mfu().values()])
         mgr.add_metrics_provider(slo.metrics_block)
         if arbiter is not None and arbiter.feedback is not None:
             # SLO-burn-driven replanning: burn_rates() feeds the bounded

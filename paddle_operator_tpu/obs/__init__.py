@@ -20,6 +20,12 @@ Layout:
 * :mod:`.worker` — :class:`WorkerMetricsServer` (the runner's /metrics),
   :class:`StepProfiler` (bounded per-step phase ring), and
   :class:`StragglerDetector` (gang-median p50 drift).
+* :mod:`.hardware` — the hardware-efficiency plane (ISSUE 13):
+  :class:`ChipSpec` / :class:`StepCost` / :class:`HardwarePlane`
+  (analytic per-step FLOPs from ``cost_analysis()``, chip capability
+  registry, device-memory sampling, MFU + roofline classification) and
+  :class:`MfuBaseline` (the absolute-floor MFU-collapse detector the
+  ledger aggregates worker samples through).
 * :mod:`.exposition` — :func:`parse_exposition` (the strict validator
   both scrape surfaces run through) and formatting helpers.
 
@@ -28,6 +34,11 @@ Everything is stdlib-only and cheap when idle; nothing imports jax.
 
 from .exposition import (  # noqa: F401
     format_float, format_value, http_respond, parse_exposition,
+)
+from .hardware import (  # noqa: F401
+    CHIP_PEAKS, MFU_COLLAPSE_FLOOR, ChipSpec, HardwarePlane, MfuBaseline,
+    StepCost, analytic_cost, clamped_mfu, device_memory_stats,
+    resolve_chip, roofline_class, step_cost_of,
 )
 from .ledger import BADPUT_CAUSES, GOODPUT, GoodputLedger  # noqa: F401
 from .metrics import (  # noqa: F401
@@ -44,12 +55,17 @@ from .worker import (  # noqa: F401
 )
 
 __all__ = [
-    "BADPUT_CAUSES", "GOODPUT", "PHASE_BUCKETS", "RESTART_CAUSES",
-    "STEP_PHASES", "STRAGGLER_K", "FlightRecorder", "GoodputLedger",
-    "JobMetrics", "ObservedEventRecorder", "SloEvaluator", "SloSpec",
+    "BADPUT_CAUSES", "CHIP_PEAKS", "GOODPUT", "MFU_COLLAPSE_FLOOR",
+    "PHASE_BUCKETS", "RESTART_CAUSES",
+    "STEP_PHASES", "STRAGGLER_K", "ChipSpec", "FlightRecorder",
+    "GoodputLedger", "HardwarePlane",
+    "JobMetrics", "MfuBaseline", "ObservedEventRecorder", "SloEvaluator",
+    "SloSpec", "StepCost",
     "StepProfiler", "StragglerDetector", "ThroughputBaseline",
-    "WorkerMetricsServer", "median",
+    "WorkerMetricsServer", "analytic_cost", "clamped_mfu",
+    "device_memory_stats", "median",
     "default_slos", "format_float", "format_value", "http_respond",
     "incident_cause", "job_key", "parse_exposition", "parse_slo_spec",
+    "resolve_chip", "roofline_class", "step_cost_of",
     "wire_checkpoint_observer",
 ]

@@ -57,13 +57,17 @@ badput seconds join the determinism fingerprint), and bounded:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..k8s.runtime import escape_label_value
 from ..utils.trace import tracer
+from .hardware import MfuBaseline
 from .worker import ThroughputBaseline
+
+log = logging.getLogger("tpujob.obs.ledger")
 
 #: the badput cause taxonomy (docs/observability.md "Goodput & SLOs")
 BADPUT_CAUSES = (
@@ -140,6 +144,19 @@ class GoodputLedger:
         self._tput: Dict[str, ThroughputBaseline] = {}
         self._degraded: set = set()
         self._degraded_total: Dict[str, int] = {}
+        # hardware-efficiency plane (ISSUE 13): worker MFU samples
+        # aggregated per job — the MFU-collapse trigger is the SECOND
+        # trigger of the degradation detector (absolute floor: fires
+        # even before the eps baseline is primed), and degraded samples
+        # are never folded into the healthy mean (the never-normalize
+        # mirror). _hw_mfu holds (healthy_sum, healthy_count, last);
+        # _hw_peak the job's last reported chip peak (FLOP/s) so the
+        # fleet effective-FLOPs number has real units.
+        self._mfu: Dict[str, MfuBaseline] = {}
+        self._mfu_degraded: set = set()
+        self._hw_mfu: Dict[str, Tuple[float, int, float]] = {}
+        self._hw_peak: Dict[str, float] = {}
+        self._mfu_collapse_total: Dict[str, int] = {}
 
     # -- segment machine (reconciler hooks) ------------------------------
 
@@ -158,8 +175,9 @@ class GoodputLedger:
             elif phase == _PHASE_RUNNING:
                 self._ran.add(key)
                 self._pending.pop(key, None)
-                bucket = ("backend_degraded" if key in self._degraded
-                          else GOODPUT)
+                bucket = ("backend_degraded"
+                          if key in self._degraded
+                          or key in self._mfu_degraded else GOODPUT)
                 emit = self._enter_locked(key, bucket)
             else:  # Pending / Starting / Restarting / unknown
                 if key not in self._ran:
@@ -256,7 +274,9 @@ class GoodputLedger:
                     emit = self._enter_locked(key, "backend_degraded")
             elif change == "recovered":
                 self._degraded.discard(key)
-                if self._state.get(key, ("",))[0] == "backend_degraded":
+                if key not in self._mfu_degraded and \
+                        self._state.get(key, ("",))[0] == \
+                        "backend_degraded":
                     emit = self._enter_locked(key, GOODPUT)
             degraded = tb.degraded
         self._emit_segments(key, emit)
@@ -270,7 +290,114 @@ class GoodputLedger:
 
     def degraded_jobs(self) -> List[str]:
         with self._lock:
-            return sorted(self._degraded)
+            return sorted(self._degraded | self._mfu_degraded)
+
+    # -- hardware-efficiency plane (ISSUE 13) ----------------------------
+
+    def observe_mfu(self, namespace: str, name: str, mfu: float,
+                    peak_flops: float = 0.0) -> bool:
+        """One worker MFU sample. Returns True while MFU-degraded.
+
+        The SECOND trigger of the backend-degradation detector: MFU is
+        measured against the chip's own peak, so a CPU-fallback resume
+        collapses below the absolute floor on the very FIRST sample —
+        no primed eps baseline needed (the r03–r05 class). A sample
+        > 1.0 is a warning and a clamped gauge, never a crash; degraded
+        samples are never folded into the healthy mean or the baseline
+        (the eps never-normalize mirror)."""
+        key = _job_key(namespace, name)
+        v = float(mfu)
+        if v > 1.0:
+            log.warning("job %s reported MFU %.3f > 1.0 (cost model vs "
+                        "peak inconsistency); clamping the sample", key, v)
+            v = 1.0
+        alert: Optional[str] = None
+        with self._lock:
+            mb = self._mfu.get(key)
+            if mb is None:
+                mb = self._mfu[key] = MfuBaseline(
+                    degraded_ratio=self._degraded_ratio,
+                    recovery_ratio=self._recovery_ratio,
+                    window=self._baseline_window,
+                    min_samples=self._baseline_min)
+            change = mb.observe(v)
+            if peak_flops > 0:
+                self._hw_peak[key] = float(peak_flops)
+            s, n, _last = self._hw_mfu.get(key, (0.0, 0, 0.0))
+            if not mb.degraded:
+                s, n = s + v, n + 1
+            self._hw_mfu[key] = (s, n, v)
+            emit: List[dict] = []
+            if change == "degraded":
+                self._mfu_degraded.add(key)
+                self._mfu_collapse_total[key] = \
+                    self._mfu_collapse_total.get(key, 0) + 1
+                self._degraded_total[key] = \
+                    self._degraded_total.get(key, 0) + 1
+                alert = ("observed MFU %.3g vs collapse floor %.3g / own "
+                         "baseline %.3g: the step is not plausibly "
+                         "running on the chip its peak describes (CPU "
+                         "fallback after resume?)"
+                         % (v, mb.floor, mb.baseline))
+                if self._state.get(key, ("",))[0] == GOODPUT:
+                    emit = self._enter_locked(key, "backend_degraded")
+            elif change == "recovered":
+                self._mfu_degraded.discard(key)
+                if key not in self._degraded and \
+                        self._state.get(key, ("",))[0] == \
+                        "backend_degraded":
+                    emit = self._enter_locked(key, GOODPUT)
+            degraded = mb.degraded
+        self._emit_segments(key, emit)
+        tracer().event("mfu_sample", job=key, mfu=round(v, 6),
+                       degraded=degraded)
+        if alert is not None:
+            tracer().event("mfu_collapse", job=key, mfu=round(v, 6))
+            cb = self.on_alert
+            if cb is not None:
+                cb(namespace, name, "MfuCollapse", alert)
+        return degraded
+
+    def job_mfu(self) -> Dict[str, float]:
+        """Last MFU sample per job — the ``mfu`` SLO pull source (bad
+        samples must reach the burn windows, so this is the raw last
+        observation, not the healthy mean)."""
+        with self._lock:
+            return {key: last for key, (_s, _n, last)
+                    in self._hw_mfu.items()}
+
+    def job_mfu_mean(self) -> Dict[str, float]:
+        """Healthy-sample mean MFU per job (the ``tpujob_mfu`` gauge) —
+        degraded samples are excluded, mirroring the eps baseline's
+        never-normalize rule."""
+        with self._lock:
+            return {key: s / n for key, (s, n, _last)
+                    in self._hw_mfu.items() if n > 0}
+
+    def mfu_collapse_counts(self) -> Dict[str, int]:
+        """MFU-collapse episodes per job (chaos audit surface)."""
+        with self._lock:
+            return dict(self._mfu_collapse_total)
+
+    def fleet_effective_flops(self) -> float:
+        """Goodput-seconds weighted by healthy-mean MFU x the job's
+        chip peak: the single FLOP figure the arbiter and the bench
+        trajectory should optimize (a job with no reported peak
+        contributes nothing rather than a unitless guess)."""
+        with self._lock:
+            return self._effective_flops_locked()
+
+    def _effective_flops_locked(self) -> float:
+        """The ONE implementation of the fleet effective-FLOPs formula
+        — the arbiter-facing method and the scraped gauge must never
+        desynchronize. Called with self._lock held."""
+        total = 0.0
+        for key, (s, n, _last) in self._hw_mfu.items():
+            peak = self._hw_peak.get(key, 0.0)
+            if n <= 0 or peak <= 0:
+                continue
+            total += self._snapshot_locked(key)["goodput"] * (s / n) * peak
+        return total
 
     # -- readout ---------------------------------------------------------
 
@@ -346,7 +473,8 @@ class GoodputLedger:
         """Jobs with live ledger series (churn-boundedness checks)."""
         with self._lock:
             return len(set(self._buckets) | set(self._state)
-                       | set(self._tput))
+                       | set(self._tput) | set(self._mfu)
+                       | set(self._hw_mfu))
 
     def forget_job(self, namespace: str, name: str) -> None:
         """Terminal-job GC: drop every per-job series so 10k-job churn
@@ -364,6 +492,11 @@ class GoodputLedger:
             self._tput.pop(key, None)
             self._degraded.discard(key)
             self._degraded_total.pop(key, None)
+            self._mfu.pop(key, None)
+            self._mfu_degraded.discard(key)
+            self._hw_mfu.pop(key, None)
+            self._hw_peak.pop(key, None)
+            self._mfu_collapse_total.pop(key, None)
 
     # -- exposition ------------------------------------------------------
 
@@ -376,6 +509,10 @@ class GoodputLedger:
                      for key in sorted(set(self._buckets)
                                        | set(self._state))}
             degraded_total = dict(self._degraded_total)
+            hw_mfu = dict(self._hw_mfu)
+            # computed inside the same lock hold as the per-job copies,
+            # by the same helper the arbiter-facing method uses
+            effective_flops = self._effective_flops_locked()
         lines: List[str] = []
         with_wall = {k: s for k, s in snaps.items() if s["wall"] > 0}
         if with_wall:
@@ -421,6 +558,23 @@ class GoodputLedger:
             for key in sorted(degraded_total):
                 lines.append('tpujob_backend_degraded_total{job="%s"} %d'
                              % (esc(key), degraded_total[key]))
+        mfu_means = {key: s / n for key, (s, n, _last)
+                     in hw_mfu.items() if n > 0}
+        if mfu_means:
+            lines.append("# HELP tpujob_mfu Healthy-sample mean model "
+                         "FLOP/s utilization per job (degraded samples "
+                         "excluded — the never-normalize rule).")
+            lines.append("# TYPE tpujob_mfu gauge")
+            for key in sorted(mfu_means):
+                lines.append('tpujob_mfu{job="%s"} %.6f'
+                             % (esc(key), mfu_means[key]))
+            lines.append("# HELP tpujob_fleet_effective_flops Goodput-"
+                         "seconds weighted by MFU x chip peak, summed "
+                         "over the fleet (the number the arbiter and "
+                         "the bench trajectory optimize).")
+            lines.append("# TYPE tpujob_fleet_effective_flops gauge")
+            lines.append("tpujob_fleet_effective_flops %.6g"
+                         % effective_flops)
         return "\n".join(lines)
 
     # -- internals (all called with self._lock held) ---------------------

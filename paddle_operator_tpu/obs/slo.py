@@ -46,8 +46,10 @@ from ..k8s.runtime import escape_label_value
 
 #: objectives with built-in sources (docs/observability.md):
 #: goodput_ratio (ledger), time_to_running (JobMetrics),
-#: step_latency_p99 (worker step profiles) — plus anything custom.
-KNOWN_OBJECTIVES = ("goodput_ratio", "time_to_running", "step_latency_p99")
+#: step_latency_p99 (worker step profiles), mfu (the ledger's worker
+#: MFU samples, ISSUE 13) — plus anything custom.
+KNOWN_OBJECTIVES = ("goodput_ratio", "time_to_running",
+                    "step_latency_p99", "mfu")
 
 
 @dataclass(frozen=True)
@@ -115,7 +117,10 @@ def parse_slo_spec(text: str) -> SloSpec:
 
 def default_slos() -> List[SloSpec]:
     """The stock fleet SLO set wired by the harness and the manager:
-    goodput, admission latency, and worker step latency."""
+    goodput, admission latency, worker step latency, and hardware
+    efficiency (MFU — the goodput ratio says the chip was BUSY, MFU
+    says it was busy doing model FLOPs; see docs/observability.md
+    "Hardware efficiency")."""
     return [
         SloSpec("goodput", "goodput_ratio", target=0.5, comparator=">=",
                 budget=0.25),
@@ -123,6 +128,10 @@ def default_slos() -> List[SloSpec]:
                 comparator="<=", budget=0.2),
         SloSpec("step-latency", "step_latency_p99", target=1.0,
                 comparator="<=", budget=0.1),
+        # a modest floor: a v5e ResNet run sits ~0.4, a silent CPU
+        # fallback at ~1e-5 — the SLO burns on sustained inefficiency
+        # while the ledger's collapse floor catches the acute case
+        SloSpec("mfu", "mfu", target=0.05, comparator=">=", budget=0.25),
     ]
 
 

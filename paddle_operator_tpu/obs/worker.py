@@ -213,6 +213,12 @@ _WORKER_GAUGES = [
      "Prestaged batches/windows waiting in the input pipeline.", "gauge"),
     ("tpujob_worker_goodput_ratio",
      "Productive step-dispatch time over wall time.", "gauge"),
+    ("tpujob_worker_mfu",
+     "Model FLOP/s utilization at the last readback-synced boundary "
+     "(achieved step FLOP/s over the chip's peak).", "gauge"),
+    ("tpujob_worker_arithmetic_intensity",
+     "FLOPs per HBM byte of the compiled train step (roofline x-axis).",
+     "gauge"),
 ]
 
 _WORKER_COUNTERS = [
@@ -245,6 +251,7 @@ class WorkerMetricsServer:
         self._step_stats: Dict[str, Dict[str, float]] = {}
         self._badput: Dict[str, float] = {}
         self._counters: Dict[str, int] = {}
+        self._hbm: Dict[str, float] = {}
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -308,6 +315,13 @@ class WorkerMetricsServer:
         with self._lock:
             self._badput = {k: float(v) for k, v in badput.items()}
 
+    def set_hbm(self, stats: Dict[str, float]) -> None:
+        """Publish a live device-memory sample
+        (:func:`~.hardware.device_memory_stats`: ``in_use`` / ``peak``
+        / ``limit`` bytes) — empty dict clears the family."""
+        with self._lock:
+            self._hbm = {k: float(v) for k, v in stats.items()}
+
     def inc(self, family: str, n: int = 1) -> None:
         """Bump a declared counter (``tpujob_straggler_total``)."""
         with self._lock:
@@ -322,6 +336,7 @@ class WorkerMetricsServer:
             step_stats = {k: dict(v) for k, v in self._step_stats.items()}
             badput = dict(self._badput)
             counters = dict(self._counters)
+            hbm = dict(self._hbm)
         lines: List[str] = []
         for name, help_text, mtype in _WORKER_GAUGES:
             short = name[len("tpujob_worker_"):]
@@ -369,6 +384,14 @@ class WorkerMetricsServer:
                 lines.append(
                     'tpujob_worker_badput_seconds_total{cause="%s"} %.6f'
                     % (escape_label_value(cause), badput[cause]))
+        if hbm:
+            lines.append("# HELP tpujob_worker_hbm_bytes Live device-"
+                         "memory sample (device.memory_stats).")
+            lines.append("# TYPE tpujob_worker_hbm_bytes gauge")
+            for kind in sorted(hbm):
+                lines.append(
+                    'tpujob_worker_hbm_bytes{kind="%s"} %s'
+                    % (escape_label_value(kind), format_value(hbm[kind])))
         for name, help_text, mtype in _WORKER_COUNTERS:
             if name not in counters:
                 continue

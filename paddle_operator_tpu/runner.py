@@ -25,6 +25,9 @@ import numpy as np
 from . import compile_cache
 from .data import DeferredMetrics, ShardedLoader, job_window_source
 from .launch import ElasticAgent, LaunchConfig, detect_env, initialize_distributed
+from .obs.hardware import (
+    HardwarePlane, StepCost, analytic_cost, resolve_chip, step_cost_of,
+)
 from .obs.worker import (
     StepProfiler, StragglerDetector, ThroughputBaseline, median,
 )
@@ -220,6 +223,14 @@ class TrainJob:
     # result["straggler_events"].
     gang_p50_source: Optional[Callable[[float], Dict[Any, float]]] = None
     straggler_k: float = 2.0
+    # analytic per-step cost fallback for the hardware-efficiency plane
+    # (obs.hardware): when XLA's cost model is unavailable on the
+    # compiled step (interpret-mode backends, exotic wrappers), these
+    # closed-form figures keep MFU/roofline reporting alive — stamped
+    # cost_source="analytic" so a reader never mistakes provenance.
+    # None + no cost model = MFU suppressed, never invented.
+    flops_per_step: Optional[float] = None
+    bytes_per_step: Optional[float] = None
     seed: int = 0
 
 
@@ -238,6 +249,11 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
     # ladder (AOT executable -> persistent XLA cache -> fresh jit), so a
     # preempted/resized job's restart pays milliseconds, not a recompile
     compile_cache.enable_persistent_cache()
+
+    # declared-guard runtime check (analysis/guards.py): no-op unless
+    # TPUJOB_RACE_DETECT instruments the locks — the PR 12 pattern,
+    # applied to every shared-state holder this function builds
+    from .analysis.guards import guard_declared
 
     result: Dict[str, Any] = {"cycles": 0}
     ckpt_writer = AsyncCheckpointer() if job.async_checkpoint else None
@@ -264,7 +280,8 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         from .obs import WorkerMetricsServer
 
         try:
-            metrics_srv = WorkerMetricsServer(":%d" % metrics_port).start()
+            metrics_srv = guard_declared(
+                WorkerMetricsServer(":%d" % metrics_port)).start()
         except (OSError, OverflowError) as e:
             # OverflowError: CPython raises it (not OSError) for a port
             # outside 0-65535
@@ -293,6 +310,18 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
     badput_acc: Dict[str, float] = {}
     result["straggler_events"] = 0
     result["backend_degraded_events"] = 0
+    # hardware-efficiency plane (docs/observability.md "Hardware
+    # efficiency"): chip capability resolved once per process, the
+    # per-step cost installed per cycle from the compiled step itself
+    try:
+        _hw_dev = jax.devices()[0]
+    except Exception:
+        _hw_dev = None
+    hw = guard_declared(HardwarePlane(resolve_chip(_hw_dev),
+                                      device=_hw_dev))
+    if job.flops_per_step:
+        hw.set_cost(analytic_cost(job.flops_per_step,
+                                  job.bytes_per_step or 0.0))
 
     def add_badput(cause: str, seconds: float) -> None:
         if seconds > 0:
@@ -403,6 +432,45 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         # (memo/aot/compiled/jit) — the resume-cost story in one field
         result.setdefault("compile_sources", []).append(
             getattr(step_fn, "source", "jit"))
+        # per-step FLOPs/bytes from the compiled executable itself
+        # (trace-only probe — no second compile), with a persisted-cost
+        # rung riding the compile-cache fingerprint: a warm restart
+        # served from the AOT/memo rung reads the cold run's figures
+        # back instead of re-tracing the step (the probe must not hand
+        # back startup tax the cache removed). Analytic fallback
+        # (TrainJob.flops_per_step) or suppression when unavailable.
+        try:
+            fp = str(getattr(step_fn, "fingerprint", "") or "")
+            cost = None
+            if fp:
+                raw = compile_cache.load_step_cost(fp)
+                if raw and float(raw.get("flops") or 0) > 0:
+                    cost = StepCost(
+                        float(raw["flops"]),
+                        max(0.0, float(raw.get("bytes") or 0.0)),
+                        str(raw.get("source") or "cost_analysis"))
+            if cost is None:
+                def _sds(x: Any, lead: Optional[int] = None) -> Any:
+                    shape = tuple(getattr(x, "shape", ()))
+                    if lead is not None:
+                        shape = (lead,) + shape
+                    return jax.ShapeDtypeStruct(
+                        shape, getattr(x, "dtype", jnp.float32))
+
+                abstract_batch = jax.tree_util.tree_map(
+                    functools.partial(_sds, lead=K if K > 1 else None),
+                    sample)
+                abstract_state = jax.tree_util.tree_map(_sds, state)
+                cost = step_cost_of(step_fn, abstract_state,
+                                    abstract_batch, steps_per_call=K)
+                if cost is not None and fp:
+                    compile_cache.save_step_cost(fp, {
+                        "flops": cost.flops,
+                        "bytes": cost.bytes_accessed,
+                        "source": cost.source})
+            hw.set_cost(cost)
+        except Exception:
+            pass  # telemetry must never take the training run down
         single_fn = None  # tail windows shorter than K, built lazily
 
         def make_single_fn():
@@ -503,7 +571,18 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                     examples_per_second=rate * examples_per_step,
                     loss=float(host["loss"]),
                     loader_queue_depth=loader.queue_depth(),
+                    # hardware-efficiency gauges: MFU at this boundary's
+                    # readback-synced rate (None = suppressed, not
+                    # invented — and intensity needs MEASURED bytes: an
+                    # analytic cost with no bytes figure must not export
+                    # a 0.0 that reads as "extremely memory-bound")
+                    mfu=hw.mfu_of_rate(rate),
+                    arithmetic_intensity=(
+                        hw.cost.arithmetic_intensity
+                        if hw.cost.source != "unavailable"
+                        and hw.cost.bytes_accessed > 0 else None),
                 )
+                metrics_srv.set_hbm(hw.sample_hbm())
 
         # Input pipeline: batches/windows are built by a background
         # producer (and, single-process, prestaged on device with the
@@ -545,11 +624,14 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             add_badput("data_stall", wait)
             return batch, wait
 
-        def dispatch(fn, fetched, at_step):
+        def dispatch(fn, fetched, at_step, span=1):
             """One step_fn/single_fn call, with the host gap between
             consecutive dispatches (batch wait + logging + checkpoint
             time) recorded as the `dispatch_gap` stage and the per-step
-            phases (data_wait, dispatch) in the bounded profiler ring."""
+            phases (data_wait, dispatch) in the bounded profiler ring.
+            ``span`` is the optimizer steps this one call executes (K
+            for a fused window) — the hardware plane banks them against
+            the dispatch seconds for the MFU totals."""
             nonlocal t_dispatched
             batch, data_wait = fetched
             if t_dispatched is not None:
@@ -560,6 +642,7 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             t_dispatched = time.perf_counter()
             profiler.record(at_step, data_wait=data_wait,
                             dispatch=t_dispatched - t_d0)
+            hw.record(span, t_dispatched - t_d0)
             return out
 
         def straggler_check(at_step):
@@ -600,7 +683,8 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 if k_here == K:
                     # full window (K>1) or plain per-step batch (K==1),
                     # prestaged by the loader
-                    state, metrics = dispatch(step_fn, fetch(), step)
+                    state, metrics = dispatch(step_fn, fetch(), step,
+                                              span=K)
                     if K > 1:
                         # fused metrics come back stacked [K]; report the last
                         metrics = jax.tree_util.tree_map(
@@ -728,6 +812,12 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             min(1.0, goodput_acc["step"] / goodput_acc["wall"]), 4)
     result["compile_cache"] = compile_cache.startup_block()
     result["step_profile"] = profiler.stats()
+    # hardware-efficiency block (obs.hardware): self-conserving by
+    # construction (total_flops == flops_per_step x steps) and mirrored
+    # into the trace (hardware_block event) so obs_report --hardware
+    # rebuilds the fleet MFU/roofline picture offline
+    hw.sample_hbm()
+    result["hardware"] = hw.emit_trace()
     # -- worker-local goodput attribution (the runner half of the
     # operator's goodput ledger; docs/observability.md "Goodput & SLOs").
     # Conservation is structural: wall == goodput + Σ badput, with the

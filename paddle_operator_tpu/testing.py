@@ -116,6 +116,9 @@ class OperatorHarness:
             self.slo.add_source(
                 lambda: [("time_to_running", s) for s in self.job_metrics
                          .pop_time_to_running_samples()])
+            self.slo.add_source(
+                lambda: [("mfu", v) for v in self.job_metrics
+                         .ledger.job_mfu().values()])
         # Production release channel: a real CoordinationServer on localhost;
         # the pod simulator polls it over real HTTP like the init container.
         coord_url = ""

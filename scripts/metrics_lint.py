@@ -102,6 +102,14 @@ def selftest_text() -> str:
         h.job_metrics.ledger.observe_throughput("default", "lint-tpu",
                                                 1000.0)
     h.job_metrics.ledger.observe_throughput("default", "lint-tpu", 0.4)
+    # worker MFU samples (hardware-efficiency plane, ISSUE 13): healthy
+    # samples then a collapse, so tpujob_mfu + the fleet effective-FLOPs
+    # gauge populate AND the never-normalize exclusion is linted live
+    for _ in range(3):
+        h.job_metrics.ledger.observe_mfu("default", "lint-tpu", 0.38,
+                                         peak_flops=197e12)
+    h.job_metrics.ledger.observe_mfu("default", "lint-tpu", 2e-5,
+                                     peak_flops=197e12)
     # ... and the feedback loop ACTS on the collapse: the next converge
     # runs the budget-free re-schedule, populating the sched_feedback
     # decision counter the same way production would
@@ -127,6 +135,9 @@ def selftest_text() -> str:
                 "tpujob_fleet_goodput_ratio",
                 "tpujob_backend_degraded_total",
                 "tpujob_slo_burn_rate",
+                # the hardware-efficiency plane (ISSUE 13)
+                "tpujob_mfu",
+                "tpujob_fleet_effective_flops",
                 # the observe->decide loop (ISSUE 11)
                 "tpujob_sched_feedback_total"):
         assert "# TYPE %s" % fam in text, "selftest lost %s" % fam
@@ -162,12 +173,21 @@ def selftest_worker_text() -> str:
         srv.set_badput({"data_stall": 0.004, "checkpoint": 0.016,
                         'evil"cause\\x': 0.001})
         srv.inc("tpujob_straggler_total")
+        # hardware-efficiency gauges (ISSUE 13): MFU + arithmetic
+        # intensity through the same update path the runner uses, and a
+        # device-memory sample (adversarial kind label proves escaping)
+        srv.update(mfu=0.42, arithmetic_intensity=3.3)
+        srv.set_hbm({"in_use": 1.5e9, "peak": 2.1e9, "limit": 16e9,
+                     'evil"kind\\x': 1.0})
         text = srv.metrics_text()
     finally:
         srv.stop()
     for fam in ("tpujob_worker_step_phase_seconds",
                 "tpujob_worker_badput_seconds_total",
-                "tpujob_straggler_total"):
+                "tpujob_straggler_total",
+                "tpujob_worker_mfu",
+                "tpujob_worker_arithmetic_intensity",
+                "tpujob_worker_hbm_bytes"):
         assert "# TYPE %s" % fam in text, "worker selftest lost %s" % fam
     return text
 

@@ -18,6 +18,14 @@ resize, coordination release, watch restart — in one ordered timeline, so
 ``--job`` filters to one job (``namespace/name``). ``-v`` includes every
 reconcile span (default: only state-changing entries). Exit code is 0 when
 a timeline was produced, 2 when the inputs contain nothing reportable.
+
+``--hardware`` (the fourth ``make obs`` lane) rebuilds the fleet
+MFU/roofline picture from the trace's ``hardware_block`` /
+``mfu_sample`` / ``mfu_collapse`` events alone and re-checks the
+hardware conservation invariant offline (``total_flops ==
+flops_per_step x steps``, MFU a valid ratio derivable from the block's
+own totals, every degraded sample explained by a collapse event) —
+exit 1 on any inconsistency.
 """
 
 from __future__ import annotations
@@ -307,6 +315,126 @@ def render_waterfall(jkey: str, buckets: Dict[str, float]) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# hardware-efficiency lane (ISSUE 13): rebuild the fleet MFU/roofline
+# picture from trace alone and re-check hardware-block conservation
+# ---------------------------------------------------------------------------
+
+def hardware_entries(records: List[dict], job: Optional[str] = None
+                     ) -> Tuple[List[dict], Dict[str, List[dict]],
+                                Dict[str, int]]:
+    """Collect the hardware-plane trace events: ``hardware_block``
+    (the runner/bench end-of-run block, mirrored flat), ``mfu_sample``
+    (every worker MFU observation the ledger accepted, with its
+    degraded flag), and ``mfu_collapse`` (the trigger firing). Returns
+    ``(blocks, samples-by-job, collapse-counts-by-job)``."""
+    blocks: List[dict] = []
+    samples: Dict[str, List[dict]] = {}
+    collapses: Dict[str, int] = {}
+    for rec in records:
+        name = rec.get("name")
+        if name not in ("hardware_block", "mfu_sample", "mfu_collapse"):
+            continue
+        attrs = dict(rec.get("attrs") or {})
+        jkey = attrs.get("job")
+        if not _matches(jkey, job):
+            continue
+        if name == "hardware_block":
+            blocks.append(attrs)
+        elif name == "mfu_sample":
+            samples.setdefault(jkey or "-", []).append(attrs)
+        else:
+            collapses[jkey or "-"] = collapses.get(jkey or "-", 0) + 1
+    return blocks, samples, collapses
+
+
+def hardware_violations(blocks: List[dict],
+                        samples: Dict[str, List[dict]],
+                        collapses: Dict[str, int]) -> List[str]:
+    """The offline re-check: every hardware block must conserve
+    (``total_flops == flops_per_step x steps``, MFU in [0, 1] and
+    derivable from the block's own totals — obs.hardware.
+    conservation_violations, the same audit the runner tests run),
+    every MFU sample must be a valid ratio, and a job whose samples
+    went degraded must carry the collapse event that explains why —
+    otherwise the trigger is not reconstructable from trace."""
+    from paddle_operator_tpu.obs.hardware import conservation_violations
+
+    errs: List[str] = []
+    for i, blk in enumerate(blocks):
+        label = "hardware block %d (%s)" % (
+            i, blk.get("job") or blk.get("device_kind") or "?")
+        errs.extend(conservation_violations(blk, label=label))
+    for jkey in sorted(samples):
+        evs = samples[jkey]
+        for ev in evs:
+            mfu = float(ev.get("mfu") or 0.0)
+            if not 0.0 <= mfu <= 1.0:
+                errs.append("%s: mfu sample %.6g outside [0, 1]"
+                            % (jkey, mfu))
+        if any(ev.get("degraded") for ev in evs) \
+                and not collapses.get(jkey):
+            errs.append("%s: degraded mfu samples but no mfu_collapse "
+                        "event (the trigger is not reconstructable "
+                        "from trace)" % jkey)
+    return errs
+
+
+def render_hardware(blocks: List[dict], samples: Dict[str, List[dict]],
+                    collapses: Dict[str, int]) -> str:
+    """The fleet MFU/roofline picture, rebuilt from trace alone: per-job
+    healthy-mean MFU (degraded samples excluded, mirroring the ledger's
+    never-normalize rule) and every reported hardware block."""
+    lines = ["Hardware efficiency (rebuilt from trace alone)",
+             "----------------------------------------------"]
+    if not blocks and not samples:
+        lines.append("(no hardware_block / mfu_sample events in the "
+                     "trace)")
+        return "\n".join(lines)
+    for jkey in sorted(samples):
+        evs = samples[jkey]
+        healthy = [float(e.get("mfu") or 0.0) for e in evs
+                   if not e.get("degraded")]
+        degraded = len(evs) - len(healthy)
+        mean = sum(healthy) / len(healthy) if healthy else 0.0
+        lines.append(
+            "  %-24s mfu=%.4f over %d healthy sample(s) "
+            "(%d degraded, %d collapse(s))"
+            % (jkey, mean, len(healthy), degraded,
+               collapses.get(jkey, 0)))
+    for blk in blocks:
+        mfu = blk.get("mfu")
+        lines.append(
+            "  block %-18s %-4s %-13s mfu=%-8s %.6g FLOP/step x %s "
+            "step(s) [%s/%s]"
+            % (blk.get("job") or blk.get("device_kind") or "?",
+               blk.get("backend", "?"), blk.get("roofline", "?"),
+               ("%.4f" % float(mfu)) if mfu is not None else "n/a",
+               float(blk.get("flops_per_step") or 0.0),
+               blk.get("steps"), blk.get("peak_source", "?"),
+               blk.get("cost_source", "?")))
+    return "\n".join(lines)
+
+
+def hardware_lane(records: List[dict], job: Optional[str] = None
+                  ) -> Tuple[int, str]:
+    """The whole --hardware lane over loaded trace records: returns
+    ``(exit_code, rendered_text)`` — 1 on a conservation violation, 2
+    when the trace carries no hardware telemetry at all."""
+    blocks, samples, collapses = hardware_entries(records, job=job)
+    out = [render_hardware(blocks, samples, collapses)]
+    errs = hardware_violations(blocks, samples, collapses)
+    if errs:
+        out.append("HARDWARE CONSERVATION VIOLATIONS:")
+        out.extend("  " + e for e in errs)
+        return 1, "\n".join(out)
+    if not blocks and not samples:
+        return 2, "\n".join(out)
+    out.append("hardware conservation: ok (%d block(s), %d job(s) "
+               "sampled)" % (len(blocks), len(samples)))
+    return 0, "\n".join(out)
+
+
 #: the inputs each sched_feedback action must carry for the decision to
 #: be reconstructable from trace alone (ISSUE 11 acceptance): a decision
 #: event missing its inputs fails the --decisions lane
@@ -443,7 +571,8 @@ def render_report(timeline: List[dict], metrics_text: str = "",
 # chaos mode
 # ---------------------------------------------------------------------------
 
-def run_chaos(scenario: str, seed: int, verbose: bool) -> int:
+def run_chaos(scenario: str, seed: int, verbose: bool,
+              hardware: bool = False) -> int:
     """Run one chaos scenario with tracing enabled, then report each
     job's timeline from the trace + recorded events. ``multi_tenant``
     runs the fleet-scheduler harness and reports the feedback-decision
@@ -535,6 +664,17 @@ def run_chaos(scenario: str, seed: int, verbose: bool) -> int:
                 print("  " + e)
             return 1
         print("waterfall conservation: ok (%d job(s))" % len(buckets))
+    if hardware:
+        # the hardware-efficiency lane (`make obs`, fourth leg): fleet
+        # MFU/roofline rebuilt from the trace ALONE, conservation and
+        # trigger-reconstructability re-checked offline
+        print()
+        hw_rc, text = hardware_lane(records)
+        print(text)
+        if hw_rc == 2:
+            print("(expected hardware telemetry in a %s run)" % scenario)
+        if hw_rc != 0:
+            return hw_rc
     return rc
 
 
@@ -560,12 +700,19 @@ def main(argv=None) -> int:
                          "remediate / boost) with its inputs from the "
                          "trace alone (exit 1 when a decision is not "
                          "reconstructable)")
+    ap.add_argument("--hardware", action="store_true",
+                    help="also rebuild the fleet MFU/roofline picture "
+                         "from the trace's hardware_block / mfu_sample "
+                         "events and re-check the hardware conservation "
+                         "invariant (total_flops == flops_per_step x "
+                         "steps; exit 1 on violation)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="include every reconcile span")
     args = ap.parse_args(argv)
 
     if args.chaos:
-        return run_chaos(args.chaos, args.seed, args.verbose)
+        return run_chaos(args.chaos, args.seed, args.verbose,
+                         hardware=args.hardware)
     if not args.trace and not args.events:
         ap.error("need --trace and/or --events (or --chaos)")
     records = load_trace(args.trace) if args.trace else []
@@ -602,6 +749,12 @@ def main(argv=None) -> int:
             print("WATERFALL CONSERVATION VIOLATIONS:")
             for e in errs:
                 print("  " + e)
+            return 1
+    if args.hardware:
+        print()
+        hw_rc, text = hardware_lane(records, job=args.job)
+        print(text)
+        if hw_rc == 1:
             return 1
     return 0 if timeline else 2
 

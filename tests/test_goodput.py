@@ -260,6 +260,11 @@ def test_obs_state_bounded_under_job_churn():
         h.create_job(api.new_tpujob(name, spec={"worker": role_spec(1)}))
         h.converge()
         assert h.get_job(name).phase == api.Phase.RUNNING
+        # hardware-efficiency samples (ISSUE 13): MFU series — including
+        # a collapse episode's state — must ride the same terminal GC
+        h.job_metrics.ledger.observe_mfu("default", name, 0.4,
+                                         peak_flops=197e12)
+        h.job_metrics.ledger.observe_mfu("default", name, 2e-5)
         h.client.delete(api.KIND, "default", name)
         h.converge()
         # at most the one live job's series exist at any point
@@ -267,9 +272,12 @@ def test_obs_state_bounded_under_job_churn():
         assert h.job_metrics.ledger.job_count() <= 1
     assert h.job_metrics.job_count() == 0
     assert h.job_metrics.ledger.job_count() == 0
+    assert h.job_metrics.ledger.job_mfu() == {}
+    assert h.job_metrics.ledger.mfu_collapse_counts() == {}
     assert h.job_metrics.flight.ring_count() == 0
     text = h.manager.metrics_text()
     assert 'job="default/churn-' not in text
+    assert "tpujob_mfu" not in text
     assert parse_exposition(text) == []
 
 
