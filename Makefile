@@ -59,7 +59,8 @@ race:
 	  tests/test_feedback.py tests/test_goodput.py \
 	  tests/test_hardware.py \
 	  tests/test_helper.py tests/test_hostport_elastic_server.py \
-	  tests/test_http_client.py tests/test_informer.py \
+	  tests/test_http_client.py tests/test_incidents.py \
+	  tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
 	  tests/test_observability.py tests/test_ops9xx.py \
 	  tests/test_reconciler.py \
@@ -104,6 +105,12 @@ sched:
 #                  hardware_block / mfu_sample events, hardware-block
 #                  conservation (total_flops == flops_per_step x steps)
 #                  and MFU-collapse reconstructability re-checked offline
+#                  ... and the causal-incident lane (ISSUE 14): every
+#                  recovery incident's cross-process chain rebuilt from
+#                  trace alone, each chain's MTTR stage sum cross-
+#                  validated against the goodput ledger's badput episode
+#                  for the same incident id — exit 1 on an orphan span,
+#                  broken chain, dropped propagation, or ledger mismatch
 #   metrics-lint — strict text-exposition validation of a live
 #                  Manager.metrics_text() AND WorkerMetricsServer
 #                  .metrics_text() with every provider registered,
@@ -116,6 +123,8 @@ obs:
 	$(PY) scripts/obs_report.py --chaos goodput_audit --seed 1
 	$(PY) scripts/obs_report.py --chaos multi_tenant --seed 1 --decisions
 	$(PY) scripts/obs_report.py --chaos goodput_audit --seed 1 --hardware
+	$(PY) scripts/obs_report.py --chaos goodput_audit --seed 1 --incidents
+	$(PY) scripts/obs_report.py --chaos multi_tenant --seed 1 --incidents
 
 metrics-lint:
 	$(PY) scripts/metrics_lint.py --selftest

@@ -71,8 +71,14 @@ SPECS: Tuple[GuardSpec, ...] = (
                "_max_normal_behind_high")),
     GuardSpec("paddle_operator_tpu.obs.hardware", "HardwarePlane", "_lock",
               ("_steps", "_step_seconds", "_hbm")),
+    GuardSpec("paddle_operator_tpu.obs.incidents", "IncidentRegistry",
+              "_lock",
+              ("_open", "_armed", "_counts", "_hist", "_hist_sum",
+               "_hist_count", "_stage_totals", "_mttr_pending",
+               "_closed_log")),
     GuardSpec("paddle_operator_tpu.obs.ledger", "GoodputLedger", "_lock",
-              ("_state", "_buckets", "_pending", "_episodes", "_ran",
+              ("_state", "_buckets", "_pending", "_episodes",
+               "_episode_open", "_episode_log", "_ran",
                "_finished", "_first", "_last", "_tput", "_degraded",
                "_degraded_total", "_mfu", "_mfu_degraded", "_hw_mfu",
                "_hw_peak", "_mfu_collapse_total")),

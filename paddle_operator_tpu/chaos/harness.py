@@ -447,6 +447,16 @@ class ChaosHarness:
                 extra["audit_mfu"] = round(mean, 4)
             extra["audit_mfu_collapses"] = \
                 ledger.mfu_collapse_counts().get("default/audit", 0)
+            # the causal-incident plane (ISSUE 14) joins the fingerprint:
+            # how many incidents closed per inception cause and the MTTR
+            # seconds per recovery stage are tick-clock-deterministic
+            # replayable facts (incident IDS are process-unique and
+            # deliberately excluded)
+            reg = self.h.job_metrics.incidents
+            for cause, n in sorted(reg.incident_counts().items()):
+                extra["audit_incidents_%s" % cause] = n
+            for stage, s in sorted(reg.stage_totals().items()):
+                extra["audit_mttr_%s" % stage] = round(s, 3)
             # mirror the audit worker's hardware block into the trace
             # (the runner does this at end-of-run; here the harness
             # stands in for it) so `obs_report --hardware` rebuilds the
@@ -603,6 +613,47 @@ class ChaosHarness:
         if stray:
             out.append("bystander charged badput it never incurred: %r"
                        % sorted(stray))
+        out.extend(self._audit_incidents(counts))
+        return out
+
+    def _audit_incidents(self, counts: Dict[str, int]) -> List[str]:
+        """The event-plane half of the goodput audit (ISSUE 14): every
+        injected fault produced an incident chain, every chain closed,
+        and — the tentpole invariant — each closed incident's MTTR
+        stage sum reconciles with the ledger's badput episode sharing
+        its incident id (conservation between the event plane and the
+        time plane, on the exact tick clock)."""
+        out: List[str] = []
+        reg = self.h.job_metrics.incidents
+        ledger = self.h.job_metrics.ledger
+        closed = reg.closed_incidents()
+        inc_counts = reg.incident_counts()
+        if reg.open_count():
+            out.append("%d incident(s) still open at quiescence — the "
+                       "chain never completed" % reg.open_count())
+        if counts.get("graceful_drain") and \
+                not inc_counts.get("drain"):
+            out.append("graceful drain injected but no drain-cause "
+                       "incident closed (%r)" % inc_counts)
+        if counts.get("pod_preempt") and not closed:
+            out.append("hard preemption injected but no incident "
+                       "closed at all")
+        episodes: Dict[str, List[dict]] = {}
+        for ep in ledger.episode_log():
+            episodes.setdefault(ep["incident"], []).append(ep)
+        for inc in closed:
+            eps = episodes.get(inc["incident"])
+            if not eps:
+                out.append("incident %s has no ledger episode — the "
+                           "time plane never saw it" % inc["incident"])
+                continue
+            ep_s = sum(e["badput_s"] for e in eps)
+            if abs(inc["total_s"] - ep_s) > 1e-6:
+                out.append(
+                    "incident %s (%s) stage sum %.6fs != ledger episode "
+                    "badput %.6fs — event/time plane conservation broken"
+                    % (inc["incident"], inc["cause"], inc["total_s"],
+                       ep_s))
         return out
 
     def check_invariants(self, converged: bool, ticks: int) -> List[str]:

@@ -605,6 +605,17 @@ def run_tenant_scenario(plan: ChaosPlan) -> ChaosReport:
     }
     for action, n in sorted(fb_counts.items()):
         extra["feedback_%s" % action] = n
+    # the causal-incident plane (ISSUE 14): closed-incident counts per
+    # inception cause and per-stage MTTR seconds from the arbitrated run
+    # are tick-clock-deterministic replayable facts (ids excluded)
+    reg = fair.h.job_metrics.incidents
+    if reg.open_count():
+        violations.append("%d incident chain(s) still open at "
+                          "quiescence" % reg.open_count())
+    for cause, n in sorted(reg.incident_counts().items()):
+        extra["incidents_%s" % cause] = n
+    for stage, s in sorted(reg.stage_totals().items()):
+        extra["mttr_%s" % stage] = round(s, 3)
     jobs = fair.job_states()
     converged = all(st["completed"] is not None
                     for st in fair.jobs.values())

@@ -185,6 +185,14 @@ ANNOT_SCHED_EVICT = "batch.tpujob.dev/sched-evict"
 # restored when fleet pressure subsides.
 ANNOT_SCHED_RESTORE_NP = "batch.tpujob.dev/sched-restore-np"
 
+# Pod annotation carrying the encoded incident span context
+# (utils.trace.SpanContext) for pods created while their job's recovery
+# incident is still open: the runner adopts it from the matching
+# TPUJOB_TRACE_CONTEXT env var, and a RESTARTED operator re-reads it
+# here to re-adopt the in-flight incident — the causal chain survives
+# the process that minted it (docs/observability.md "Incident tracing").
+ANNOT_TRACE_CONTEXT = "batch.tpujob.dev/trace-context"
+
 
 def event_lane(etype: str, obj: dict) -> str:
     """Workqueue priority lane for a watch event (the ``lane_for`` hook

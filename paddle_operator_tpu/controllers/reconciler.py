@@ -25,7 +25,7 @@ from ..k8s import objects as k8s
 from ..k8s.client import EventRecorder, KubeClient
 from ..k8s.errors import ApiError, ConflictError, NotFoundError
 from ..obs import JobMetrics, ObservedEventRecorder, incident_cause
-from ..utils.trace import tracer
+from ..utils.trace import SpanContext, tracer
 from . import helper
 from .hostport import PortRangeAllocator
 
@@ -234,6 +234,19 @@ class TpuJobReconciler:
 
         # -- status derivation (reference :122-131) ---------------------
         status_changed = self._sync_current_status(job, child_pods)
+
+        # -- incident-context adoption (operator restart survival) ------
+        # A restarted operator loses the incident registry with the rest
+        # of its memory; mid-incident, the context it minted survives on
+        # the job + pods (ANNOT_TRACE_CONTEXT) — re-adopt it so the
+        # causal chain keeps its id across the restart. AFTER status
+        # derivation, so the Running gate sees the FRESH phase: a crash
+        # that left the persisted phase Running while the pods are
+        # already dead must adopt NOW, before the restart hooks below
+        # would mint a fresh id and fork the chain. BEFORE observe_phase,
+        # so the rebuilt ledger's first phase observation already sees
+        # the re-opened episode's pending cause.
+        self._adopt_trace_context(job, child_pods)
         # observe the freshly derived phase (no-op when unchanged): this
         # is the one site every phase transition flows through, so the
         # phase gauge / time-in-phase histogram / flight recorder see the
@@ -246,6 +259,12 @@ class TpuJobReconciler:
                 return self._requeue_error((namespace, name))
             except NotFoundError:
                 return Result()
+
+        # keep the job-level trace-context annotation current (stamp
+        # while an incident is open, strip once recovered) so a
+        # restarted operator adopts the newest incident, not whatever a
+        # stale pod annotation remembers
+        self._sync_trace_annotation(job)
 
         # -- elastic preemption: whole-slice restart (SURVEY §7) --------
         if job.elastic is not None:
@@ -589,6 +608,13 @@ class TpuJobReconciler:
         if not self.arbiter.stamp_evict(job.namespace, job.name):
             return self._requeue_error((job.namespace, job.name))
         fb.commit_remediation(job.namespace, job.name, action)
+        # incident inception (feedback decision): the drain this
+        # decision commissions books a scheduler eviction — arm the
+        # finer cause label so the incident the drain opens reads
+        # regang/remediate, not a generic evict
+        self.obs.incidents.arm(
+            job.namespace, job.name,
+            "regang" if action.get("action") == "regang" else "remediate")
         if action.get("action") == "regang":
             reason, what = "SchedFeedbackRegang", (
                 "worker %s flagged as the gang straggler for %s "
@@ -608,6 +634,96 @@ class TpuJobReconciler:
         for pod in targets:
             self.arbiter.evictor(pod, self.arbiter.drain_grace)
         return Result(requeue=True)
+
+    def _adopt_trace_context(self, job: api.TpuJob,
+                             child_pods: List[dict]) -> None:
+        """Re-adopt an in-flight incident when this process has none
+        (fresh registry after an operator restart). The JOB-level
+        trace-context annotation (kept current by
+        :meth:`_sync_trace_annotation`: stamped at inception, stripped
+        after close) is authoritative — it always names the NEWEST
+        incident, where a pod's annotation names whatever incident
+        recreated that pod and can be stale. Pods are the fallback for
+        the stamp-lost-in-a-crash window. Only while the job is NOT
+        Running — a steady job's pods legitimately carry the context of
+        the (closed) incident that created them, and resurrecting that
+        id is only correct while a recovery is actually in flight; the
+        rare hook-less recovery (pods deleted outright) re-using the
+        previous id is by design (``incident_restored`` marks the
+        re-open, and the ledger re-opens its episode under the same id,
+        so the cross-validation stays episode-wise exact)."""
+        if job.phase in (api.Phase.RUNNING, api.Phase.COMPLETED,
+                         api.Phase.FAILED):
+            # steady or terminal: any context on the pods belongs to a
+            # finished incident — resurrecting it would open a chain
+            # nothing will ever close
+            return
+        if self.obs.incidents.context(job.namespace, job.name) is not None:
+            return
+        ctx = SpanContext.decode((job.metadata.get("annotations") or {})
+                                 .get(helper.ANNOT_TRACE_CONTEXT))
+        if ctx is not None:
+            self.obs.restore_incident(job.namespace, job.name, ctx)
+            return
+        # Pod-annotation fallback: ONLY on this process's first sight of
+        # the job (the restart window where the job-level stamp may have
+        # been lost with the crash). Once this process has observed the
+        # job, the actively-maintained job annotation is the sole
+        # authority — pods keep the context of whatever incident created
+        # them forever, and adopting one mid-run would resurrect a
+        # CLOSED incident onto a new fault.
+        if self.obs.has_seen(job.namespace, job.name):
+            return
+        for pod in child_pods:
+            enc = (pod["metadata"].get("annotations") or {}).get(
+                helper.ANNOT_TRACE_CONTEXT)
+            ctx = SpanContext.decode(enc)
+            if ctx is not None:
+                self.obs.restore_incident(job.namespace, job.name, ctx)
+                return
+
+    def _sync_trace_annotation(self, job: api.TpuJob) -> None:
+        """Keep the JOB's trace-context annotation equal to the open
+        incident: stamped (bounded conflict retry, fresh GET per
+        attempt, best-effort) while one is open, stripped once the job
+        is back to Running with none — so a restarted operator adopts
+        the CURRENT incident, never a closed one a stale pod annotation
+        still remembers. Both writes are episodic (once per incident),
+        the same write budget as ANNOT_SCHED_EVICT."""
+        ctx = self.obs.incidents.context(job.namespace, job.name)
+        annots = job.metadata.get("annotations") or {}
+        have = annots.get(helper.ANNOT_TRACE_CONTEXT)
+        if ctx is None:
+            if have is not None and job.phase == api.Phase.RUNNING:
+                old = SpanContext.decode(have)
+                if old is not None and not self.obs.incidents.was_closed(
+                        old.incident_id):
+                    # this process never saw that incident close — a
+                    # freshly restarted operator whose kubelet state has
+                    # not caught up yet must not strip the annotation it
+                    # may be about to adopt (undecodable garbage is
+                    # stripped regardless)
+                    return
+                self._strip_job_annotation(job,
+                                           helper.ANNOT_TRACE_CONTEXT)
+            return
+        enc = ctx.encode()
+        if have == enc:
+            return
+        for _attempt in range(4):
+            try:
+                cur = self.client.get(api.KIND, job.namespace, job.name)
+            except NotFoundError:
+                return
+            cur["metadata"].setdefault("annotations", {})[
+                helper.ANNOT_TRACE_CONTEXT] = enc
+            try:
+                self.client.update(cur)
+            except ConflictError:
+                continue
+            job.metadata.setdefault("annotations", {})[
+                helper.ANNOT_TRACE_CONTEXT] = enc
+            return
 
     def _count_restart_durably(self, job: api.TpuJob, field: str) -> None:
         """Increment a restart counter with bounded retry and a fresh GET
@@ -947,6 +1063,20 @@ class TpuJobReconciler:
             env.append({"name": "PADDLE_ELASTIC_SERVER", "value": eps})
             env.append({"name": "TPUJOB_ELASTIC_SERVER", "value": eps})
 
+        # Incident-context propagation (docs/observability.md "Incident
+        # tracing"): a pod created while its job's recovery incident is
+        # open carries the operator-minted span context — the runner
+        # adopts it from the env var and stamps its restore/compile/
+        # first-step trace events; the annotation is what a restarted
+        # operator re-reads to keep the chain's id.
+        ctx = self.obs.incidents.context(job.namespace, job.name)
+        if ctx is not None:
+            enc = ctx.encode()
+            pod["metadata"].setdefault("annotations", {})[
+                helper.ANNOT_TRACE_CONTEXT] = enc
+            pod["spec"]["containers"][0].setdefault("env", []).append(
+                {"name": "TPUJOB_TRACE_CONTEXT", "value": enc})
+
         k8s.set_controller_reference(job.obj, pod)
         try:
             self._create_resource(job, pod)
@@ -1097,11 +1227,18 @@ class TpuJobReconciler:
             self._delete_resource(job, svc)
             return
 
+    def _incident_attrs(self, job: api.TpuJob) -> Dict[str, str]:
+        """``{"incident": id}`` while the job's recovery incident is
+        open (create/delete spans join the causal chain), else empty."""
+        ctx = self.obs.incidents.context(job.namespace, job.name)
+        return {} if ctx is None else {"incident": ctx.incident_id}
+
     def _create_resource(self, job: api.TpuJob, obj: dict) -> None:
         kind, name = obj.get("kind", ""), obj["metadata"]["name"]
         try:
             with tracer().span("create", kind=kind, obj=name,
-                               job=job.name, namespace=job.namespace):
+                               job=job.name, namespace=job.namespace,
+                               **self._incident_attrs(job)):
                 self.client.create(obj)
         except ApiError as e:
             self.recorder.event(
@@ -1117,7 +1254,8 @@ class TpuJobReconciler:
         ns = obj["metadata"].get("namespace", "default")
         try:
             with tracer().span("delete", kind=kind, obj=name,
-                               job=job.name, namespace=job.namespace):
+                               job=job.name, namespace=job.namespace,
+                               **self._incident_attrs(job)):
                 self.client.delete(kind, ns, name)
         except NotFoundError:
             return

@@ -418,6 +418,9 @@ def main(argv=None):
         slo.add_source(lambda: [
             ("mfu", v)
             for v in job_metrics.ledger.job_mfu().values()])
+        slo.add_source(lambda: [
+            ("mttr", s)
+            for s in job_metrics.incidents.pop_mttr_samples()])
         mgr.add_metrics_provider(slo.metrics_block)
         if arbiter is not None and arbiter.feedback is not None:
             # SLO-burn-driven replanning: burn_rates() feeds the bounded

@@ -26,6 +26,10 @@ Layout:
   registry, device-memory sampling, MFU + roofline classification) and
   :class:`MfuBaseline` (the absolute-floor MFU-collapse detector the
   ledger aggregates worker samples through).
+* :mod:`.incidents` — :class:`IncidentRegistry`: the causal incident-
+  tracing plane (ISSUE 14) — cross-process span contexts minted at every
+  incident inception site, MTTR decomposed into named stages, and the
+  episode↔incident cross-validation against the goodput ledger.
 * :mod:`.exposition` — :func:`parse_exposition` (the strict validator
   both scrape surfaces run through) and formatting helpers.
 
@@ -39,6 +43,9 @@ from .hardware import (  # noqa: F401
     CHIP_PEAKS, MFU_COLLAPSE_FLOOR, ChipSpec, HardwarePlane, MfuBaseline,
     StepCost, analytic_cost, clamped_mfu, device_memory_stats,
     resolve_chip, roofline_class, step_cost_of,
+)
+from .incidents import (  # noqa: F401
+    INCIDENT_CAUSES, INCIDENT_STAGES, MTTR_BUCKETS, IncidentRegistry,
 )
 from .ledger import BADPUT_CAUSES, GOODPUT, GoodputLedger  # noqa: F401
 from .metrics import (  # noqa: F401
@@ -55,7 +62,9 @@ from .worker import (  # noqa: F401
 )
 
 __all__ = [
-    "BADPUT_CAUSES", "CHIP_PEAKS", "GOODPUT", "MFU_COLLAPSE_FLOOR",
+    "BADPUT_CAUSES", "CHIP_PEAKS", "GOODPUT", "INCIDENT_CAUSES",
+    "INCIDENT_STAGES", "IncidentRegistry", "MFU_COLLAPSE_FLOOR",
+    "MTTR_BUCKETS",
     "PHASE_BUCKETS", "RESTART_CAUSES",
     "STEP_PHASES", "STRAGGLER_K", "ChipSpec", "FlightRecorder",
     "GoodputLedger", "HardwarePlane",

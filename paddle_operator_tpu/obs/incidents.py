@@ -1,0 +1,427 @@
+"""IncidentRegistry — the causal incident-tracing plane (event plane).
+
+The goodput ledger (the *time* plane) prices every badput second, but a
+recovery incident's causal chain — arbiter eviction decision → drain
+notice → checkpoint cut → pod delete → reschedule → restore → recompile
+→ first good step — was scattered across two uncorrelated per-process
+trace files. This registry is the operator-side half of the fix
+(Dapper-style: one id per incident, propagated through every hop):
+
+* an :class:`~..utils.trace.SpanContext` is **minted** at every incident
+  inception site (graceful drain, hard preemption, scheduler eviction,
+  feedback remediation / re-gang; an elastic resize *arms* a cause label
+  the next restart-shaped incident consumes);
+* the context is **propagated** operator→runner through the pod env
+  (``TPUJOB_TRACE_CONTEXT``) and the ``batch.tpujob.dev/trace-context``
+  pod annotation — the annotation survives an operator restart, so a
+  rebuilt process re-adopts the in-flight incident instead of losing the
+  chain (:meth:`restore`);
+* every downstream trace event is **stamped** with the incident id
+  (explicitly on the operator side, ambiently in the runner), so
+  ``scripts/obs_report.py --incidents`` rebuilds each incident as one
+  cross-process tree from the JSONL files alone;
+* per-incident **MTTR decomposes into named stages**
+
+      detect | drain | ckpt | reschedule | restore | compile | warmup
+
+  driven by the same phase transitions the status subresource sees
+  (stage boundaries share ONE clock read, so the stage sum partitions
+  the open→close window exactly), exported as
+  ``tpujob_incident_recovery_seconds{cause,stage}`` histograms +
+  ``tpujob_incidents_total{cause}``, with closed-incident MTTR totals
+  drained into the ``mttr`` SLO (burn-rate machinery, obs.slo);
+* the tentpole invariant is **cross-validation against the ledger**:
+  the registry opens and closes at the exact hooks the ledger's badput
+  episode opens and closes on the same clock, so each incident's stage
+  sum must equal the ledger's episode badput for the same incident id —
+  conservation *between the event plane and the time plane*, audited in
+  chaos and re-checked offline by the ``--incidents`` lane.
+
+Stage durations here partition operator-observed wall clock; the runner
+additionally reports its own restore/compile/warmup seconds as
+``incident_stage`` events with ``plane="runner"`` — chain members for
+the offline rebuild, deliberately NOT folded into the operator stage
+sum (they overlap the operator's reschedule/restore window; folding
+them in would double-count and break the ledger reconciliation).
+
+Everything stdlib-only, clock-injectable (chaos drives the harness tick
+clock so incident counts and MTTR stage totals join the deterministic
+replay fingerprint), thread-safe (all state under ``self._lock``; trace
+emission outside it), and bounded (:meth:`forget` on terminal-job GC).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from ..k8s.runtime import escape_label_value
+from ..utils.trace import SpanContext, tracer
+from .exposition import format_float
+
+#: the MTTR stage taxonomy (docs/observability.md "Incident tracing")
+INCIDENT_STAGES = (
+    "detect",      # fault observed, incident owned (hard preemptions)
+    "drain",       # grace window: pods Terminating, final checkpoints cut
+    "ckpt",        # checkpoint save observed inside the incident window
+    "reschedule",  # gang gone, waiting for capacity / recreation
+    "restore",     # pods back (Starting), state restoring
+    "compile",     # runner-reported: step (re)build — trace plane only
+    "warmup",      # Running observed → first good step
+)
+
+#: incident inception causes (the {cause} label)
+INCIDENT_CAUSES = ("drain", "preempt", "evict", "remediate", "regang",
+                   "resize", "crash")
+
+#: which freshly-opened causes an ARMED cause label may override: a
+#: resize arm explains the restart it cues (preempt/crash shapes); a
+#: feedback remediation/re-gang arm explains ONLY the scheduler
+#: eviction it commissions (the commissioned path always opens
+#: evict-shaped: observe_sched_eviction fires before observe_drain) —
+#: never a plain graceful drain, so node maintenance landing between
+#: the decision and the arbiter's eviction keeps its own cause.
+_ARM_CONSUMES: Dict[str, Tuple[str, ...]] = {
+    "resize": ("preempt", "crash"),
+    "remediate": ("evict",),
+    "regang": ("evict",),
+}
+
+#: MTTR stage buckets: harness ticks land in the small ones, real
+#: recoveries (restore + recompile) in the minutes range
+MTTR_BUCKETS = (0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0)
+
+#: how long (registry-clock seconds) an armed cause label stays valid
+ARM_TTL_S = 300.0
+
+# process-wide id sequence: unique across registry rebuilds in one
+# process (the multi_tenant chaos replay runs three harnesses into one
+# trace file); the pid component separates real operator incarnations
+_SEQ = itertools.count(1)
+
+
+def _job_key(namespace: str, name: str) -> str:
+    return "%s/%s" % (namespace, name)
+
+
+def _mint_id(name: str, cause: str) -> str:
+    return "i%d-%d-%s-%s" % (os.getpid(), next(_SEQ), name, cause)
+
+
+class IncidentRegistry:
+    """Per-job open-incident state + MTTR accounting (operator side)."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        # job key -> {"ctx", "stage", "since", "t0", "stages": {s: sec}}
+        self._open: Dict[str, Dict[str, Any]] = {}
+        # job key -> (cause, armed-at): consumed by the next matching open
+        self._armed: Dict[str, Tuple[str, float]] = {}
+        self._counts: Dict[str, int] = {}          # closed, by cause
+        # (cause, stage) -> bucket counts [.., +Inf]; plus sum/count
+        self._hist: Dict[Tuple[str, str], List[int]] = {}
+        self._hist_sum: Dict[Tuple[str, str], float] = {}
+        self._hist_count: Dict[Tuple[str, str], int] = {}
+        self._stage_totals: Dict[str, float] = {}  # fleet, by stage
+        # drainable MTTR samples (the ``mttr`` SLO source) + the bounded
+        # closed-incident log the chaos audit reconciles with the ledger
+        self._mttr_pending: Deque[float] = deque(maxlen=1024)
+        self._closed_log: Deque[Dict[str, Any]] = deque(maxlen=256)
+
+    # -- inception --------------------------------------------------------
+
+    def open(self, namespace: str, name: str, cause: str) -> SpanContext:
+        """Mint (or return the already-open) incident for this job.
+        First inception wins — a drain notice followed by the restart it
+        cues is ONE incident, mirroring the ledger's episode rule."""
+        if cause not in INCIDENT_CAUSES:
+            cause = "crash"
+        key = _job_key(namespace, name)
+        emit: Optional[Dict[str, Any]] = None
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is not None:
+                return rec["ctx"]  # type: ignore[no-any-return]
+            armed = self._armed.get(key)
+            if armed is not None:
+                armed_cause, t_armed = armed
+                now = self._clock()
+                if now - t_armed > ARM_TTL_S:
+                    del self._armed[key]
+                elif cause in _ARM_CONSUMES.get(armed_cause, ()):
+                    cause = armed_cause
+                    del self._armed[key]
+            ctx = SpanContext(_mint_id(name, cause), cause, key)
+            now = self._clock()
+            stage = "drain" if cause in ("drain", "evict", "remediate",
+                                         "regang") else "detect"
+            self._open[key] = {"ctx": ctx, "stage": stage, "since": now,
+                               "t0": now, "stages": {}}
+            emit = {"incident": ctx.incident_id, "cause": cause,
+                    "job": key, "stage": stage}
+        if emit is not None:
+            tracer().event("incident_open", **emit)
+        return ctx
+
+    def restore(self, namespace: str, name: str,
+                ctx: SpanContext) -> SpanContext:
+        """Re-adopt an in-flight incident from a pod annotation after an
+        operator restart: the chain keeps its id (and its cause), the
+        clock restarts in this process — the rebuilt ledger restarts its
+        episode at the same hook, so the two planes stay reconciled over
+        the window this process can observe."""
+        key = _job_key(namespace, name)
+        emit: Optional[Dict[str, Any]] = None
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is not None:
+                return rec["ctx"]  # type: ignore[no-any-return]
+            # sanitize the annotation-sourced cause BEFORE storing: the
+            # close path labels metrics with ctx.cause, and a mangled
+            # annotation must never mint an out-of-taxonomy label
+            cause = ctx.cause if ctx.cause in INCIDENT_CAUSES else "crash"
+            ctx = SpanContext(ctx.incident_id, cause, key)
+            now = self._clock()
+            self._open[key] = {"ctx": ctx, "stage": "reschedule",
+                               "since": now, "t0": now, "stages": {}}
+            emit = {"incident": ctx.incident_id, "cause": cause,
+                    "job": key, "stage": "reschedule"}
+        if emit is not None:
+            tracer().event("incident_restored", **emit)
+        return ctx
+
+    def arm(self, namespace: str, name: str, cause: str) -> None:
+        """Pre-label the NEXT matching incident's cause without starting
+        its clock: an elastic resize arms ``resize`` for the restart it
+        cues; a feedback decision arms ``remediate``/``regang`` for the
+        scheduler drain it commissions (see ``_ARM_CONSUMES``)."""
+        if cause not in _ARM_CONSUMES:
+            return
+        key = _job_key(namespace, name)
+        with self._lock:
+            self._armed[key] = (cause, self._clock())
+
+    # -- stage machine ----------------------------------------------------
+
+    def context(self, namespace: str, name: str) -> Optional[SpanContext]:
+        with self._lock:
+            rec = self._open.get(_job_key(namespace, name))
+            return None if rec is None else rec["ctx"]  # type: ignore[no-any-return]
+
+    def stage(self, namespace: str, name: str, stage: str) -> None:
+        """Enter a named stage (no-op without an open incident, or when
+        already in it). ONE clock read closes the old stage and opens
+        the new one, so stage durations partition the incident window
+        exactly — the property the ledger cross-validation rides."""
+        if stage not in INCIDENT_STAGES:
+            return
+        key = _job_key(namespace, name)
+        emit: Optional[Dict[str, Any]] = None
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is None or rec["stage"] == stage:
+                return
+            now = self._clock()
+            emit = self._close_stage_locked(rec, now)
+            rec["stage"] = stage
+            rec["since"] = now
+        if emit is not None:
+            tracer().event("incident_stage", **emit)
+
+    def on_phase(self, namespace: str, name: str, phase: str) -> None:
+        """The operator-side stage machine, fed from the one site every
+        phase transition flows through (JobMetrics.observe_phase):
+        Running closes the incident (recovery is over — the same
+        transition that flips the ledger back to goodput), a terminal
+        phase closes it unresolved, Starting means the gang is back and
+        restoring, any other non-running phase means rescheduling."""
+        if phase == "Running":
+            self.close(namespace, name, resolved=True)
+        elif phase in ("Completed", "Failed"):
+            self.close(namespace, name, resolved=False)
+        elif phase == "Starting":
+            self.stage(namespace, name, "restore")
+        elif phase:
+            self.stage(namespace, name, "reschedule")
+
+    def close(self, namespace: str, name: str,
+              resolved: bool = True) -> Optional[Dict[str, Any]]:
+        """Close the open incident (if any): bank every stage into the
+        MTTR histograms, queue the MTTR sample for the SLO, log the
+        closed incident for the chaos audit, and emit the final
+        ``incident_stage`` + ``incident_close`` trace events."""
+        key = _job_key(namespace, name)
+        emits: List[Tuple[str, Dict[str, Any]]] = []
+        closed: Optional[Dict[str, Any]] = None
+        with self._lock:
+            rec = self._open.pop(key, None)
+            if rec is None:
+                return None
+            now = self._clock()
+            last = self._close_stage_locked(rec, now)
+            if last is not None:
+                emits.append(("incident_stage", last))
+            ctx: SpanContext = rec["ctx"]
+            cause = ctx.cause or "crash"
+            stages: Dict[str, float] = rec["stages"]
+            total = sum(stages.values())
+            for stage, dur in stages.items():
+                self._observe_hist_locked(cause, stage, dur)
+                self._stage_totals[stage] = \
+                    self._stage_totals.get(stage, 0.0) + dur
+            self._counts[cause] = self._counts.get(cause, 0) + 1
+            if resolved:
+                # only COMPLETED recoveries feed the mttr SLO: a job
+                # deleted (or gone terminal) mid-outage never reached a
+                # first good step, and its partial duration would skew
+                # the burn windows both ways
+                self._mttr_pending.append(total)
+            closed = {
+                "incident": ctx.incident_id, "job": key, "cause": cause,
+                "total_s": round(total, 6), "resolved": resolved,
+                "stages": {s: round(d, 6)
+                           for s, d in sorted(stages.items())},
+            }
+            self._closed_log.append(closed)
+            emits.append(("incident_close", dict(closed)))
+        for name_, attrs in emits:
+            tracer().event(name_, **attrs)
+        return closed
+
+    # -- readout ----------------------------------------------------------
+
+    def incident_counts(self) -> Dict[str, int]:
+        """Closed incidents by cause (chaos fingerprint surface)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def stage_totals(self) -> Dict[str, float]:
+        """Fleet-wide closed-incident seconds by stage (fingerprint)."""
+        with self._lock:
+            return dict(self._stage_totals)
+
+    def closed_incidents(self) -> List[Dict[str, Any]]:
+        """The bounded closed-incident log (chaos audit: each entry must
+        reconcile with the ledger episode sharing its incident id)."""
+        with self._lock:
+            return [dict(e) for e in self._closed_log]
+
+    def was_closed(self, incident_id: str) -> bool:
+        """Whether THIS process closed the incident (bounded lookback).
+        The reconciler strips the job-level context annotation only for
+        incidents it saw close — a freshly restarted process must not
+        mistake "not yet adopted" for "over" and strip the annotation
+        it is about to adopt from."""
+        with self._lock:
+            return any(e["incident"] == incident_id
+                       for e in self._closed_log)
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open)
+
+    def pop_mttr_samples(self) -> List[float]:
+        """Drain closed-incident MTTR totals — the ``mttr`` SLO source
+        consumes them at evaluation."""
+        with self._lock:
+            out = list(self._mttr_pending)
+            self._mttr_pending.clear()
+        return out
+
+    def job_count(self) -> int:
+        """Jobs with live incident state (churn-boundedness checks)."""
+        with self._lock:
+            return len(set(self._open) | set(self._armed))
+
+    def forget(self, namespace: str, name: str) -> None:
+        """Terminal-job GC: a job deleted mid-incident closes its chain
+        (resolved=False) — the ledger closes its episode at the same
+        hook, so the trace stays reconstructable — then per-job state
+        drops (the cause/stage aggregates are label-bounded by the
+        fixed taxonomies: kept)."""
+        self.close(namespace, name, resolved=False)
+        key = _job_key(namespace, name)
+        with self._lock:
+            self._armed.pop(key, None)
+
+    # -- exposition -------------------------------------------------------
+
+    def metrics_block(self) -> str:
+        """Text-exposition lines (no trailing newline); merged into the
+        operator scrape by :meth:`~.metrics.JobMetrics.metrics_block`."""
+        with self._lock:
+            counts = dict(self._counts)
+            hist = {k: list(v) for k, v in self._hist.items()}
+            hist_sum = dict(self._hist_sum)
+            hist_count = dict(self._hist_count)
+        lines: List[str] = []
+        if counts:
+            lines.append("# HELP tpujob_incidents_total Recovery "
+                         "incidents closed (causal chains reconstructed "
+                         "end-to-end), by inception cause.")
+            lines.append("# TYPE tpujob_incidents_total counter")
+            for cause in INCIDENT_CAUSES:
+                if cause in counts:
+                    lines.append(
+                        'tpujob_incidents_total{cause="%s"} %d'
+                        % (escape_label_value(cause), counts[cause]))
+        if hist:
+            lines.append("# HELP tpujob_incident_recovery_seconds Per-"
+                         "incident MTTR decomposed into named recovery "
+                         "stages (operator-observed wall clock).")
+            lines.append("# TYPE tpujob_incident_recovery_seconds "
+                         "histogram")
+            for cause, stage in sorted(hist):
+                counts_b = hist[(cause, stage)]
+                for i, le in enumerate(MTTR_BUCKETS):
+                    lines.append(
+                        'tpujob_incident_recovery_seconds_bucket'
+                        '{cause="%s",stage="%s",le="%s"} %d'
+                        % (cause, stage, format_float(le), counts_b[i]))
+                lines.append(
+                    'tpujob_incident_recovery_seconds_bucket'
+                    '{cause="%s",stage="%s",le="+Inf"} %d'
+                    % (cause, stage, counts_b[-1]))
+                lines.append(
+                    'tpujob_incident_recovery_seconds_sum'
+                    '{cause="%s",stage="%s"} %.6f'
+                    % (cause, stage, hist_sum[(cause, stage)]))
+                lines.append(
+                    'tpujob_incident_recovery_seconds_count'
+                    '{cause="%s",stage="%s"} %d'
+                    % (cause, stage, hist_count[(cause, stage)]))
+        return "\n".join(lines)
+
+    # -- internals (called with self._lock held) --------------------------
+
+    def _close_stage_locked(self, rec: Dict[str, Any],
+                            now: float) -> Optional[Dict[str, Any]]:
+        dur = max(0.0, now - rec["since"])
+        stage: str = rec["stage"]
+        rec["since"] = now
+        if dur <= 0.0:
+            return None
+        stages: Dict[str, float] = rec["stages"]
+        stages[stage] = stages.get(stage, 0.0) + dur
+        ctx: SpanContext = rec["ctx"]
+        return {"incident": ctx.incident_id, "job": ctx.job,
+                "stage": stage, "dur_s": round(dur, 6),
+                "plane": "operator"}
+
+    def _observe_hist_locked(self, cause: str, stage: str,
+                             seconds: float) -> None:
+        key = (cause, stage)
+        counts = self._hist.get(key)
+        if counts is None:
+            counts = self._hist[key] = [0] * (len(MTTR_BUCKETS) + 1)
+        for i, le in enumerate(MTTR_BUCKETS):
+            if seconds <= le:
+                counts[i] += 1
+        counts[-1] += 1  # +Inf
+        self._hist_sum[key] = self._hist_sum.get(key, 0.0) + seconds
+        self._hist_count[key] = self._hist_count.get(key, 0) + 1

@@ -60,7 +60,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..k8s.runtime import escape_label_value
 from ..utils.trace import tracer
@@ -131,6 +132,18 @@ class GoodputLedger:
         # the badput predictor divides recovery badput by this to price
         # "one more preemption of this job"
         self._episodes: Dict[str, int] = {}
+        # episode↔incident linkage (the event-plane cross-validation,
+        # docs/observability.md "Incident tracing"): the OPEN episode per
+        # job accumulates the badput seconds banked while it is live
+        # (segment banking only — charges move already-banked goodput and
+        # are deliberately excluded, time that passed before the incident
+        # must not inflate its episode), keyed by the incident id the
+        # registry minted; closed episodes land in a bounded log and a
+        # ``ledger_episode`` trace event, so the registry's stage sum can
+        # be reconciled against the ledger both at runtime (chaos audit)
+        # and offline (obs_report --incidents).
+        self._episode_open: Dict[str, Dict[str, Any]] = {}
+        self._episode_log: Deque[Dict[str, Any]] = deque(maxlen=256)
         # jobs that have reached Running at least once (first Pending
         # stretch is sched_wait; later ones are incident recovery)
         self._ran: set = set()
@@ -164,11 +177,13 @@ class GoodputLedger:
         """Fed from the one site every phase transition flows through
         (:meth:`~.metrics.JobMetrics.observe_phase` forwards here)."""
         key = _job_key(namespace, name)
+        episode: Optional[Dict[str, Any]] = None
         with self._lock:
             if key in self._finished:
                 return
             if phase in _PHASE_TERMINAL:
                 emit = self._close_locked(key)
+                episode = self._close_episode_locked(key)
                 self._state.pop(key, None)
                 self._pending.pop(key, None)
                 self._finished.add(key)
@@ -179,21 +194,37 @@ class GoodputLedger:
                           if key in self._degraded
                           or key in self._mfu_degraded else GOODPUT)
                 emit = self._enter_locked(key, bucket)
+                # recovery is over: the episode closes on the SAME
+                # transition (and the same clock read sequence) the
+                # incident registry closes its stage machine on, so the
+                # two planes' sums reconcile exactly
+                episode = self._close_episode_locked(key)
             else:  # Pending / Starting / Restarting / unknown
-                if key not in self._ran:
-                    bucket = "sched_wait"
-                else:
-                    bucket = self._pending.get(key, "restore")
+                # a pending incident cause wins even when this process
+                # never saw the job Running: a restarted operator
+                # re-opens the episode via note_incident BEFORE the
+                # first phase observation, and its recovery seconds
+                # must stay attributed to the incident's cause, not be
+                # demoted to first-admission sched_wait
+                bucket = self._pending.get(key)
+                if bucket is None:
+                    bucket = ("sched_wait" if key not in self._ran
+                              else "restore")
                 emit = self._enter_locked(key, bucket)
         self._emit_segments(key, emit)
+        if episode is not None:
+            tracer().event("ledger_episode", **episode)
 
-    def note_incident(self, namespace: str, name: str, cause: str) -> None:
+    def note_incident(self, namespace: str, name: str, cause: str,
+                      incident: str = "") -> None:
         """An incident hook fired (drain notice, arbiter eviction, hard
         preemption): badput starts NOW — the gang is already dying even
         while the phase still reads Running — and the stretch until the
         job is Running again stays charged to this cause. The first
         incident of an episode wins (a drain notice followed by the
-        restart it cues is one ``drain`` episode, not drain+restore)."""
+        restart it cues is one ``drain`` episode, not drain+restore).
+        ``incident`` is the registry-minted incident id this episode is
+        cross-validated against (empty for legacy callers)."""
         if cause not in BADPUT_CAUSES:
             cause = "restore"
         key = _job_key(namespace, name)
@@ -206,6 +237,10 @@ class GoodputLedger:
                 self._pending[key] = cause
                 self._episodes[key] = self._episodes.get(key, 0) + 1
                 emit = self._enter_locked(key, cause)
+                # opened AFTER _enter_locked: the close of the previous
+                # (pre-incident) segment must not leak into this episode
+                self._episode_open[key] = {"incident": incident,
+                                           "cause": cause, "s": 0.0}
         self._emit_segments(key, emit)
 
     def charge(self, namespace: str, name: str, cause: str,
@@ -469,6 +504,13 @@ class GoodputLedger:
             return {"episodes": episodes, "recovery_s": recovery,
                     "open_bucket": open_bucket, "open_s": open_s}
 
+    def episode_log(self) -> List[Dict[str, Any]]:
+        """Closed badput episodes (bounded), each carrying the incident
+        id the registry minted — the chaos audit reconciles every closed
+        incident's stage sum against the matching entry here."""
+        with self._lock:
+            return [dict(e) for e in self._episode_log]
+
     def job_count(self) -> int:
         """Jobs with live ledger series (churn-boundedness checks)."""
         with self._lock:
@@ -478,13 +520,21 @@ class GoodputLedger:
 
     def forget_job(self, namespace: str, name: str) -> None:
         """Terminal-job GC: drop every per-job series so 10k-job churn
-        shows no monotonic growth in label cardinality."""
+        shows no monotonic growth in label cardinality. A job deleted
+        MID-INCIDENT closes its open badput episode here (the incident
+        registry closes its chain at the same hook), so the trace never
+        carries an episode that just stops — the --incidents lane would
+        rightly read that as a broken chain."""
         key = _job_key(namespace, name)
+        episode: Optional[Dict[str, Any]] = None
         with self._lock:
+            emit = self._close_locked(key)
+            episode = self._close_episode_locked(key)
             self._state.pop(key, None)
             self._buckets.pop(key, None)
             self._pending.pop(key, None)
             self._episodes.pop(key, None)
+            self._episode_open.pop(key, None)
             self._ran.discard(key)
             self._finished.discard(key)
             self._first.pop(key, None)
@@ -497,6 +547,9 @@ class GoodputLedger:
             self._hw_mfu.pop(key, None)
             self._hw_peak.pop(key, None)
             self._mfu_collapse_total.pop(key, None)
+        self._emit_segments(key, emit)
+        if episode is not None:
+            tracer().event("ledger_episode", **episode)
 
     # -- exposition ------------------------------------------------------
 
@@ -612,9 +665,29 @@ class GoodputLedger:
             return []
         buckets = self._buckets.setdefault(key, {})
         buckets[bucket] = buckets.get(bucket, 0.0) + dur
+        # episode accumulation rides segment banking only: badput
+        # seconds that really passed while the episode was live — a
+        # charge() moving PRE-incident goodput into a cause must not
+        # inflate the episode (charges call _close_locked first, so the
+        # open badput stretch itself still lands here correctly)
+        ep = self._episode_open.get(key)
+        if ep is not None and bucket != GOODPUT:
+            ep["s"] += dur
         total = sum(buckets.values())
         return [{"cause": bucket, "dur_s": round(dur, 6),
                  "total_s": round(total, 6)}]
+
+    def _close_episode_locked(self, key: str) -> Optional[Dict[str, Any]]:
+        """Pop the open episode (if any) into the bounded log; returns
+        the ``ledger_episode`` trace record to emit after the lock
+        drops. Called AFTER the final badput segment was banked."""
+        ep = self._episode_open.pop(key, None)
+        if ep is None:
+            return None
+        rec = {"job": key, "incident": ep["incident"],
+               "cause": ep["cause"], "badput_s": round(ep["s"], 6)}
+        self._episode_log.append(rec)
+        return dict(rec)
 
     def _snapshot_locked(self, key: str) -> Dict[str, Any]:
         buckets = dict(self._buckets.get(key, {}))

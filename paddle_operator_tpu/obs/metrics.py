@@ -29,8 +29,9 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..api.types import Phase
 from ..k8s.runtime import escape_label_value
-from ..utils.trace import tracer
+from ..utils.trace import SpanContext, tracer
 from .exposition import format_float
+from .incidents import IncidentRegistry
 from .ledger import GoodputLedger
 
 log = logging.getLogger("tpujob.obs")
@@ -163,6 +164,11 @@ class JobMetrics:
         #: shares the injected clock so chaos stays deterministic
         self.ledger = ledger if ledger is not None \
             else GoodputLedger(clock=clock)
+        #: the causal incident-tracing plane (docs/observability.md
+        #: "Incident tracing"): minted at the same hooks that open the
+        #: ledger's badput episodes, on the same clock, so the two
+        #: planes cross-validate
+        self.incidents = IncidentRegistry(clock=clock)
 
     # -- feeding hooks (reconciler / coordination server) ----------------
 
@@ -190,8 +196,16 @@ class JobMetrics:
         old = prev[0] if prev else ""
         self.flight.record(namespace, name, "phase",
                            **{"from": old, "to": phase})
+        ctx = self.incidents.context(namespace, name)
         tracer().event("phase_transition", job=key,
-                       **{"from": old, "to": phase})
+                       **dict({"from": old, "to": phase},
+                              **({"incident": ctx.incident_id}
+                                 if ctx is not None else {})))
+        # the incident stage machine and the ledger episode ride the
+        # SAME transition (and the same tick of the injected clock), so
+        # the event plane's stage sum and the time plane's episode
+        # badput reconcile exactly
+        self.incidents.on_phase(namespace, name, phase)
         self.ledger.observe_phase(namespace, name, phase)
 
     def observe_restart(self, namespace: str, name: str, cause: str) -> None:
@@ -202,20 +216,36 @@ class JobMetrics:
             self._restarts[(key, cause)] = \
                 self._restarts.get((key, cause), 0) + 1
         self.flight.record(namespace, name, "restart", cause=cause)
-        tracer().event("restart", job=key, cause=cause)
+        # incident inception (hard preemption / app crash): mint the
+        # span context — first inception wins, so a restart cued by a
+        # drain notice joins the already-open drain incident
+        ctx = self.incidents.open(
+            namespace, name,
+            "preempt" if cause == "preemption" else "crash")
+        tracer().event("restart", job=key, cause=cause,
+                       incident=ctx.incident_id)
         # a hard preemption's recovery stretch is restore-from-checkpoint
         # time (the drain/eviction hooks fire BEFORE this one when the
         # incident was graceful, and the first incident of an episode
         # wins inside the ledger)
-        self.ledger.note_incident(namespace, name, "restore")
+        self.ledger.note_incident(namespace, name, "restore",
+                                  incident=ctx.incident_id)
 
     def observe_resize(self, namespace: str, name: str,
                        np: Optional[int] = None) -> None:
         key = job_key(namespace, name)
         with self._lock:
             self._resizes[key] = self._resizes.get(key, 0) + 1
+            running = self._phase.get(key, ("", 0.0))[0] == Phase.RUNNING
         self.flight.record(namespace, name, "resize", np=np)
         tracer().event("elastic_resize", job=key, np=np)
+        if running:
+            # resizing a LIVE job cues a whole-slice restart at the next
+            # cycle boundary: arm the cause label so that restart-shaped
+            # incident (if one is observed) reads `resize`, not a
+            # generic preempt. The initial np publish of a job that has
+            # not run yet is bring-up, not a resize incident.
+            self.incidents.arm(namespace, name, "resize")
 
     def observe_release(self, namespace: str, name: str, pod: str,
                         waited_s: float) -> None:
@@ -237,8 +267,11 @@ class JobMetrics:
         with self._lock:
             self._drains[key] = self._drains.get(key, 0) + 1
         self.flight.record(namespace, name, "drain", pods=pods)
-        tracer().event("drain_notice", job=key, pods=pods)
-        self.ledger.note_incident(namespace, name, "drain")
+        ctx = self.incidents.open(namespace, name, "drain")
+        tracer().event("drain_notice", job=key, pods=pods,
+                       incident=ctx.incident_id)
+        self.ledger.note_incident(namespace, name, "drain",
+                                  incident=ctx.incident_id)
 
     def observe_sched_eviction(self, namespace: str, name: str) -> None:
         """The fleet arbiter preempted this job (ANNOT_SCHED_EVICT drain
@@ -248,8 +281,12 @@ class JobMetrics:
             self._sched_evictions[key] = \
                 self._sched_evictions.get(key, 0) + 1
         self.flight.record(namespace, name, "sched_evicted")
-        tracer().event("sched_evicted", job=key)
-        self.ledger.note_incident(namespace, name, "eviction")
+        # cause `evict` unless a feedback decision armed a finer label
+        # (remediate / regang) for the drain it commissioned
+        ctx = self.incidents.open(namespace, name, "evict")
+        tracer().event("sched_evicted", job=key, incident=ctx.incident_id)
+        self.ledger.note_incident(namespace, name, "eviction",
+                                  incident=ctx.incident_id)
 
     def observe_gang_stranded(self, namespace: str, name: str) -> None:
         """A startup-release failure left the gang stuck in its init
@@ -267,6 +304,9 @@ class JobMetrics:
         with self._lock:
             self._ckpt_saves[key] = self._ckpt_saves.get(key, 0) + 1
         self.flight.record(namespace, name, "checkpoint_save", step=step)
+        # a save landing inside an open incident is the drain's final
+        # checkpoint cut: a named MTTR stage (no-op otherwise)
+        self.incidents.stage(namespace, name, "ckpt")
 
     def observe_checkpoint_corrupt(self, namespace: str, name: str,
                                    step: int) -> None:
@@ -291,6 +331,27 @@ class JobMetrics:
                            reason=reason, message=message)
         tracer().event("k8s_event", job=key, type=etype, reason=reason,
                        message=message)
+
+    def restore_incident(self, namespace: str, name: str,
+                         ctx: SpanContext) -> None:
+        """Re-adopt an in-flight incident after an operator restart (the
+        reconciler re-read the context from a pod annotation): the
+        registry keeps the chain's id, and the rebuilt ledger re-opens a
+        badput episode under the SAME id at the same hook — so the two
+        planes stay reconciled over the window this process observes."""
+        self.incidents.restore(namespace, name, ctx)
+        ledger_cause = {"drain": "drain", "evict": "eviction",
+                        "remediate": "eviction",
+                        "regang": "eviction"}.get(ctx.cause, "restore")
+        self.ledger.note_incident(namespace, name, ledger_cause,
+                                  incident=ctx.incident_id)
+
+    def has_seen(self, namespace: str, name: str) -> bool:
+        """Whether THIS process has observed the job before (any phase
+        observation). False right after an operator restart — the
+        window where pod-annotation incident adoption is legitimate."""
+        with self._lock:
+            return job_key(namespace, name) in self._first_seen
 
     def pop_time_to_running_samples(self) -> List[float]:
         """Drain the pending first-Running latencies (seconds) — the
@@ -320,6 +381,9 @@ class JobMetrics:
             for k in [k for k in self._restarts if k[0] == key]:
                 del self._restarts[k]
         self.flight.forget(namespace, name)
+        # registry first: the chain's incident_close must precede the
+        # ledger_episode it reconciles with in the trace stream
+        self.incidents.forget(namespace, name)
         self.ledger.forget_job(namespace, name)
 
     def job_count(self) -> int:
@@ -472,6 +536,9 @@ class JobMetrics:
         ledger_block = self.ledger.metrics_block()
         if ledger_block:
             lines.append(ledger_block)
+        incident_block = self.incidents.metrics_block()
+        if incident_block:
+            lines.append(incident_block)
         return "\n".join(lines)
 
 

@@ -47,9 +47,10 @@ from ..k8s.runtime import escape_label_value
 #: objectives with built-in sources (docs/observability.md):
 #: goodput_ratio (ledger), time_to_running (JobMetrics),
 #: step_latency_p99 (worker step profiles), mfu (the ledger's worker
-#: MFU samples, ISSUE 13) — plus anything custom.
+#: MFU samples, ISSUE 13), mttr (closed-incident recovery totals from
+#: the incident registry, ISSUE 14) — plus anything custom.
 KNOWN_OBJECTIVES = ("goodput_ratio", "time_to_running",
-                    "step_latency_p99", "mfu")
+                    "step_latency_p99", "mfu", "mttr")
 
 
 @dataclass(frozen=True)
@@ -132,6 +133,12 @@ def default_slos() -> List[SloSpec]:
         # fallback at ~1e-5 — the SLO burns on sustained inefficiency
         # while the ledger's collapse floor catches the acute case
         SloSpec("mfu", "mfu", target=0.05, comparator=">=", budget=0.25),
+        # MTTR (ISSUE 14): each closed incident's end-to-end recovery
+        # total (detect→first good step, operator-observed) — the SLO
+        # burns when recoveries sustainedly run long, e.g. a capacity
+        # squeeze stretching every reschedule stage
+        SloSpec("mttr", "mttr", target=300.0, comparator="<=",
+                budget=0.25),
     ]
 
 

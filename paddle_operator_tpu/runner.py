@@ -38,7 +38,10 @@ from .utils.checkpoint import (
     AsyncCheckpointer, restore_latest, save_checkpoint,
     save_checkpoint_sharded,
 )
-from .utils.trace import StageTimes, profile_steps, tracer
+from .utils.trace import (
+    SpanContext, StageTimes, clear_incident_context, profile_steps,
+    set_incident_context, tracer,
+)
 
 log = logging.getLogger("tpujob.runner")
 
@@ -258,6 +261,43 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
     result: Dict[str, Any] = {"cycles": 0}
     ckpt_writer = AsyncCheckpointer() if job.async_checkpoint else None
 
+    # -- incident-context adoption (docs/observability.md "Incident
+    # tracing"): a pod created while its job's recovery incident was
+    # open carries the operator-minted span context — adopt it so every
+    # trace event this process emits until the FIRST post-recovery step
+    # is stamped with the incident id (the cross-process half of the
+    # causal chain), and report the runner-side recovery stages
+    # (restore / compile / warmup) as incident_stage events. A legacy
+    # launch without the env var (or with a mangled one) degrades to
+    # plain uncorrelated tracing.
+    inc_state: Dict[str, Optional[SpanContext]] = {
+        "ctx": SpanContext.decode(
+            os.environ.get("TPUJOB_TRACE_CONTEXT", ""))}
+    if inc_state["ctx"] is not None:
+        set_incident_context(inc_state["ctx"])
+        tracer().event("incident_adopted",
+                       cause=inc_state["ctx"].cause,
+                       job=inc_state["ctx"].job or None,
+                       worker=cfg.worker_id)
+
+    def incident_stage(stage: str, seconds: float) -> None:
+        ctx = inc_state["ctx"]
+        if ctx is not None and seconds > 0:
+            tracer().event("incident_stage", stage=stage,
+                           dur_s=round(seconds, 6), plane="runner",
+                           job=ctx.job or None)
+
+    def incident_first_step(at_step: int) -> None:
+        """The incident ends HERE: the first good step after recovery.
+        Emit the marker, then stop stamping."""
+        ctx = inc_state["ctx"]
+        if ctx is None:
+            return
+        inc_state["ctx"] = None
+        tracer().event("incident_first_step", step=at_step,
+                       job=ctx.job or None)
+        clear_incident_context()
+
     # -- graceful-preemption drain --------------------------------------
     drain = job.drain_monitor
     if drain is None:
@@ -427,7 +467,12 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             accum_steps=job.accum_steps,
             host_local_batches=job.host_local_batches,
         )
+        t_build0 = time.perf_counter()
         step_fn, state = build(steps_per_call=K)
+        # runner-reported compile stage: what THIS process paid to get a
+        # runnable step (milliseconds on a cache hit — exactly the story
+        # the incident chain should tell)
+        incident_stage("compile", time.perf_counter() - t_build0)
         # provenance per cycle: which cache rung served this compile
         # (memo/aot/compiled/jit) — the resume-cost story in one field
         result.setdefault("compile_sources", []).append(
@@ -519,8 +564,11 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
             start_step = manifest["step"]
             result.setdefault("resume_steps", []).append(start_step)
             # the whole restore chain (read + verify + place +
-            # materialize) is restore badput in the goodput ledger
-            add_badput("restore", time.perf_counter() - t_restore0)
+            # materialize) is restore badput in the goodput ledger —
+            # and the runner-reported restore stage of the incident
+            restore_s = time.perf_counter() - t_restore0
+            add_badput("restore", restore_s)
+            incident_stage("restore", restore_s)
             log.info("restored checkpoint step=%d (epoch %s)",
                      start_step, manifest["meta"].get("epoch"))
         if ckpt_writer is not None and job.checkpoint_dir:
@@ -700,6 +748,13 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                 prof.after(step, span=k_here)
                 step += k_here
                 trc.event("train_step", step=step, epoch=epoch)
+                if inc_state["ctx"] is not None:
+                    # recovery ends at the FIRST good step: warmup is
+                    # the stretch from loop entry (state restored, step
+                    # built) to this step landing, then the ambient
+                    # stamp clears — steady-state events stay unlabeled
+                    incident_stage("warmup", time.perf_counter() - t0)
+                    incident_first_step(step)
                 if job.log_every and (
                         step % job.log_every < k_here):
                     # deferred readback: start the D2H copy for THIS
@@ -805,6 +860,9 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         except BaseException:
             log.exception("async checkpoint write failed during teardown")
         drain.uninstall()
+        # the ambient incident stamp must never outlive the run (a
+        # failed setup path, or a run that never reached a step)
+        clear_incident_context()
         if metrics_srv is not None:
             metrics_srv.stop()
     if goodput_acc["wall"] > 0:

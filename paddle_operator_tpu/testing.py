@@ -119,6 +119,9 @@ class OperatorHarness:
             self.slo.add_source(
                 lambda: [("mfu", v) for v in self.job_metrics
                          .ledger.job_mfu().values()])
+            self.slo.add_source(
+                lambda: [("mttr", s) for s in self.job_metrics
+                         .incidents.pop_mttr_samples()])
         # Production release channel: a real CoordinationServer on localhost;
         # the pod simulator polls it over real HTTP like the init container.
         coord_url = ""
@@ -184,6 +187,7 @@ class OperatorHarness:
 
         if racedetect.enabled():
             for obj in (self.job_metrics, self.job_metrics.ledger,
+                        self.job_metrics.incidents,
                         self.slo, self.arbiter,
                         getattr(self.arbiter, "feedback", None)
                         if self.arbiter is not None else None,

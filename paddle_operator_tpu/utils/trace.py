@@ -25,9 +25,69 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
+from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 _local = threading.local()
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """Cross-process incident span context (docs/observability.md
+    "Incident tracing").
+
+    Minted by the operator's incident registry at an incident inception
+    site (drain notice, hard preemption, arbiter eviction, feedback
+    remediation) and propagated operator→runner through the pod's
+    ``TPUJOB_TRACE_CONTEXT`` env var and the
+    ``batch.tpujob.dev/trace-context`` pod annotation (the annotation is
+    what a restarted operator re-reads to adopt an in-flight incident).
+    Every trace event a participating process emits while the incident
+    is live carries ``incident=<incident_id>``, so the two per-process
+    JSONL files reconstruct into one causal tree offline."""
+
+    incident_id: str
+    cause: str = ""
+    job: str = ""  # "namespace/name" — the owning TpuJob
+
+    def encode(self) -> str:
+        return "v1;%s;%s;%s" % (self.incident_id, self.cause, self.job)
+
+    @classmethod
+    def decode(cls, text: Optional[str]) -> Optional["SpanContext"]:
+        """Parse an encoded context; None for anything unparseable — a
+        legacy runner (or a mangled annotation) must degrade to
+        uncorrelated tracing, never crash."""
+        if not text:
+            return None
+        parts = text.split(";")
+        if len(parts) != 4 or parts[0] != "v1" or not parts[1]:
+            return None
+        return cls(incident_id=parts[1], cause=parts[2], job=parts[3])
+
+
+# Process-ambient incident context: the RUNNER adopts the operator-minted
+# context from its environment and every trace event until the first
+# post-recovery step is stamped with it. (The operator side stamps
+# explicitly per job — one process there serves many concurrent
+# incidents, so an ambient global would cross-label them.)
+_ambient_lock = threading.Lock()
+_ambient_ctx: Optional[SpanContext] = None
+
+
+def set_incident_context(ctx: Optional[SpanContext]) -> None:
+    global _ambient_ctx
+    with _ambient_lock:
+        _ambient_ctx = ctx
+
+
+def clear_incident_context() -> None:
+    set_incident_context(None)
+
+
+def current_incident_context() -> Optional[SpanContext]:
+    with _ambient_lock:
+        return _ambient_ctx
 
 
 class _Span:
@@ -88,6 +148,12 @@ class Tracer:
         self._file = None
         self._bytes = 0
         self._events = deque(maxlen=4096)  # in-memory ring, O(1) append
+        # clock anchor: emitted once, before the first real record, so
+        # offline tools can convert this process's monotonic stamps
+        # (``m0``) to wall time via ONE (wall, mono) pair — cross-process
+        # ordering and stage durations stay well-defined even when the
+        # wall clock steps mid-run (NTP) or skews between hosts
+        self._anchored = False
 
     @contextmanager
     def span(self, name: str, **attrs: Any):
@@ -98,6 +164,11 @@ class Tracer:
         _local.depth = depth + 1
         sp = _Span(dict(attrs))
         t0 = time.time()
+        # m0 captured NEXT TO t0 (span start): merge_traces re-times
+        # records as anchor.wall + (m0 - anchor.mono), and an exit-time
+        # m0 would shift every span by its own duration in merged
+        # cross-process timelines
+        m0 = time.monotonic()
         p0 = time.perf_counter()
         try:
             yield sp
@@ -106,6 +177,7 @@ class Tracer:
             self._emit({
                 "name": name,
                 "t0": round(t0, 6),
+                "m0": round(m0, 6),
                 "dur_ms": round((time.perf_counter() - p0) * 1e3, 3),
                 "depth": depth,
                 "attrs": sp.attrs,
@@ -115,14 +187,28 @@ class Tracer:
         if not self.enabled:
             return
         self._emit({
-            "name": name, "t0": round(time.time(), 6), "dur_ms": 0.0,
+            "name": name, "t0": round(time.time(), 6),
+            "m0": round(time.monotonic(), 6), "dur_ms": 0.0,
             "depth": getattr(_local, "depth", 0), "attrs": attrs,
         })
 
     def _emit(self, rec: Dict[str, Any]) -> None:
+        # ambient incident stamping (runner side): while an adopted
+        # incident context is live, every record carries its id — the
+        # cross-process half of the causal chain. setdefault, so an
+        # explicit per-site incident attr always wins.
+        ctx = current_incident_context()
+        if ctx is not None:
+            rec["attrs"].setdefault("incident", ctx.incident_id)
         with self._lock:
-            self._events.append(rec)
-            if self.path:
+            recs = [rec]
+            if not self._anchored:
+                self._anchored = True
+                recs.insert(0, self._anchor_record())
+            for r in recs:
+                self._events.append(r)
+                if not self.path:
+                    continue
                 if self._file is None:
                     os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
                     self._file = open(self.path, "a", buffering=1)
@@ -130,11 +216,25 @@ class Tracer:
                         self._bytes = os.path.getsize(self.path)
                     except OSError:
                         self._bytes = 0
-                line = json.dumps(rec) + "\n"
+                line = json.dumps(r) + "\n"
                 self._file.write(line)
                 self._bytes += len(line)
                 if self.max_bytes and self._bytes >= self.max_bytes:
                     self._rotate_locked()
+
+    @staticmethod
+    def _anchor_record() -> Dict[str, Any]:
+        """One (wall, mono) pair taken back-to-back at first emission:
+        offline readers convert any later record's ``m0`` to this
+        process's wall frame as ``wall + (m0 - mono)``."""
+        return {
+            "name": "clock_anchor",
+            "t0": round(time.time(), 6),
+            "m0": round(time.monotonic(), 6),
+            "dur_ms": 0.0,
+            "depth": 0,
+            "attrs": {"pid": os.getpid()},
+        }
 
     def _rotate_locked(self) -> None:
         """Shift ``path.i`` → ``path.i+1`` (discarding ``.keep``) and
@@ -154,6 +254,11 @@ class Tracer:
                 else:
                     os.replace(src, "%s.%d" % (self.path, i + 1))
             os.replace(self.path, self.path + ".1")
+            # the fresh live segment needs its own clock anchor: the
+            # old one rotates away (and is eventually discarded at
+            # .keep), and a segment without an anchor silently loses
+            # skew-correct merging in obs_report
+            self._anchored = False
         except OSError:
             # a rotation failure (read-only dir race, NFS hiccup) must
             # not take tracing down; keep appending to the live file

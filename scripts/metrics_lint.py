@@ -115,6 +115,13 @@ def selftest_text() -> str:
     # decision counter the same way production would
     h.arbiter.feedback.nudge("default", "lint-tpu")
     h.converge()
+    # a full incident lifecycle on the adversarial name (ISSUE 14):
+    # drain inception → reschedule → recovery, so the incident counter
+    # + the MTTR stage histogram families are linted live
+    h.job_metrics.observe_phase("default", 'evil"name\\x', "Running")
+    h.job_metrics.observe_drain("default", 'evil"name\\x', pods=2)
+    h.job_metrics.observe_phase("default", 'evil"name\\x', "Restarting")
+    h.job_metrics.observe_phase("default", 'evil"name\\x', "Running")
     text = h.manager.metrics_text()
     # the coverage this selftest claims must actually be in the text —
     # a scenario drift that stops exercising these emitters should fail
@@ -139,8 +146,13 @@ def selftest_text() -> str:
                 "tpujob_mfu",
                 "tpujob_fleet_effective_flops",
                 # the observe->decide loop (ISSUE 11)
-                "tpujob_sched_feedback_total"):
+                "tpujob_sched_feedback_total",
+                # the causal-incident plane (ISSUE 14)
+                "tpujob_incidents_total",
+                "tpujob_incident_recovery_seconds"):
         assert "# TYPE %s" % fam in text, "selftest lost %s" % fam
+    assert 'tpujob_incidents_total{cause="drain"}' in text, \
+        "the drain incident never closed into the counter"
     assert 'tenant="evil' in text, "adversarial tenant label missing"
     assert 'outcome="done"' in text, "reconcile histogram lost its outcomes"
     assert 'cause="data_stall"' in text, "ledger badput cause missing"

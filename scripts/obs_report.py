@@ -26,6 +26,16 @@ hardware conservation invariant offline (``total_flops ==
 flops_per_step x steps``, MFU a valid ratio derivable from the block's
 own totals, every degraded sample explained by a collapse event) —
 exit 1 on any inconsistency.
+
+``--incidents`` (the fifth ``make obs`` lane, ISSUE 14) rebuilds every
+recovery incident's cross-process causal chain from the trace alone
+(``incident_open`` → stages → ``incident_close`` plus every event
+stamped with the incident id) and cross-validates each chain's MTTR
+stage sum against the goodput ledger's badput episode for the same
+incident — exit 1 on an orphan span, a broken chain, dropped
+propagation, or an event-plane/time-plane mismatch. ``--trace`` is
+repeatable: multiple per-process files are merged on their
+``clock_anchor`` records, so ordering survives wall-clock skew.
 """
 
 from __future__ import annotations
@@ -87,6 +97,38 @@ def load_trace(path: str) -> List[dict]:
                 except ValueError:
                     continue
     return records
+
+
+def merge_traces(paths: List[str]) -> List[dict]:
+    """Merge several per-process trace files (operator + runners) into
+    one time-ordered stream using each file's ``clock_anchor`` record:
+    every record carrying a monotonic stamp (``m0``) is re-timed as
+    ``anchor.wall + (m0 - anchor.mono)`` — one wall reading per process,
+    so in-process ordering and durations are immune to wall-clock steps
+    (NTP) mid-run, and cross-process ordering degrades only by the
+    one-off anchor skew, not by whatever the clocks did later. Files
+    without an anchor (pre-anchor traces) keep their raw ``t0``."""
+    merged: List[dict] = []
+    for path in paths:
+        records = load_trace(path)
+        # re-anchor at EVERY clock_anchor in stream order: rotation and
+        # process restarts (a rebooted host resets CLOCK_MONOTONIC)
+        # each start a fresh monotonic frame with a fresh anchor, and
+        # re-timing a record with the wrong frame's anchor would throw
+        # it hours off. Records before the first anchor keep raw t0.
+        anchor: Optional[Tuple[float, float]] = None
+        for rec in records:
+            if rec.get("name") == "clock_anchor" \
+                    and rec.get("m0") is not None:
+                anchor = (float(rec["t0"]), float(rec["m0"]))
+                continue
+            m0 = rec.get("m0")
+            if anchor is not None and m0 is not None:
+                wall, mono = anchor
+                rec["t0"] = round(wall + (float(m0) - mono), 6)
+        merged.extend(records)
+    merged.sort(key=lambda r: r.get("t0", 0.0))
+    return merged
 
 
 def parse_iso(ts: str) -> Optional[float]:
@@ -435,6 +477,256 @@ def hardware_lane(records: List[dict], job: Optional[str] = None
     return 0, "\n".join(out)
 
 
+# ---------------------------------------------------------------------------
+# causal incident lane (ISSUE 14): rebuild every incident's cross-process
+# chain from the trace alone and cross-validate against the ledger plane
+# ---------------------------------------------------------------------------
+
+#: trace events that ARE incident inceptions: one of these without an
+#: ``incident`` attribute is a fault the tracing plane lost — the chain
+#: can never be rebuilt, so the lane fails on it
+INCEPTION_EVENTS = ("drain_notice", "sched_evicted", "restart")
+
+#: stage-sum vs ledger-episode tolerance (seconds). Chaos runs on the
+#: tick clock and reconciles exactly; real clocks pay microseconds of
+#: skew between the two planes' clock reads at the same hook.
+INCIDENT_TOL_S = 0.01
+
+
+def incident_chains(records: List[dict], job: Optional[str] = None
+                    ) -> Tuple[Dict[str, dict], List[str]]:
+    """Group the incident-plane records into per-incident chains,
+    SEGMENT-wise: a segment runs from an open (or a post-close re-open
+    via ``incident_restored``) to its ``incident_close``. An
+    ``incident_restored`` arriving while a segment is still open is an
+    operator-restart continuation — the dead process's partial segment
+    is kept for display but can no longer be reconciled (its close and
+    its ledger episode died with the process), so reconciliation
+    restarts with the segment the new process owns.
+
+    Returns ``(chains, errors)``; structural errors collected here: a
+    record stamped with an id no inception ever minted (orphan span),
+    and a ledger episode pointing at an unknown incident."""
+    chains: Dict[str, dict] = {}
+    stray: List[str] = []
+
+    def new_chain(attrs: dict, t0: float) -> dict:
+        return {
+            "cause": attrs.get("cause"), "job": attrs.get("job"),
+            "t0": t0, "live": False, "opens": 0, "closes": 0,
+            "seg": None, "segments": [], "lost": 0,
+            "runner_stages": [], "members": 0, "resolved": True,
+        }
+
+    for rec in records:
+        name = rec.get("name", "")
+        attrs = rec.get("attrs") or {}
+        inc = attrs.get("incident")
+        if name in ("incident_open", "incident_restored"):
+            if not _matches(attrs.get("job"), job):
+                continue
+            ch = chains.get(inc)
+            if ch is None:
+                ch = chains[inc] = new_chain(attrs, rec.get("t0", 0.0))
+            if ch["live"]:
+                if name == "incident_open":
+                    stray.append("duplicate incident_open for %r" % inc)
+                else:
+                    # operator-restart continuation: the old process's
+                    # partial segment is unreconcilable (its close died
+                    # with the process) — keep it as `lost`, restart
+                    ch["lost"] += 1
+            else:
+                ch["opens"] += 1
+            ch["live"] = True
+            ch["seg"] = {"stage_s": {}}
+        elif name == "incident_stage":
+            if job is not None and not _matches(attrs.get("job"), job):
+                continue
+            ch = chains.get(inc)
+            if ch is None:
+                stray.append("incident_stage for unknown incident %r"
+                             % (inc,))
+                continue
+            dur = float(attrs.get("dur_s") or 0.0)
+            if attrs.get("plane") == "runner":
+                ch["runner_stages"].append(
+                    {"stage": attrs.get("stage"), "dur_s": dur})
+            elif ch["seg"] is None:
+                stray.append("incident_stage for %r outside any open "
+                             "segment" % (inc,))
+            else:
+                st = attrs.get("stage", "?")
+                ch["seg"]["stage_s"][st] = \
+                    ch["seg"]["stage_s"].get(st, 0.0) + dur
+        elif name == "incident_close":
+            if job is not None and not _matches(attrs.get("job"), job):
+                continue
+            ch = chains.get(inc)
+            if ch is None:
+                stray.append("incident_close for unknown incident %r"
+                             % (inc,))
+                continue
+            if not ch["live"]:
+                stray.append("incident_close for %r with no open "
+                             "segment" % (inc,))
+                continue
+            ch["closes"] += 1
+            ch["live"] = False
+            ch["segments"].append({
+                "stage_s": ch["seg"]["stage_s"],
+                "total_s": float(attrs.get("total_s") or 0.0),
+                "episode_s": None,
+            })
+            ch["seg"] = None
+            if not attrs.get("resolved", True):
+                ch["resolved"] = False
+        elif name == "ledger_episode":
+            if not _matches(attrs.get("job"), job):
+                continue
+            if not inc:
+                stray.append("ledger episode for %s carries no incident "
+                             "id (badput the event plane cannot explain)"
+                             % attrs.get("job"))
+                continue
+            ch = chains.get(inc)
+            if ch is None:
+                stray.append("ledger episode points at unknown incident "
+                             "%r (the inception was never traced)"
+                             % (inc,))
+                continue
+            # the episode closes at the same hook as the segment, right
+            # after it: attach to the newest close still waiting
+            seg = next((s for s in reversed(ch["segments"])
+                        if s["episode_s"] is None), None)
+            if seg is None:
+                stray.append("ledger episode for %r has no matching "
+                             "incident close" % (inc,))
+            else:
+                seg["episode_s"] = float(attrs.get("badput_s") or 0.0)
+        elif inc is not None:
+            # any other record stamped with an id (pod create/delete
+            # spans, runner checkpoint/step events): must reference a
+            # chain some inception minted
+            if inc in chains:
+                chains[inc]["members"] += 1
+            elif job is None:
+                stray.append("orphan span: %r stamped with unknown "
+                             "incident %r" % (name, inc))
+            elif attrs.get("job") is not None \
+                    and _matches(attrs.get("job"), job):
+                # with a --job filter, a job-less record whose incident
+                # was filtered out is NOT an orphan — only flag records
+                # that positively belong to the requested job
+                stray.append("orphan span: %r stamped with unknown "
+                             "incident %r" % (name, inc))
+    return chains, stray
+
+
+def incident_violations(chains: Dict[str, dict],
+                        stray: List[str],
+                        records: List[dict],
+                        job: Optional[str] = None) -> List[str]:
+    """The full --incidents audit: broken chains (an open segment with
+    no close), missing propagation (an inception-shaped event with no
+    incident id), internal stage-sum consistency per segment, and the
+    tentpole cross-validation — every closed segment's operator stage
+    sum must reconcile with the ledger's badput episode for the same
+    incident id."""
+    errs = list(stray)
+    for rec in records:
+        if rec.get("name") in INCEPTION_EVENTS:
+            attrs = rec.get("attrs") or {}
+            if not _matches(attrs.get("job"), job):
+                continue
+            if not attrs.get("incident"):
+                errs.append(
+                    "fault with no incident: %s for %s carries no "
+                    "incident id (propagation dropped)"
+                    % (rec["name"], attrs.get("job")))
+    for inc in sorted(chains):
+        ch = chains[inc]
+        label = "%s (%s, %s)" % (inc, ch["cause"], ch["job"])
+        if ch["live"]:
+            errs.append("broken chain: %s never closed — the incident "
+                        "ends nowhere in the trace" % label)
+            continue
+        for i, seg in enumerate(ch["segments"]):
+            stage_sum = sum(seg["stage_s"].values())
+            if abs(stage_sum - seg["total_s"]) > INCIDENT_TOL_S:
+                errs.append(
+                    "%s segment %d: stage events sum to %.6fs but the "
+                    "close reported %.6fs (a stage event was dropped)"
+                    % (label, i, stage_sum, seg["total_s"]))
+            if seg["episode_s"] is None:
+                errs.append("%s segment %d: no ledger episode shares "
+                            "this incident id — the time plane never "
+                            "saw the incident" % (label, i))
+            elif abs(stage_sum - seg["episode_s"]) > INCIDENT_TOL_S:
+                errs.append(
+                    "%s segment %d: stage sum %.6fs does not reconcile "
+                    "with the ledger episode badput %.6fs (event plane "
+                    "vs time plane conservation broken)"
+                    % (label, i, stage_sum, seg["episode_s"]))
+    return errs
+
+
+def render_incidents(chains: Dict[str, dict]) -> str:
+    lines = ["Incident chains (rebuilt from trace alone)",
+             "------------------------------------------"]
+    if not chains:
+        lines.append("(no incident_open events in the trace)")
+        return "\n".join(lines)
+    order = sorted(chains.items(), key=lambda kv: kv[1]["t0"] or 0.0)
+    for inc, ch in order:
+        stage_s: Dict[str, float] = {}
+        for seg in ch["segments"]:
+            for s, d in seg["stage_s"].items():
+                stage_s[s] = stage_s.get(s, 0.0) + d
+        if ch["seg"] is not None:
+            for s, d in ch["seg"]["stage_s"].items():
+                stage_s[s] = stage_s.get(s, 0.0) + d
+        stages = " ".join("%s=%.3fs" % (s, d)
+                          for s, d in sorted(stage_s.items()))
+        notes = ""
+        if not ch["resolved"]:
+            notes += "  [unresolved]"
+        if ch["lost"]:
+            notes += "  [%d pre-restart segment(s) lost]" % ch["lost"]
+        if ch["live"]:
+            notes += "  [STILL OPEN]"
+        lines.append(
+            "  %-40s %-9s %-22s mttr=%.3fs  %s%s"
+            % (inc, ch["cause"] or "?", ch["job"] or "-",
+               sum(stage_s.values()), stages or "(zero-length)", notes))
+        for rs in ch["runner_stages"]:
+            lines.append("      runner %-10s %.3fs"
+                         % (rs["stage"], rs["dur_s"]))
+        if ch["members"]:
+            lines.append("      +%d member event(s) in the chain"
+                         % ch["members"])
+    return "\n".join(lines)
+
+
+def incidents_lane(records: List[dict], job: Optional[str] = None
+                   ) -> Tuple[int, str]:
+    """The whole --incidents lane over loaded trace records: returns
+    ``(exit_code, text)`` — 1 on any broken chain / dropped propagation
+    / ledger mismatch, 2 when the trace carries no incidents at all."""
+    chains, stray = incident_chains(records, job=job)
+    out = [render_incidents(chains)]
+    errs = incident_violations(chains, stray, records, job=job)
+    if errs:
+        out.append("INCIDENT CHAIN VIOLATIONS:")
+        out.extend("  " + e for e in errs)
+        return 1, "\n".join(out)
+    if not chains:
+        return 2, "\n".join(out)
+    out.append("incident reconstruction: ok (%d chain(s), every stage "
+               "sum reconciled with its ledger episode)" % len(chains))
+    return 0, "\n".join(out)
+
+
 #: the inputs each sched_feedback action must carry for the decision to
 #: be reconstructable from trace alone (ISSUE 11 acceptance): a decision
 #: event missing its inputs fails the --decisions lane
@@ -572,12 +864,16 @@ def render_report(timeline: List[dict], metrics_text: str = "",
 # ---------------------------------------------------------------------------
 
 def run_chaos(scenario: str, seed: int, verbose: bool,
-              hardware: bool = False) -> int:
+              hardware: bool = False, incidents: bool = False) -> int:
     """Run one chaos scenario with tracing enabled, then report each
     job's timeline from the trace + recorded events. ``multi_tenant``
     runs the fleet-scheduler harness and reports the feedback-decision
     lane (every sched_feedback decision reconstructed from trace alone,
-    inputs validated — exit 1 when one is not reconstructable)."""
+    inputs validated — exit 1 when one is not reconstructable).
+    ``incidents`` adds the causal-incident lane (the fifth ``make obs``
+    lane, ISSUE 14): every incident chain rebuilt from trace alone,
+    stage sums cross-validated against the ledger episodes — exit 1 on
+    a broken chain, dropped propagation, or a ledger mismatch."""
     import paddle_operator_tpu.utils.trace as trace_mod
     from paddle_operator_tpu.chaos.harness import ChaosHarness
     from paddle_operator_tpu.chaos.plan import CONTROL_SCENARIOS, build_plan
@@ -620,6 +916,14 @@ def run_chaos(scenario: str, seed: int, verbose: bool,
             return 2
         print("decision reconstruction: ok (%d decision(s))"
               % len(entries))
+        if incidents:
+            print()
+            inc_rc, text = incidents_lane(records)
+            print(text)
+            if inc_rc == 2:
+                print("(expected incidents in a multi_tenant run)")
+            if inc_rc != 0:
+                return inc_rc
         return 0
     if scenario not in CONTROL_SCENARIOS:
         print("scenario %r is not a control-plane scenario (one of %s)"
@@ -675,13 +979,29 @@ def run_chaos(scenario: str, seed: int, verbose: bool,
             print("(expected hardware telemetry in a %s run)" % scenario)
         if hw_rc != 0:
             return hw_rc
+    if incidents:
+        # the causal-incident lane (`make obs`, fifth leg): every
+        # incident chain rebuilt from the trace ALONE, stage sums
+        # cross-validated against the ledger's badput episodes
+        print()
+        inc_rc, text = incidents_lane(records)
+        print(text)
+        if inc_rc == 2:
+            print("(expected incidents in a %s run)" % scenario)
+        if inc_rc != 0:
+            return inc_rc
     return rc
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="merge trace + events (+ metrics) into a job timeline")
-    ap.add_argument("--trace", help="Tracer JSONL file (TPUJOB_TRACE_FILE)")
+    ap.add_argument("--trace", action="append", default=None,
+                    help="Tracer JSONL file (TPUJOB_TRACE_FILE); "
+                         "repeatable — multiple per-process files "
+                         "(operator + runners) are merged on their "
+                         "clock_anchor records, so cross-process "
+                         "ordering survives wall-clock skew")
     ap.add_argument("--events",
                     help="JSON file holding a list of corev1 Events")
     ap.add_argument("--metrics", help="text-exposition snapshot to append")
@@ -706,16 +1026,27 @@ def main(argv=None) -> int:
                          "events and re-check the hardware conservation "
                          "invariant (total_flops == flops_per_step x "
                          "steps; exit 1 on violation)")
+    ap.add_argument("--incidents", action="store_true",
+                    help="also rebuild every recovery incident's "
+                         "cross-process causal chain (incident_open / "
+                         "incident_stage / incident_close + every event "
+                         "stamped with the incident id) and cross-"
+                         "validate each chain's MTTR stage sum against "
+                         "the goodput ledger's badput episode for the "
+                         "same incident (exit 1 on an orphan span, a "
+                         "broken chain, dropped propagation, or a "
+                         "ledger mismatch)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="include every reconcile span")
     args = ap.parse_args(argv)
 
     if args.chaos:
         return run_chaos(args.chaos, args.seed, args.verbose,
-                         hardware=args.hardware)
+                         hardware=args.hardware,
+                         incidents=args.incidents)
     if not args.trace and not args.events:
         ap.error("need --trace and/or --events (or --chaos)")
-    records = load_trace(args.trace) if args.trace else []
+    records = merge_traces(args.trace) if args.trace else []
     events: List[dict] = []
     if args.events:
         with open(args.events) as f:
@@ -755,6 +1086,12 @@ def main(argv=None) -> int:
         hw_rc, text = hardware_lane(records, job=args.job)
         print(text)
         if hw_rc == 1:
+            return 1
+    if args.incidents:
+        print()
+        inc_rc, text = incidents_lane(records, job=args.job)
+        print(text)
+        if inc_rc == 1:
             return 1
     return 0 if timeline else 2
 

@@ -14,6 +14,15 @@ The consistency bar rides along (EasyScale, arXiv 2208.14228): the warm
 process's first-step loss must be BIT-IDENTICAL to the cold one's — a
 cache that changes numerics is a corruption, not an optimization.
 
+The gate works on MEDIANS: cold startup on a shared CI box has ~30%
+run-to-run variance (one slow cold sample vs one fast warm sample flaked
+the 3x floor even though the cache was working), so both modes take
+median-of-N cold AND warm samples (default 3 each; each cold sample gets
+its OWN empty cache dir — a second child against a populated dir would
+silently measure warm) and the floor applies to the medians. The
+bit-identity bar stays STRICT: every sample's first-step loss, cold and
+warm, must be byte-identical — numerics never get averaged away.
+
 Run:   python scripts/perf_startup.py            # full: publishes
                                                  # BENCH_STARTUP.json
        python scripts/perf_startup.py --quick    # CI lane (make startup):
@@ -25,6 +34,7 @@ import argparse
 import json
 import os
 import shutil
+import statistics
 import subprocess
 import sys
 import tempfile
@@ -147,10 +157,13 @@ def run_sample(cache_dir, label, timeout_s):
 def main():
     ap = argparse.ArgumentParser(description="cold vs warm startup bench")
     ap.add_argument("--quick", action="store_true",
-                    help="one cold + one warm sample; assert the floor "
-                    "(the make-verify lane); no JSON artifact")
-    ap.add_argument("--warm-samples", type=int, default=2,
-                    help="warm samples in full mode (best-of)")
+                    help="median-of-N cold/warm samples; assert the "
+                    "floor (the make-verify lane); no JSON artifact")
+    ap.add_argument("--cold-samples", type=int, default=3,
+                    help="cold samples (median-of; each gets a fresh "
+                    "empty cache dir)")
+    ap.add_argument("--warm-samples", type=int, default=3,
+                    help="warm samples (median-of)")
     ap.add_argument("--out", default=None,
                     help="JSON path (default: BENCH_STARTUP.json at the "
                     "repo root; full mode only)")
@@ -160,23 +173,45 @@ def main():
                     help="per-sample subprocess timeout (seconds)")
     args = ap.parse_args()
 
-    cache_dir = tempfile.mkdtemp(prefix="tpujob_perf_startup_")
+    n_cold = max(1, args.cold_samples)
+    n_warm = max(1, args.warm_samples)
+    cold_samples = []
+    warm_samples = []
+    warm_dir = None
+    dirs = []
     try:
-        cold = run_sample(cache_dir, "cold", args.timeout)
-        warm_samples = [
-            run_sample(cache_dir, "warm", args.timeout)
-            for _ in range(1 if args.quick else max(1, args.warm_samples))]
+        # each cold sample starts from its OWN empty cache directory (a
+        # second child against a dir a previous cold child populated
+        # would silently measure a warm start); the warm samples all run
+        # against the first cold sample's now-populated directory
+        for i in range(n_cold):
+            d = tempfile.mkdtemp(prefix="tpujob_perf_startup_")
+            dirs.append(d)
+            cold_samples.append(run_sample(d, "cold", args.timeout))
+            if warm_dir is None:
+                warm_dir = d
+        for _ in range(n_warm):
+            warm_samples.append(run_sample(warm_dir, "warm", args.timeout))
     finally:
-        shutil.rmtree(cache_dir, ignore_errors=True)
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
 
+    # the flake fix (ISSUE 14 satellite): cold time on a shared box has
+    # ~30% variance — gate the floor on MEDIANS, not on one draw each
+    cold_median = statistics.median(s["startup_s"] for s in cold_samples)
+    warm_median = statistics.median(s["startup_s"] for s in warm_samples)
+    cold = min(cold_samples, key=lambda s: s["startup_s"])
     warm = min(warm_samples, key=lambda s: s["startup_s"])
-    speedup = cold["startup_s"] / max(warm["startup_s"], 1e-9)
-    bit_identical = all(s["loss_repr"] == cold["loss_repr"]
-                        for s in warm_samples)
+    speedup = cold_median / max(warm_median, 1e-9)
+    # bit-identity stays strict across EVERY sample, cold and warm
+    bit_identical = all(s["loss_repr"] == cold_samples[0]["loss_repr"]
+                        for s in cold_samples + warm_samples)
     summary = {
         "metric": "startup_cold_vs_warm",
-        "cold_startup_s": cold["startup_s"],
-        "warm_startup_s": warm["startup_s"],
+        "cold_startup_s": cold_median,
+        "warm_startup_s": warm_median,
+        "cold_samples": len(cold_samples),
+        "warm_samples": len(warm_samples),
         "speedup": round(speedup, 2),
         "floor": SPEEDUP_FLOOR,
         "loss_bit_identical": bit_identical,
@@ -189,15 +224,16 @@ def main():
     if not args.quick:
         out = args.out or os.path.join(REPO, "BENCH_STARTUP.json")
         with open(out, "w") as fh:
-            json.dump({"summary": summary, "cold": cold,
+            json.dump({"summary": summary, "cold_samples": cold_samples,
                        "warm_samples": warm_samples}, fh, indent=2)
         print("wrote %s" % out, file=sys.stderr)
 
     # the gates: a warm process that recompiles, or a cache that changes
     # the numbers, must FAIL the lane loudly
     assert bit_identical, (
-        "warm loss %r != cold loss %r — the cache changed numerics"
-        % (warm["loss_repr"], cold["loss_repr"]))
+        "loss not bit-identical across samples (cold %r) — the cache "
+        "changed numerics"
+        % (sorted({s["loss_repr"] for s in cold_samples + warm_samples}),))
     # persistent_hits == -1 means this jax exposes no monitoring events
     # (the counter is observability-only); the speedup floor below is
     # the real gate there — don't fail a working cache over a label
@@ -205,9 +241,10 @@ def main():
         assert warm["cache"]["cache"] in ("warm", "aot"), (
             "warm process did not hit the cache: %r" % (warm["cache"],))
     assert speedup >= SPEEDUP_FLOOR, (
-        "warm startup %.2fs is only %.2fx faster than cold %.2fs "
-        "(floor %.1fx)" % (warm["startup_s"], speedup,
-                           cold["startup_s"], SPEEDUP_FLOOR))
+        "median warm startup %.2fs is only %.2fx faster than median "
+        "cold %.2fs (floor %.1fx, %d/%d samples)"
+        % (warm_median, speedup, cold_median, SPEEDUP_FLOOR,
+           len(cold_samples), len(warm_samples)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,676 @@
+"""Causal incident tracing (ISSUE 14): cross-process span propagation,
+MTTR stage decomposition, and event-plane↔time-plane cross-validation.
+
+Covers the SpanContext wire format, the IncidentRegistry stage machine +
+exposition, the reconciler's operator→runner propagation (pod env +
+annotation) and operator-restart adoption, the runner's context adoption
+and stage stamps, the ledger episode linkage, the clock-anchor records,
+and the ``obs_report --incidents`` lane's failure modes (orphan span,
+broken chain, dropped propagation, ledger mismatch).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.controllers import helper
+from paddle_operator_tpu.obs import (
+    IncidentRegistry, JobMetrics, parse_exposition,
+)
+from paddle_operator_tpu.testing import OperatorHarness
+from paddle_operator_tpu.utils import trace as trace_mod
+from paddle_operator_tpu.utils.trace import (
+    SpanContext, Tracer, current_incident_context,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+from obs_report import (  # noqa: E402
+    incident_chains, incident_violations, incidents_lane, merge_traces,
+)
+
+
+@pytest.fixture
+def traced(monkeypatch, tmp_path):
+    """Route the global tracer to a JSONL file; returns a loader."""
+    path = str(tmp_path / "trace.jsonl")
+    monkeypatch.setattr(trace_mod, "_global", Tracer(path=path))
+
+    def load():
+        trace_mod.tracer().close()
+        if not os.path.exists(path):
+            return []
+        return [json.loads(line) for line in open(path)]
+
+    yield load
+    trace_mod.tracer().close()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# SpanContext wire format
+# ---------------------------------------------------------------------------
+
+def test_span_context_roundtrip():
+    ctx = SpanContext("i123-4-job-drain", "drain", "default/job")
+    back = SpanContext.decode(ctx.encode())
+    assert back == ctx
+
+
+@pytest.mark.parametrize("garbage", [
+    None, "", "v1", "v0;id;c;j", "v1;;drain;d/j", "not;a;context",
+    "v1;id;cause;job;extra",
+])
+def test_span_context_garbage_decodes_to_none(garbage):
+    assert SpanContext.decode(garbage) is None
+
+
+# ---------------------------------------------------------------------------
+# IncidentRegistry
+# ---------------------------------------------------------------------------
+
+def test_registry_lifecycle_stages_and_exposition(traced):
+    clk = FakeClock()
+    reg = IncidentRegistry(clock=clk)
+    ctx = reg.open("d", "j", "drain")
+    assert ctx.cause == "drain" and ctx.job == "d/j"
+    # first inception wins: the restart cued by the drain joins it
+    assert reg.open("d", "j", "preempt").incident_id == ctx.incident_id
+    clk.advance(3.0)
+    reg.on_phase("d", "j", "Restarting")    # drain -> reschedule
+    clk.advance(2.0)
+    reg.on_phase("d", "j", "Starting")      # reschedule -> restore
+    clk.advance(1.0)
+    reg.on_phase("d", "j", "Running")       # close
+    assert reg.context("d", "j") is None
+    assert reg.incident_counts() == {"drain": 1}
+    assert reg.stage_totals() == {"drain": 3.0, "reschedule": 2.0,
+                                  "restore": 1.0}
+    closed = reg.closed_incidents()
+    assert len(closed) == 1 and closed[0]["total_s"] == 6.0
+    assert closed[0]["incident"] == ctx.incident_id
+    assert reg.pop_mttr_samples() == [6.0]
+    assert reg.pop_mttr_samples() == []      # drained
+    # the exposition is a valid self-contained block
+    block = reg.metrics_block()
+    assert parse_exposition(block) == []
+    assert 'tpujob_incidents_total{cause="drain"} 1' in block
+    assert ('tpujob_incident_recovery_seconds_sum'
+            '{cause="drain",stage="reschedule"} 2.0') in block
+    # the trace carries the whole chain
+    names = [r["name"] for r in traced()
+             if r["name"].startswith("incident")]
+    assert names == ["incident_open", "incident_stage", "incident_stage",
+                     "incident_stage", "incident_close"]
+
+
+def test_registry_arm_consumption_rules():
+    clk = FakeClock()
+    reg = IncidentRegistry(clock=clk)
+    # a resize arm explains a restart-shaped incident...
+    reg.arm("d", "a", "resize")
+    assert reg.open("d", "a", "preempt").cause == "resize"
+    # ...but never a scheduler drain
+    reg.arm("d", "b", "resize")
+    assert reg.open("d", "b", "evict").cause == "evict"
+    # a remediation arm explains the drain it commissioned
+    reg.arm("d", "c", "remediate")
+    assert reg.open("d", "c", "evict").cause == "remediate"
+    # and arms expire
+    reg.arm("d", "e", "resize")
+    clk.advance(10_000.0)
+    assert reg.open("d", "e", "preempt").cause == "preempt"
+
+
+def test_restore_sanitizes_annotation_sourced_cause():
+    """A mangled annotation must never mint an out-of-taxonomy metric
+    label: restore() stores the SANITIZED cause, so the close path's
+    histogram/counter stay inside the fixed taxonomy."""
+    reg = IncidentRegistry(clock=FakeClock())
+    ctx = reg.restore("d", "j", SpanContext("i-x", 'bogus"cause\\x',
+                                            "d/j"))
+    assert ctx.cause == "crash"
+    reg.on_phase("d", "j", "Running")
+    assert reg.incident_counts() == {"crash": 1}
+    assert parse_exposition(reg.metrics_block()) == []
+
+
+def test_registry_forget_closes_open_chain(traced):
+    reg = IncidentRegistry(clock=FakeClock())
+    reg.open("d", "gone", "drain")
+    reg.forget("d", "gone")
+    assert reg.open_count() == 0 and reg.job_count() == 0
+    closed = reg.closed_incidents()
+    assert len(closed) == 1 and closed[0]["resolved"] is False
+    assert any(r["name"] == "incident_close" for r in traced())
+
+
+# ---------------------------------------------------------------------------
+# JobMetrics wiring: the two planes reconcile on the same clock
+# ---------------------------------------------------------------------------
+
+def test_incident_stage_sum_reconciles_with_ledger_episode(traced):
+    clk = FakeClock()
+    jm = JobMetrics(clock=clk)
+    jm.observe_phase("d", "j", "Pending")
+    clk.advance(2)
+    jm.observe_phase("d", "j", "Running")
+    clk.advance(10)
+    jm.observe_drain("d", "j", pods=4)
+    clk.advance(2)
+    jm.observe_restart("d", "j", "preemption")  # joins the drain episode
+    clk.advance(1)
+    jm.observe_phase("d", "j", "Restarting")
+    clk.advance(3)
+    jm.observe_phase("d", "j", "Starting")
+    clk.advance(2)
+    jm.observe_phase("d", "j", "Running")
+    inc = jm.incidents.closed_incidents()[0]
+    eps = jm.ledger.episode_log()
+    assert len(eps) == 1
+    assert eps[0]["incident"] == inc["incident"]
+    assert eps[0]["badput_s"] == pytest.approx(inc["total_s"])
+    assert inc["total_s"] == pytest.approx(8.0)
+    # ...and the full offline lane agrees, from the trace alone
+    rc, text = incidents_lane(traced())
+    assert rc == 0, text
+
+
+def test_charge_during_episode_does_not_break_reconciliation(traced):
+    """A data-stall charge moves PRE-incident goodput into a cause; the
+    episode (time that passed while the incident was live) must not
+    inflate — the exact hazard the segment-banking rule exists for."""
+    clk = FakeClock()
+    jm = JobMetrics(clock=clk)
+    jm.observe_phase("d", "j", "Running")
+    clk.advance(10)  # banked goodput the charge can draw from
+    jm.observe_drain("d", "j")
+    clk.advance(2)
+    assert jm.ledger.charge("d", "j", "data_stall", 3.0) == 3.0
+    clk.advance(1)
+    jm.observe_phase("d", "j", "Restarting")
+    clk.advance(1)
+    jm.observe_phase("d", "j", "Running")
+    inc = jm.incidents.closed_incidents()[0]
+    ep = jm.ledger.episode_log()[0]
+    assert inc["total_s"] == pytest.approx(4.0)
+    assert ep["badput_s"] == pytest.approx(4.0)
+    rc, text = incidents_lane(traced())
+    assert rc == 0, text
+
+
+def test_forget_mid_incident_closes_both_planes(traced):
+    clk = FakeClock()
+    jm = JobMetrics(clock=clk)
+    jm.observe_phase("d", "j", "Running")
+    clk.advance(5)
+    jm.observe_drain("d", "j")
+    clk.advance(3)
+    jm.forget_job("d", "j")  # deleted mid-incident
+    rc, text = incidents_lane(traced())
+    assert rc == 0, text
+    assert jm.incidents.closed_incidents()[0]["resolved"] is False
+
+
+def test_restored_incident_badput_keeps_its_cause(traced):
+    """A restarted operator re-opens the episode via restore_incident
+    BEFORE any phase observation lands in the fresh ledger; the
+    recovery seconds must stay attributed to the incident's cause —
+    not demoted to first-admission sched_wait just because the rebuilt
+    process never saw the job Running."""
+    clk = FakeClock()
+    jm = JobMetrics(clock=clk)
+    jm.restore_incident("d", "j", SpanContext("i-r1", "drain", "d/j"))
+    clk.advance(5)
+    jm.observe_phase("d", "j", "Restarting")
+    clk.advance(5)
+    jm.observe_phase("d", "j", "Running")
+    snap = jm.ledger.snapshot("d", "j")
+    assert snap["badput"].get("drain") == pytest.approx(10.0)
+    assert "sched_wait" not in snap["badput"]
+    ep = jm.ledger.episode_log()[0]
+    assert ep["incident"] == "i-r1"
+    assert ep["badput_s"] == pytest.approx(10.0)
+    rc, text = incidents_lane(traced())
+    assert rc == 0, text
+
+
+# ---------------------------------------------------------------------------
+# reconciler propagation + operator-restart adoption
+# ---------------------------------------------------------------------------
+
+def role_spec(replicas):
+    return {"replicas": replicas, "template": {"spec": {"containers": [
+        {"name": "main", "image": "img"}]}}}
+
+
+def elastic_job(name, workers=4):
+    return api.new_tpujob(name, spec={
+        "device": "tpu",
+        "tpu": {"accelerator": "v5e", "topology": "4x8"},
+        "worker": role_spec(workers), "elastic": 1,
+    })
+
+
+def test_drain_propagates_context_to_recreated_pods(traced):
+    h = OperatorHarness()
+    h.create_job(elastic_job("g"))
+    h.converge()
+    h.sim.preempt("g-worker-0", grace_seconds=2)
+    h.converge(max_ticks=80)
+    job = h.get_job("g")
+    assert job.phase == api.Phase.RUNNING
+    # the incident closed once the gang recovered...
+    assert h.job_metrics.incidents.context("default", "g") is None
+    assert h.job_metrics.incidents.incident_counts() == {"drain": 1}
+    # ...and the pod recreated DURING it carries the context, both as
+    # env (the runner's adoption channel) and annotation (the restarted
+    # operator's adoption channel)
+    pod = h.client.get("Pod", "default", "g-worker-0")
+    enc = pod["metadata"]["annotations"][helper.ANNOT_TRACE_CONTEXT]
+    ctx = SpanContext.decode(enc)
+    assert ctx is not None and ctx.cause == "drain"
+    assert ctx.job == "default/g"
+    env = {e["name"]: e.get("value")
+           for e in pod["spec"]["containers"][0]["env"]}
+    assert env["TPUJOB_TRACE_CONTEXT"] == enc
+    closed = h.job_metrics.incidents.closed_incidents()
+    assert closed[0]["incident"] == ctx.incident_id
+    # untouched survivors carry no context
+    other = h.client.get("Pod", "default", "g-worker-1")
+    assert helper.ANNOT_TRACE_CONTEXT not in (
+        other["metadata"].get("annotations") or {})
+    # the JOB-level annotation was stripped once the job recovered —
+    # a later operator restart must not resurrect the closed incident
+    assert helper.ANNOT_TRACE_CONTEXT not in (
+        job.metadata.get("annotations") or {})
+    # the whole run reconstructs offline
+    rc, text = incidents_lane(traced())
+    assert rc == 0, text
+
+
+def test_operator_restart_mid_incident_adopts_context(traced):
+    h = OperatorHarness()
+    h.create_job(elastic_job("r"))
+    h.converge()
+    from paddle_operator_tpu.chaos import FaultInjector, PodChaos
+
+    chaos = PodChaos(h.sim, h.client, FaultInjector())
+    chaos.preempt(h.client.get("Pod", "default", "r-worker-1"))
+    h.manager.drain()
+    h.sim.step()
+    chaos.tick()
+    h.manager.drain()  # replacement pod created, context stamped
+    ctx = h.job_metrics.incidents.context("default", "r")
+    assert ctx is not None
+    h.restart_operator()  # operator memory (registry included) is gone
+    assert h.job_metrics.incidents.context("default", "r") is None
+    for _ in range(40):
+        h.manager.drain()
+        h.sim.step()
+        chaos.tick()
+    assert h.get_job("r").phase == api.Phase.RUNNING
+    # the rebuilt process re-adopted the SAME incident id from the pod
+    # annotation and closed it
+    closed = h.job_metrics.incidents.closed_incidents()
+    assert [c["incident"] for c in closed] == [ctx.incident_id]
+    records = traced()
+    assert any(r["name"] == "incident_restored"
+               and r["attrs"]["incident"] == ctx.incident_id
+               for r in records)
+    rc, text = incidents_lane(records)
+    assert rc == 0, text
+
+
+def test_adoption_prefers_job_annotation_over_stale_pod_context(traced):
+    """The job-level annotation names the NEWEST incident; a pod's
+    annotation names whatever incident recreated that pod. A restarted
+    operator must follow the job, or it would resurrect a closed
+    incident and leave the live one's chain open forever."""
+    h = OperatorHarness()
+    h.create_job(elastic_job("p"))
+    h.converge()
+    stale = SpanContext("i-closed-old", "drain", "default/p")
+    live = SpanContext("i-live-new", "preempt", "default/p")
+
+    def annotate(obj, enc):
+        obj["metadata"].setdefault("annotations", {})[
+            helper.ANNOT_TRACE_CONTEXT] = enc
+
+    pod = h.client.get("Pod", "default", "p-worker-1")
+    annotate(pod, stale.encode())
+    h.client.update(pod)
+    job = h.client.get(api.KIND, "default", "p")
+    annotate(job, live.encode())
+    h.client.update(job)
+    # a pod fails: the freshly derived phase leaves Running, making
+    # this a real mid-recovery pass
+    from paddle_operator_tpu.chaos import FaultInjector, PodChaos
+
+    PodChaos(h.sim, h.client, FaultInjector()).preempt(
+        h.client.get("Pod", "default", "p-worker-0"))
+    h.sim.step()
+    h.reconciler.reconcile("default", "p")
+    # the pass adopted the JOB's (live) context BEFORE the restart hook
+    # ran (which then joined it, first-wins); the stale pod context was
+    # never resurrected
+    adopted = h.job_metrics.incidents.context("default", "p")
+    assert adopted is not None
+    assert adopted.incident_id == live.incident_id
+    restored = [r["attrs"]["incident"] for r in traced()
+                if r["name"] == "incident_restored"]
+    assert restored == [live.incident_id]
+
+
+def test_restart_with_stale_running_phase_does_not_fork_chain(traced):
+    """An operator dying while the persisted phase still reads Running
+    (a drain incident opens before the phase moves) must not let the
+    rebuilt process mint a FRESH incident for the same recovery: the
+    adoption gate reads the freshly derived phase, so the stamped
+    context is re-adopted before the restart hooks run."""
+    h = OperatorHarness()
+    h.create_job(elastic_job("f"))
+    h.converge()
+    h.sim.preempt("f-worker-0", grace_seconds=4)
+    h.manager.drain()  # incident opens + job annotation stamped
+    ctx = h.job_metrics.incidents.context("default", "f")
+    assert ctx is not None
+    job = h.client.get(api.KIND, "default", "f")
+    assert job["metadata"]["annotations"][
+        helper.ANNOT_TRACE_CONTEXT] == ctx.encode()
+    assert job["status"]["phase"] == api.Phase.RUNNING  # stale window
+    # a second fault lands and the operator dies before handling it
+    from paddle_operator_tpu.chaos import FaultInjector, PodChaos
+
+    chaos = PodChaos(h.sim, h.client, FaultInjector())
+    chaos.preempt(h.client.get("Pod", "default", "f-worker-1"))
+    h.restart_operator()
+    for _ in range(60):
+        h.manager.drain()
+        h.sim.step()
+        chaos.tick()
+    assert h.get_job("f").phase == api.Phase.RUNNING
+    # ONE chain end to end: every open/restore/close in the trace (and
+    # the restart hook's stamp) carries the original id
+    records = traced()
+    ids = {r["attrs"]["incident"] for r in records
+           if r["name"] in ("incident_open", "incident_restored",
+                            "incident_close", "restart")}
+    assert ids == {ctx.incident_id}
+    rc, text = incidents_lane(records)
+    assert rc == 0, text
+
+
+def test_fresh_job_gets_no_context(traced):
+    h = OperatorHarness()
+    h.create_job(elastic_job("calm"))
+    h.converge()
+    for pod in h.pods():
+        assert helper.ANNOT_TRACE_CONTEXT not in (
+            pod["metadata"].get("annotations") or {})
+        env = {e["name"] for e in pod["spec"]["containers"][0]["env"]}
+        assert "TPUJOB_TRACE_CONTEXT" not in env
+    assert h.job_metrics.incidents.incident_counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# runner adoption
+# ---------------------------------------------------------------------------
+
+def test_runner_adopts_context_stamps_stages_and_clears(
+        traced, tmp_path, monkeypatch):
+    from paddle_operator_tpu.chaos.recovery import (
+        linear_batch_source, tiny_linear_job)
+    from paddle_operator_tpu.runner import run_training
+
+    ckpt_dir = str(tmp_path / "ck")
+    # first leg: no context — a legacy launch, nothing stamped
+    monkeypatch.delenv("TPUJOB_TRACE_CONTEXT", raising=False)
+    job = tiny_linear_job(ckpt_dir, linear_batch_source(),
+                          total_steps=2, checkpoint_every=2)
+    run_training(job, init_distributed=False)
+    # second leg: the operator-minted context rides the env; the run
+    # resumes from step 2 (restore stage) and trains to 4
+    ctx = SpanContext("i-test-77", "drain", "default/tiny")
+    monkeypatch.setenv("TPUJOB_TRACE_CONTEXT", ctx.encode())
+    job2 = tiny_linear_job(ckpt_dir, linear_batch_source(),
+                           total_steps=4, checkpoint_every=2)
+    result = run_training(job2, init_distributed=False)
+    assert result["steps"] == 4
+    assert current_incident_context() is None  # cleared after first step
+    records = traced()
+    adopted = [r for r in records if r["name"] == "incident_adopted"]
+    assert len(adopted) == 1
+    assert adopted[0]["attrs"]["incident"] == ctx.incident_id
+    stages = {r["attrs"]["stage"]: r["attrs"]
+              for r in records if r["name"] == "incident_stage"}
+    assert set(stages) >= {"restore", "compile", "warmup"}
+    for attrs in stages.values():
+        assert attrs["plane"] == "runner"
+        assert attrs["incident"] == ctx.incident_id
+        assert attrs["dur_s"] > 0
+    # the first post-recovery step is stamped and marks the chain's end
+    first = [r for r in records if r["name"] == "incident_first_step"]
+    assert len(first) == 1 and first[0]["attrs"]["step"] == 3
+    steps = [(r["attrs"]["step"], r["attrs"].get("incident"))
+             for r in records if r["name"] == "train_step"]
+    # legacy leg (steps 1, 2): unstamped; resumed leg: step 3 stamped,
+    # step 4 after the clear — unstamped again
+    assert (3, ctx.incident_id) in steps
+    assert (4, None) in steps
+    assert all(inc is None for s, inc in steps if s <= 2)
+
+
+# ---------------------------------------------------------------------------
+# clock anchors + multi-file merging
+# ---------------------------------------------------------------------------
+
+def test_tracer_emits_clock_anchor_first(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    t = Tracer(path=path)
+    t.event("x", k=1)
+    t.close()
+    recs = [json.loads(line) for line in open(path)]
+    assert recs[0]["name"] == "clock_anchor"
+    assert recs[0]["attrs"]["pid"] == os.getpid()
+    assert all("m0" in r for r in recs)
+
+
+def test_rotation_reanchors_the_fresh_segment(tmp_path):
+    """Size rotation eventually discards the oldest segment — the one
+    holding the anchor — so every fresh live segment must start its own,
+    or a long run silently loses skew-correct merging."""
+    path = str(tmp_path / "r.jsonl")
+    t = Tracer(path=path, max_bytes=400, keep=2)
+    for i in range(40):
+        t.event("x", i=i, pad="p" * 40)
+    t.event("last")  # the live segment (fresh after the last rotation)
+    t.close()
+    live = [json.loads(line) for line in open(path)]
+    assert live[0]["name"] == "clock_anchor"
+
+
+def test_merge_traces_orders_on_anchors_despite_wall_skew(tmp_path):
+    """Two processes with skewed wall clocks: the merge re-times every
+    record as anchor.wall + (m0 - anchor.mono), so ordering follows the
+    per-process monotonic clocks, not the (stepped) wall stamps."""
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text("\n".join(json.dumps(r) for r in [
+        {"name": "clock_anchor", "t0": 1000.0, "m0": 50.0, "attrs": {}},
+        {"name": "second", "t0": 5.0, "m0": 60.0, "attrs": {}},
+    ]) + "\n")
+    b.write_text("\n".join(json.dumps(r) for r in [
+        {"name": "clock_anchor", "t0": 1001.0, "m0": 500.0, "attrs": {}},
+        # wall stamp wildly wrong (9999) — mono says +2s after anchor
+        {"name": "first", "t0": 9999.0, "m0": 502.0, "attrs": {}},
+    ]) + "\n")
+    merged = merge_traces([str(a), str(b)])
+    names = [r["name"] for r in merged if r["name"] != "clock_anchor"]
+    assert names == ["first", "second"]
+    by = {r["name"]: r for r in merged}
+    assert by["first"]["t0"] == pytest.approx(1003.0)
+    assert by["second"]["t0"] == pytest.approx(1010.0)
+
+
+def test_span_m0_is_span_start_not_exit(tmp_path):
+    """Spans emit at exit but their monotonic stamp must be the START
+    time (next to t0), or merge_traces would shift every span by its
+    own duration in merged cross-process timelines."""
+    import time as _time
+
+    path = str(tmp_path / "s.jsonl")
+    t = Tracer(path=path)
+    t.event("before")
+    with t.span("slow"):
+        _time.sleep(0.15)
+    t.close()
+    recs = {r["name"]: r for r in
+            (json.loads(line) for line in open(path))}
+    assert recs["slow"]["m0"] - recs["before"]["m0"] < 0.1
+    assert recs["slow"]["dur_ms"] >= 140
+
+
+def test_merge_traces_reanchors_at_each_anchor(tmp_path):
+    """A process restart (or host reboot) resets CLOCK_MONOTONIC and
+    writes a fresh anchor into the same file chain: records after it
+    must be re-timed against THEIR anchor, not the first one — or
+    post-restart records land hours in the past and chains read out of
+    order."""
+    f = tmp_path / "c.jsonl"
+    f.write_text("\n".join(json.dumps(r) for r in [
+        {"name": "clock_anchor", "t0": 1000.0, "m0": 50.0, "attrs": {}},
+        {"name": "before", "t0": 7.0, "m0": 51.0, "attrs": {}},
+        # restart: monotonic resets near zero, wall moved on
+        {"name": "clock_anchor", "t0": 2000.0, "m0": 5.0, "attrs": {}},
+        {"name": "after", "t0": 8.0, "m0": 6.0, "attrs": {}},
+    ]) + "\n")
+    merged = merge_traces([str(f)])
+    by = {r["name"]: r for r in merged}
+    assert by["before"]["t0"] == pytest.approx(1001.0)
+    assert by["after"]["t0"] == pytest.approx(2001.0)
+    names = [r["name"] for r in merged if r["name"] != "clock_anchor"]
+    assert names == ["before", "after"]
+
+
+# ---------------------------------------------------------------------------
+# the --incidents lane's failure modes (synthetic traces)
+# ---------------------------------------------------------------------------
+
+def _rec(name, **attrs):
+    return {"name": name, "t0": 0.0, "attrs": attrs}
+
+
+def good_chain():
+    return [
+        _rec("incident_open", incident="i1", cause="drain",
+             job="d/j", stage="drain"),
+        _rec("drain_notice", job="d/j", pods=4, incident="i1"),
+        _rec("incident_stage", incident="i1", job="d/j", stage="drain",
+             dur_s=3.0, plane="operator"),
+        _rec("incident_stage", incident="i1", job="d/j",
+             stage="reschedule", dur_s=2.0, plane="operator"),
+        _rec("incident_close", incident="i1", job="d/j", cause="drain",
+             total_s=5.0, resolved=True),
+        _rec("ledger_episode", job="d/j", incident="i1", cause="drain",
+             badput_s=5.0),
+    ]
+
+
+def test_lane_passes_on_good_chain():
+    rc, text = incidents_lane(good_chain())
+    assert rc == 0, text
+
+
+def test_lane_fails_on_orphan_span():
+    recs = good_chain() + [_rec("train_step", step=9, incident="ghost")]
+    rc, text = incidents_lane(recs)
+    assert rc == 1 and "orphan span" in text
+
+
+def test_lane_fails_on_unterminated_chain():
+    recs = [r for r in good_chain() if r["name"] not in
+            ("incident_close", "ledger_episode")]
+    rc, text = incidents_lane(recs)
+    assert rc == 1 and "never closed" in text
+
+
+def test_lane_fails_on_dropped_propagation():
+    recs = good_chain() + [_rec("drain_notice", job="d/other", pods=1)]
+    rc, text = incidents_lane(recs)
+    assert rc == 1 and "fault with no incident" in text
+
+
+def test_lane_fails_on_ledger_mismatch():
+    recs = good_chain()
+    recs[-1]["attrs"]["badput_s"] = 9.0
+    rc, text = incidents_lane(recs)
+    assert rc == 1 and "does not reconcile" in text
+
+
+def test_lane_fails_on_missing_episode():
+    recs = good_chain()[:-1]
+    rc, text = incidents_lane(recs)
+    assert rc == 1 and "no ledger episode" in text
+
+
+def test_lane_handles_operator_restart_segments():
+    """A chain split by an operator restart: the pre-crash segment has
+    no close (lost with the process); the restored segment closes and
+    reconciles — the lane must accept it, not read it as broken."""
+    recs = [
+        _rec("incident_open", incident="i1", cause="drain",
+             job="d/j", stage="drain"),
+        _rec("incident_stage", incident="i1", job="d/j", stage="drain",
+             dur_s=2.0, plane="operator"),
+        # crash here: no close, no episode; the new process restores
+        _rec("incident_restored", incident="i1", cause="drain",
+             job="d/j", stage="reschedule"),
+        _rec("incident_stage", incident="i1", job="d/j",
+             stage="reschedule", dur_s=4.0, plane="operator"),
+        _rec("incident_close", incident="i1", job="d/j", cause="drain",
+             total_s=4.0, resolved=True),
+        _rec("ledger_episode", job="d/j", incident="i1", cause="drain",
+             badput_s=4.0),
+    ]
+    rc, text = incidents_lane(recs)
+    assert rc == 0, text
+    chains, stray = incident_chains(recs)
+    assert chains["i1"]["lost"] == 1
+    assert not stray
+
+
+def test_job_filter_does_not_orphan_other_jobs_runner_events():
+    """--job ns/a over a merged trace where ns/b also had an incident:
+    ns/b's runner events (ambient-stamped, no job attr) must not read
+    as orphan spans just because the filter skipped their inception."""
+    recs = good_chain() + [
+        _rec("incident_open", incident="i2", cause="drain",
+             job="d/other", stage="drain"),
+        _rec("train_step", step=3, incident="i2"),  # ambient, no job
+    ]
+    rc, text = incidents_lane(recs, job="d/j")
+    assert rc == 0, text
+    # unfiltered, the same unknown-id record IS an orphan
+    rc, text = incidents_lane(good_chain()
+                              + [_rec("train_step", step=3,
+                                      incident="ghost")])
+    assert rc == 1 and "orphan span" in text
+
+
+def test_lane_empty_trace_is_exit_2():
+    rc, _text = incidents_lane([])
+    assert rc == 2
