@@ -4,7 +4,7 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup artifacts bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
@@ -12,8 +12,8 @@ all: native test
 # suite again under the runtime race detector (docs/static-analysis.md)
 # + one seed of each durable-recovery chaos scenario + the fleet-
 # scheduler fast lane + the quick control-plane load profile + the quick
-# cold-vs-warm startup profile
-verify: analyze test-fast race recovery sched loadtest startup
+# cold-vs-warm startup profile + the quick fleet artifact-store profile
+verify: analyze test-fast race recovery sched loadtest startup artifacts
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -52,7 +52,8 @@ analyze-changed:
 # jax-version reasons — they would mask this gate's signal).
 race:
 	env TPUJOB_RACE_DETECT=1 $(PY) -m pytest -x -q -m "not slow" \
-	  tests/test_analysis.py tests/test_bench_supervision.py \
+	  tests/test_analysis.py tests/test_artifacts.py \
+	  tests/test_bench_supervision.py \
 	  tests/test_chaos.py tests/test_compile_cache.py \
 	  tests/test_control_plane.py tests/test_coordination.py \
 	  tests/test_data.py tests/test_elastic_e2e.py tests/test_fake_client.py \
@@ -145,6 +146,20 @@ loadtest:
 #   `python scripts/perf_startup.py` with no flags
 startup:
 	$(PY) scripts/perf_startup.py --quick
+
+# fleet artifact-store profile (docs/design.md "Fleet compile-artifact
+# store"):
+#   artifacts — quick N-fresh-process fleet bring-up through the
+#               operator-served HTTP tier: asserts aggregate compile
+#               wall with the store >= 3x lower than store-disabled
+#               (median-of-3) with bit-identical losses, that a
+#               concurrent cold-start stampede resolves to exactly ONE
+#               fleet-wide compilation (the lease proof), and that a
+#               poisoned artifact downgrades to a recompile
+#   the full artifact (BENCH_ARTIFACTS.json) is
+#   `python scripts/perf_artifact_store.py` with no flags
+artifacts:
+	$(PY) scripts/perf_artifact_store.py --quick
 
 bench:
 	$(PY) bench.py

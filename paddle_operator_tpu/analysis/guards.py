@@ -51,6 +51,12 @@ class GuardSpec:
 #: classes/locks/fields the tree no longer has.
 SPECS: Tuple[GuardSpec, ...] = (
     GuardSpec("bench", "_CanaryPool", "_alock", ("_attempts",)),
+    GuardSpec("paddle_operator_tpu.artifacts.server", "_ServerState",
+              "_lock", ("leases", "counts")),
+    GuardSpec("paddle_operator_tpu.artifacts.store", "ArtifactStore",
+              "_lock", ("_inflight", "_stats", "_warned")),
+    GuardSpec("paddle_operator_tpu.artifacts.store", "_SingletonState",
+              "_lock", ("store", "key")),
     GuardSpec("paddle_operator_tpu.compile_cache", "_CacheState", "_lock",
               ("memo", "stats", "enabled_dir")),
     GuardSpec("paddle_operator_tpu.controllers.coordination",

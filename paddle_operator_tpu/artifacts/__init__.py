@@ -1,0 +1,23 @@
+"""Fleet compile-artifact store (docs/design.md "Fleet compile-artifact
+store"): content-addressed, CRC-pinned bundles keyed by
+``compile_cache.step_fingerprint``, a shared-directory local tier plus
+an operator-served HTTP tier, and a compile-lease/singleflight protocol
+so a cold fleet pays ONE compilation instead of stampeding XLA.
+
+The compile ladder (:mod:`..compile_cache`) consumes this package as
+its rung 0: fetch-by-fingerprint before compiling, publish after the
+first compile. Everything degrades to a recompile — never to a wrong
+answer, never to a hang.
+"""
+
+from .bundle import PoisonedArtifactError, pack, parse
+from .store import (
+    ArtifactStore, CompileLease, TIERS, enabled, get_store, metrics_text,
+    reset_for_tests, stats_block,
+)
+
+__all__ = [
+    "ArtifactStore", "CompileLease", "PoisonedArtifactError", "TIERS",
+    "enabled", "get_store", "metrics_text", "pack", "parse",
+    "reset_for_tests", "stats_block",
+]

@@ -16,6 +16,7 @@ seeds; every later scaling PR regression-tests against this harness.
 """
 
 from .api_faults import ChaosKubeClient, FaultInjector
+from .artifact_faults import run_artifact_scenario
 from .data_faults import ChaosSourceError, FaultySource, run_loader_scenario
 from .harness import ChaosHarness, ChaosReport, run_scenario
 from .plan import CONTROL_SCENARIOS, SCENARIOS, ChaosPlan, FaultEvent, \
@@ -28,6 +29,6 @@ __all__ = [
     "ChaosHarness", "ChaosKubeClient", "ChaosPlan", "ChaosReport",
     "ChaosSourceError", "CONTROL_SCENARIOS", "FaultEvent", "FaultInjector",
     "FaultySource", "PodChaos", "SCENARIOS", "TenantFleetRun",
-    "build_plan", "run_loader_scenario", "run_recovery_scenario",
-    "run_scenario", "run_tenant_scenario",
+    "build_plan", "run_artifact_scenario", "run_loader_scenario",
+    "run_recovery_scenario", "run_scenario", "run_tenant_scenario",
 ]

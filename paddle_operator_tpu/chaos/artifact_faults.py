@@ -1,0 +1,244 @@
+"""``artifact_poison`` — the fleet artifact store's verify-not-trust
+proof, run as a seeded chaos scenario.
+
+Two simulated hosts share one artifact-store tier:
+
+1. **host A** (fresh compile-cache dir) builds a real (tiny) jitted step
+   through the full ladder — rung 0 misses, A takes the compile lease,
+   compiles, and PUBLISHES the bundle (AOT executable + XLA
+   persistent-cache entries).
+2. The seed decides the store's fate: clean (half the seeds), or the
+   bundle is poisoned the way real storage/serving fails — **flipped
+   payload bytes**, a **torn file** (truncated mid-write), or a **stale
+   fingerprint** (the bundle re-keyed under the wrong digest, the
+   mis-served-object case).
+3. **host B** (fresh cache dir, fresh ladder state) builds the same
+   step: a clean store must serve it (fleet hit, zero compile seconds);
+   a poisoned store must REJECT the artifact (counted in
+   ``tpujob_artifact_poisoned_rejected_total``) and downgrade to a
+   recompile — and either way host B's loss must be BIT-IDENTICAL to
+   host A's (EasyScale bar: the store can cost time, never numerics).
+
+The goodput ledger rides along on a deterministic tick clock: each
+host's recompile charges one tick of ``compile`` badput, so the extra
+compile badput a poisoned artifact causes is a conserved, replayable
+fact — the audit asserts ``wall == goodput + Σ badput`` and that the
+``compile`` bucket grew by EXACTLY the poisoned recompile. Everything
+derives from the plan seed, so the run replays byte-identically and its
+facts join the chaos fingerprint.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import tempfile
+from typing import Dict, List, Tuple
+
+from .api_faults import FaultInjector
+
+#: deterministic ledger pricing: one tick of Running wall per phase of
+#: the scenario, one tick of ``compile`` badput per recompile a host
+#: actually paid (real compile wall is machine noise; counts are facts)
+TICKS_PER_HOST = 4.0
+COMPILE_CHARGE_S = 1.0
+
+POISON_MODES = ("flip_bytes", "torn_file", "stale_fingerprint")
+
+
+class _TickClock:
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def _poison_bundle(store_dir: str, mode: str) -> str:
+    """Damage the published bundle the way real storage fails. Returns
+    the bundle filename poisoned."""
+    from ..artifacts import bundle, parse
+
+    (path,) = glob.glob(os.path.join(store_dir, "*" + bundle.SUFFIX))
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if mode == "flip_bytes":
+        raw = bytearray(data)
+        raw[-1] ^= 0xFF  # bit rot inside the last member's payload
+        with open(path, "wb") as fh:
+            fh.write(bytes(raw))
+    elif mode == "torn_file":
+        with open(path, "wb") as fh:
+            fh.write(data[: len(data) // 2])  # torn mid-write
+    else:  # stale_fingerprint: the bundle re-keyed under a wrong digest
+        fp = os.path.basename(path)[: -len(bundle.SUFFIX)]
+        members = parse(data, fp)
+        with open(path, "wb") as fh:
+            fh.write(bundle.pack("0" * len(fp), members))
+    return os.path.basename(path)
+
+
+def run_artifact_scenario(plan, injector: FaultInjector
+                          ) -> Tuple[Dict[str, object], List[str]]:
+    """Run the two-host publish/fetch/poison incident for ``plan.seed``.
+    Returns (facts-for-the-fingerprint, violations)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import artifacts, compile_cache
+    from ..obs.ledger import GoodputLedger
+
+    mode = None
+    for ev in plan.events:
+        if ev.kind == "artifact_poison":
+            mode = ev.params.get("mode")
+    violations: List[str] = []
+    facts: Dict[str, object] = {"poison": mode or "none"}
+
+    # the step closes over a per-seed constant so every seed gets its
+    # own fingerprint (and its own deterministic loss bits)
+    scale = 1.0 + plan.seed * 1e-3
+
+    def mlp_loss(params, batch):
+        h = jnp.tanh(batch["x"] @ params["w1"]) * scale
+        out = h @ params["w2"]
+        return ((out - batch["y"]) ** 2).mean(), {}
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0), 4)
+    p = {"w1": jax.random.normal(k1, (16, 32), jnp.float32) * 0.1,
+         "w2": jax.random.normal(k2, (32, 4), jnp.float32) * 0.1}
+    b = {"x": jax.random.normal(k3, (8, 16), jnp.float32),
+         "y": jax.random.normal(k4, (8, 4), jnp.float32)}
+
+    clock = _TickClock()
+    ledger = GoodputLedger(clock=clock)
+    saved_env = {k: os.environ.get(k)
+                 for k in ("TPUJOB_ARTIFACT_STORE", "TPUJOB_ARTIFACT_URL",
+                           "TPUJOB_COMPILE_CACHE_DIR")}
+
+    def _host(name: str, cache_dir: str) -> Tuple[str, Dict[str, float]]:
+        """One fresh-process ladder build (reset_stats simulates the
+        restart, the test_compile_cache pattern): returns (loss bits,
+        ladder stats delta). Books TICKS_PER_HOST seconds of Running
+        wall and COMPILE_CHARGE_S of compile badput per recompile."""
+        os.environ["TPUJOB_COMPILE_CACHE_DIR"] = cache_dir
+        compile_cache.reset_stats_for_tests()
+        ledger.observe_phase("default", name, "Running")
+        step = compile_cache.cached_jit(mlp_loss, (p, b),
+                                        label="artifact-chaos")
+        loss, _ = step(p, b)
+        clock.advance(TICKS_PER_HOST)
+        s = compile_cache.stats()
+        compiles = int(s["aot_misses"] + s["jit_fallbacks"])
+        for _ in range(compiles):
+            injector.record("artifact_recompile")
+            moved = ledger.charge("default", name, "compile",
+                                  COMPILE_CHARGE_S)
+            if abs(moved - COMPILE_CHARGE_S) > 1e-9:
+                violations.append(
+                    "host %s: compile charge clamped (%.3f of %.3f moved)"
+                    % (name, moved, COMPILE_CHARGE_S))
+        ledger.observe_phase("default", name, "Completed")
+        return float(loss).hex(), s
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaos-art-") as store_dir, \
+                tempfile.TemporaryDirectory(prefix="chaos-art-a-") as dir_a, \
+                tempfile.TemporaryDirectory(prefix="chaos-art-b-") as dir_b:
+            os.environ["TPUJOB_ARTIFACT_STORE"] = store_dir
+            os.environ.pop("TPUJOB_ARTIFACT_URL", None)
+            artifacts.reset_for_tests()
+
+            loss_a, stats_a = _host("host-a", dir_a)
+            facts["loss"] = loss_a
+            aot_supported = stats_a["aot_saves"] > 0
+            facts["aot_supported"] = aot_supported
+            if not aot_supported:
+                # this backend cannot serialize executables: the store
+                # has nothing to poison — a deterministic no-op seed
+                facts["fetch"] = "unsupported"
+                return facts, violations
+
+            store = artifacts.get_store()
+            if store.stats().get("publishes_local", 0) < 1:
+                violations.append("host A compiled but published nothing")
+
+            if mode is not None:
+                injector.record("artifact_poison")
+                _poison_bundle(store_dir, mode)
+
+            before = store.stats()
+            loss_b, stats_b = _host("host-b", dir_b)
+            delta = {k: store.stats().get(k, 0) - before.get(k, 0)
+                     for k in store.stats()}
+            facts["poisoned_rejected"] = int(delta.get("poisoned_local", 0))
+            facts["fleet_hits"] = int(stats_b["fleet_hits"])
+            facts["recompiles_b"] = int(stats_b["aot_misses"]
+                                        + stats_b["jit_fallbacks"])
+
+            if loss_b != loss_a:
+                violations.append(
+                    "host B loss %s != host A loss %s — the store "
+                    "changed numerics" % (loss_b, loss_a))
+            if mode is None:
+                if stats_b["fleet_hits"] != 1:
+                    violations.append(
+                        "clean store but host B did not get a fleet hit "
+                        "(%r)" % (stats_b,))
+                if facts["recompiles_b"]:
+                    violations.append(
+                        "clean store but host B recompiled %d time(s)"
+                        % facts["recompiles_b"])
+            else:
+                if delta.get("poisoned_local", 0) < 1:
+                    violations.append(
+                        "poisoned (%s) artifact was not rejected (%r)"
+                        % (mode, delta))
+                if stats_b["fleet_hits"]:
+                    violations.append(
+                        "poisoned (%s) artifact SERVED host B — wrong-"
+                        "answer hazard" % mode)
+                if facts["recompiles_b"] != 1:
+                    violations.append(
+                        "poisoned store: expected exactly one downgrade "
+                        "recompile on host B, saw %d"
+                        % facts["recompiles_b"])
+                # the recompile re-published: the store must be healed
+                healed, _tier = store.fetch(
+                    compile_cache.step_fingerprint(mlp_loss, (p, b)))
+                if not healed or "aot" not in healed:
+                    violations.append(
+                        "host B's recompile did not heal the poisoned "
+                        "store entry")
+
+            # conservation: every host's wall fully attributed, and the
+            # compile bucket grew by EXACTLY the recompiles' charges
+            expect_compile = {
+                "host-a": COMPILE_CHARGE_S,  # cold fleet: A always pays
+                "host-b": COMPILE_CHARGE_S * facts["recompiles_b"],
+            }
+            for host in ("host-a", "host-b"):
+                snap = ledger.snapshot("default", host)
+                attributed = snap["goodput"] + sum(snap["badput"].values())
+                if abs(attributed - snap["wall"]) > 1e-6:
+                    violations.append(
+                        "%s: conservation broken: %.6f attributed vs "
+                        "%.6f wall" % (host, attributed, snap["wall"]))
+                got = snap["badput"].get("compile", 0.0)
+                if abs(got - expect_compile[host]) > 1e-6:
+                    violations.append(
+                        "%s: compile badput %.3fs != expected %.3fs"
+                        % (host, got, expect_compile[host]))
+                facts["%s_compile_badput_s" % host] = round(got, 3)
+    finally:
+        compile_cache.reset_stats_for_tests()
+        artifacts.reset_for_tests()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return facts, violations

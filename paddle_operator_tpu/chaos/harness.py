@@ -797,6 +797,21 @@ def run_scenario(scenario: str, seed: int, quick: bool = True) -> ChaosReport:
             ticks=summary["batches"], faults=dict(injector.counts),
             jobs={}, violations=violations,
             wall_s=time.perf_counter() - t0)
+    if scenario == "artifact_poison":
+        # the compile-plane leg (chaos.artifact_faults): two fresh-
+        # ladder hosts over one store tier; a poisoned bundle must
+        # downgrade to a recompile with bit-identical loss and the
+        # extra compile badput conserved in the ledger
+        from .artifact_faults import run_artifact_scenario
+
+        t0 = time.perf_counter()
+        injector = FaultInjector()
+        facts, violations = run_artifact_scenario(plan, injector)
+        return ChaosReport(
+            scenario, seed, converged=not violations, ticks=1,
+            faults=dict(injector.counts), jobs={},
+            violations=violations, wall_s=time.perf_counter() - t0,
+            extra=facts)
     harness = ChaosHarness(plan)
     report = harness.run()
     if scenario == "graceful_drain":

@@ -43,6 +43,10 @@ Fault taxonomy (``FaultEvent.kind``):
                           slice until the feedback loop evicts and re-gangs
                           it (``multi_tenant``); in ``goodput_audit`` a
                           worker-reported straggler overlap-loss charge
+``artifact_poison``       corrupt the published compile-artifact bundle
+                          (flipped bytes / torn file / stale fingerprint)
+                          before a peer fetches it (``artifact_poison``
+                          scenario, chaos.artifact_faults)
 ========================  ====================================================
 
 ``graceful_drain`` runs a second, training-plane leg after the control-plane
@@ -70,7 +74,8 @@ CONTROL_SCENARIOS = (
     "graceful_drain", "operator_crash", "control_plane_storm",
     "goodput_audit",
 )
-SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant")
+SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant",
+                                 "artifact_poison")
 
 #: control_plane_storm fleet shape: 500+ TpuJobs (the ISSUE-7 scale bar)
 #: churning through the PARALLEL workqueue (drain workers > 1) while api
@@ -129,6 +134,7 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "goodput_audit": _goodput_audit,
         "loader_faults": _loader_faults,
         "multi_tenant": _multi_tenant,
+        "artifact_poison": _artifact_poison,
     }[scenario]
     events, horizon = builder(rng, quick)
     return ChaosPlan(scenario, seed, events, horizon)
@@ -430,6 +436,25 @@ def _control_plane_storm(rng: random.Random, quick: bool
     events.append(FaultEvent(t0 + rng.randint(2, 4), "watch_restore",
                              {"kind": "Pod"}))
     return events, 80 if quick else 140
+
+
+def _artifact_poison(rng: random.Random, quick: bool
+                     ) -> Tuple[List[FaultEvent], int]:
+    """The fleet artifact store's verify-not-trust proof (see
+    chaos.artifact_faults): host A compiles + publishes, host B fetches
+    before compiling. Half the seeds leave the store clean (B must take
+    the fleet hit, zero compile badput); the rest poison the published
+    bundle one of the three ways real storage/serving fails — flipped
+    payload bytes, a torn file, a stale fingerprint — and B must
+    reject-and-recompile with bit-identical loss, the extra ``compile``
+    badput conserved in the ledger."""
+    events: List[FaultEvent] = []
+    if rng.random() < 0.5:
+        events.append(FaultEvent(0, "artifact_poison",
+                                 {"mode": rng.choice(
+                                     list(("flip_bytes", "torn_file",
+                                           "stale_fingerprint")))}))
+    return events, 8
 
 
 def _loader_faults(rng: random.Random, quick: bool

@@ -47,7 +47,8 @@ import os
 import pickle
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Set, Tuple
 
 log = logging.getLogger("tpujob.compile_cache")
 
@@ -66,8 +67,11 @@ class _CacheState:
         self._lock = threading.Lock()
         # fingerprint -> callable (in-process memo: a resumed cycle in
         # the SAME process — elastic restart without pod loss — pays
-        # nothing at all)
-        self.memo: Dict[str, Callable] = {}
+        # nothing at all). LRU-BOUNDED (TPUJOB_COMPILE_CACHE_MEMO_MAX):
+        # a long-lived harness churning many distinct step shapes must
+        # not pin every executable it ever built (the PR 10 churn-
+        # boundedness bar); eviction only costs an .aotx reload.
+        self.memo: "OrderedDict[str, Callable]" = OrderedDict()
         self.stats: Dict[str, Any] = {
             "persistent_enabled": False,
             "persistent_dir": "",
@@ -77,9 +81,11 @@ class _CacheState:
             "persistent_misses": 0,
             # this module's own ladder accounting
             "memo_hits": 0,
+            "memo_evictions": 0,  # LRU-bounded in-process memo
             "aot_hits": 0,       # deserialized a saved executable
             "aot_misses": 0,     # compiled AOT fresh (and tried to save)
             "aot_saves": 0,      # executables serialized to disk
+            "fleet_hits": 0,     # executable served by the artifact store
             "jit_fallbacks": 0,  # AOT unavailable -> plain jax.jit
             "compile_seconds": 0.0,  # wall in lower+compile / jit warmup
         }
@@ -99,6 +105,32 @@ _guards.guard_declared(_state)
 
 def cache_enabled() -> bool:
     return os.environ.get("TPUJOB_COMPILE_CACHE", "1") != "0"
+
+
+def memo_cap() -> int:
+    """Bound on the in-process executable memo (LRU entries)."""
+    try:
+        return max(1, int(os.environ.get(
+            "TPUJOB_COMPILE_CACHE_MEMO_MAX", "64")))
+    except ValueError:
+        return 64
+
+
+def memo_size() -> int:
+    with _state._lock:
+        return len(_state.memo)
+
+
+def _memo_put_locked(fp: str, fn: Callable) -> None:
+    """Insert into the bounded LRU memo (caller holds ``_state._lock``).
+    Evicting costs at most one ``.aotx`` reload on the next rebuild —
+    never a recompile, the disk rungs still hold the executable."""
+    _state.memo[fp] = fn
+    _state.memo.move_to_end(fp)
+    cap = memo_cap()
+    while len(_state.memo) > cap:
+        _state.memo.popitem(last=False)
+        _state.stats["memo_evictions"] += 1
 
 
 def aot_enabled() -> bool:
@@ -430,38 +462,222 @@ def load_step_cost(fingerprint: str) -> Optional[Dict[str, Any]]:
     step — the hardware-efficiency plane's warm-restart rung: a
     cache-served executable must not pay a fresh trace just to learn
     its own FLOPs (the probe would hand back part of the startup tax
-    the AOT rung removed). None on miss/corruption, never raises."""
+    the AOT rung removed). None on miss, never raises; a torn/corrupt
+    sidecar is DELETED-as-miss with one warning, exactly like a torn
+    ``.aotx`` — the next probe re-saves a good one."""
     path = _cost_path(fingerprint)
-    if not path or not os.path.exists(path):
+    if not path:
         return None
+    if not os.path.exists(path):
+        # the fleet store may carry the first prober's figures —
+        # member-scoped, so this never downloads the executable payload
+        members = _artifact_fetch_members(fingerprint, member="cost")
+        if members and isinstance(members.get("cost"), bytes):
+            _atomic_write(path, members["cost"])
+        if not os.path.exists(path):
+            return None
     import json
 
     try:
         with open(path) as fh:
             raw = json.load(fh)
-        return raw if isinstance(raw, dict) else None
-    except (OSError, ValueError):
+    except OSError:
         return None
+    except ValueError:
+        log.warning("discarding corrupt step-cost sidecar %s "
+                    "(torn write?); next probe re-saves it", path)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    if not isinstance(raw, dict):
+        log.warning("discarding malformed step-cost sidecar %s "
+                    "(expected an object, got %s)",
+                    path, type(raw).__name__)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    return raw
 
 
 def save_step_cost(fingerprint: str, cost: Dict[str, Any]) -> None:
     """Persist a probed step cost next to the AOT executable (atomic
-    publish, same tmp+rename discipline as the executables)."""
+    publish, same tmp+rename discipline as the executables) and into
+    the fleet artifact store when one is configured, so a peer's warm
+    start learns its FLOPs without a trace. Never raises — an
+    unserializable cost dict or a full disk costs one re-probe, not
+    the run."""
     path = _cost_path(fingerprint)
     if not path:
         return
     import json
 
+    try:
+        payload = json.dumps(cost).encode()
+    except (TypeError, ValueError) as e:
+        log.warning("step cost for %s not JSON-serializable (%s); "
+                    "not persisted", fingerprint[:12], e)
+        return
+    if not _atomic_write(path, payload):
+        return
+    from . import artifacts
+
+    store = artifacts.get_store()
+    if store is not None:
+        store.publish(fingerprint, {"cost": payload})
+
+
+def _atomic_write(path: str, payload: bytes) -> bool:
+    """tmp + ``os.replace`` publish — readers never observe a torn file.
+    Returns False (never raises) on an unwritable target."""
     tmp = "%s.tmp.%d" % (path, os.getpid())
     try:
-        with open(tmp, "w") as fh:
-            json.dump(cost, fh)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(tmp, "wb") as fh:
+            fh.write(payload)
         os.replace(tmp, path)
+        return True
     except OSError:
         try:
             os.remove(tmp)
         except OSError:
             pass
+        return False
+
+
+# ---------------------------------------------------------------------------
+# rung 0: the fleet artifact store (paddle_operator_tpu.artifacts)
+# ---------------------------------------------------------------------------
+
+def _persistent_dir() -> Optional[str]:
+    with _state._lock:
+        base = _state.stats["persistent_dir"]
+    return base or None
+
+
+def _snapshot_persistent_files() -> Set[str]:
+    """Top-level files of the persistent compilation cache directory —
+    the XLA cache entries live here; our own artifacts (``aot/``
+    subdir, probe/tmp files) are excluded."""
+    base = _persistent_dir()
+    if not base:
+        return set()
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return set()
+    return {n for n in names
+            if not n.startswith(".") and ".tmp" not in n
+            and os.path.isfile(os.path.join(base, n))}
+
+
+def _collect_new_persistent(before: Set[str]) -> Dict[str, bytes]:
+    """XLA persistent-cache entries this compile created, as ``xla/<n>``
+    bundle members — shipping them warms a peer's persistent rung even
+    when its AOT deserialize fails (foreign jax build), and it is the
+    only fleet rung donating steps get."""
+    base = _persistent_dir()
+    if not base:
+        return {}
+    members: Dict[str, bytes] = {}
+    for name in sorted(_snapshot_persistent_files() - before):
+        try:
+            with open(os.path.join(base, name), "rb") as fh:
+                members["xla/" + name] = fh.read()
+        except OSError:
+            continue
+    return members
+
+
+def _artifact_fetch_members(fingerprint: str,
+                            member: Optional[str] = None
+                            ) -> Optional[Dict[str, bytes]]:
+    from . import artifacts
+
+    store = artifacts.get_store()
+    if store is None:
+        return None
+    members, _tier = store.fetch(fingerprint, member=member)
+    return members
+
+
+def _install_members(fingerprint: str, members: Dict[str, bytes],
+                     aot_path: Optional[str]) -> bool:
+    """Write verified fetched members into the local ladder's own
+    layout. Returns True iff an AOT executable landed at ``aot_path``
+    (the caller then loads it through the normal torn-proof path)."""
+    installed_aot = False
+    base = _persistent_dir()
+    for name in sorted(members):
+        payload = members[name]
+        if name == "aot" and aot_path:
+            installed_aot = _atomic_write(aot_path, payload)
+        elif name == "cost":
+            cpath = _cost_path(fingerprint)
+            if cpath:
+                _atomic_write(cpath, payload)
+        elif name.startswith("xla/") and base:
+            fn = os.path.basename(name[len("xla/"):])
+            target = os.path.join(base, fn)
+            if fn and not os.path.exists(target):
+                _atomic_write(target, payload)
+    return installed_aot
+
+
+def _fleet_rung(store, fingerprint: str, aot_path: str, label: str):
+    """Fetch-before-compile + compile-lease singleflight (rung 0).
+
+    Returns ``(loaded, tier, lease)``: a loaded executable and the tier
+    that served it, OR a granted lease (this process is the fleet's one
+    compiler for the fingerprint), OR ``(None, None, None)`` — the
+    bounded wait expired / the store is degraded, compile leaseless
+    (duplicate work, never a wedge).
+    """
+    members, tier = store.fetch(fingerprint)
+    if members is not None and _install_members(fingerprint, members,
+                                                aot_path):
+        got = _try_load_aot(aot_path)
+        if got is not None:
+            return got, tier, None
+    deadline = time.monotonic() + store.wait_s
+    while True:
+        lease = store.acquire_compile_lease(fingerprint)
+        if lease.granted:
+            # re-fetch under the lease before compiling: a peer may
+            # have published and RELEASED between our last miss and
+            # this acquire (publish strictly precedes release, so once
+            # we hold the lease a completed publish is visible) —
+            # without this, a waiter that raced the release would
+            # re-pay the compile the fleet just finished
+            members, tier = store.fetch(fingerprint)
+            if members is not None and _install_members(
+                    fingerprint, members, aot_path):
+                got = _try_load_aot(aot_path)
+                if got is not None:
+                    lease.release()
+                    return got, tier, None
+            return None, None, lease
+        log.info("compile lease for %s (%s) held by a peer; "
+                 "waiting-then-fetching (bounded %.0fs)",
+                 label or "step", fingerprint[:12], store.wait_s)
+        members, tier = store.wait_fetch(fingerprint, deadline)
+        if members is not None:
+            if _install_members(fingerprint, members, aot_path):
+                got = _try_load_aot(aot_path)
+                if got is not None:
+                    return got, tier, None
+            # a bundle with no usable executable (cost-only, or a
+            # deserialize reject): nothing more will arrive — compile
+            return None, None, None
+        if time.monotonic() >= deadline:
+            return None, None, None
+        # lease freed without a publish (holder died mid-compile):
+        # loop re-tries the acquire — we may become the compiler
 
 
 def _try_load_aot(path: str) -> Optional[Callable]:
@@ -520,11 +736,16 @@ class CachedStep:
     def __init__(self, fn: Callable, source: str, fingerprint: str,
                  compile_seconds: float,
                  fallback: Optional[Callable[[], Callable]] = None,
-                 aot_path: Optional[str] = None):
+                 aot_path: Optional[str] = None,
+                 on_fallback: Optional[Callable[[], None]] = None):
         self._fn = fn
         self._fallback = fallback
         self._called_ok = False
         self._aot_path = aot_path
+        # verify-not-trust, second trigger: a store-served executable
+        # that is CRC-valid but semantically stale still gets rejected
+        # here — the hook lets the artifact store count it
+        self._on_fallback = on_fallback
         self.source = source
         self.fingerprint = fingerprint
         self.compile_seconds = compile_seconds
@@ -547,11 +768,16 @@ class CachedStep:
                     os.remove(self._aot_path)
                 except OSError:
                     pass
+            if self._on_fallback is not None:
+                try:
+                    self._on_fallback()
+                except Exception:
+                    pass  # accounting must never take the step down
             self._fn = self._fallback()
             self.source = "jit"
             with _state._lock:
                 _state.stats["jit_fallbacks"] += 1
-                _state.memo[self.fingerprint] = self._fn
+                _memo_put_locked(self.fingerprint, self._fn)
             out = self._fn(*args)
         self._called_ok = True
         self._fallback = None
@@ -600,6 +826,7 @@ def cached_jit(fn: Callable, example_args: Tuple,
         hit = _state.memo.get(fp)
         if hit is not None:
             _state.stats["memo_hits"] += 1
+            _state.memo.move_to_end(fp)  # LRU freshness
             return CachedStep(hit, "memo", fp, 0.0)
 
     abstract = _abstractify(example_args)
@@ -618,42 +845,84 @@ def cached_jit(fn: Callable, example_args: Tuple,
     use_aot = aot_enabled() and not donate_argnums
     path = _aot_path(fp) if use_aot else None
 
+    store = None
+    lease = None
     if use_aot:
         loaded = _try_load_aot(path)
+        fleet_tier: Optional[str] = None
+        if loaded is None:
+            # rung 0: the fleet artifact store — fetch by fingerprint
+            # before compiling; when a peer holds the compile lease,
+            # wait-then-fetch with a bounded deadline
+            from . import artifacts
+
+            store = artifacts.get_store()
+            if store is not None:
+                loaded, fleet_tier, lease = _fleet_rung(
+                    store, fp, path, label)
         if loaded is not None:
             with _state._lock:
                 _state.stats["aot_hits"] += 1
-                _state.memo[fp] = loaded
-            log.info("AOT executable reused for %s (%s)",
-                     label or "step", fp[:12])
+                if fleet_tier is not None:
+                    _state.stats["fleet_hits"] += 1
+                _memo_put_locked(fp, loaded)
+            log.info("AOT executable reused for %s (%s%s)",
+                     label or "step", fp[:12],
+                     ", fleet tier=%s" % fleet_tier if fleet_tier else "")
+            on_fb = None
+            if fleet_tier is not None:
+                on_fb = (lambda s=store, t=fleet_tier:
+                         s.note_first_call_reject(t))
             return CachedStep(loaded, "aot", fp, 0.0, fallback=rebuild,
-                              aot_path=path)
+                              aot_path=path, on_fallback=on_fb)
 
-    t0 = time.perf_counter()
-    jitted = jax.jit(fn, **jit_kwargs)
-    compiled: Optional[Callable] = None
-    source = "jit"
-    if use_aot:
-        try:
-            compiled = jitted.lower(*abstract).compile()
-            source = "compiled"
-        except Exception as e:
-            # shape-polymorphic / backend quirks: stay on plain jit — the
-            # persistent cache still applies to its first real call
-            log.info("AOT lowering unavailable for %s, plain jit: %s",
-                     label or "step", e)
-    dt = time.perf_counter() - t0
-    out_fn = compiled if compiled is not None else jitted
-    with _state._lock:
-        _state.stats["compile_seconds"] += dt
-        if compiled is not None:
-            _state.stats["aot_misses"] += 1
-        else:
-            _state.stats["jit_fallbacks"] += 1
-        _state.memo[fp] = out_fn
-    if compiled is not None and _try_save_aot(path, compiled):
+    # the granted lease must survive NO exception past this point: a
+    # leaked lease wedges every later build of this fingerprint (this
+    # process's inflight table never clears; fleet peers wait out the
+    # TTL) — so the WHOLE compile section sits under its release
+    try:
+        xla_before: Set[str] = (_snapshot_persistent_files()
+                                if store is not None else set())
+        t0 = time.perf_counter()
+        jitted = jax.jit(fn, **jit_kwargs)
+        compiled: Optional[Callable] = None
+        source = "jit"
+        if use_aot:
+            try:
+                compiled = jitted.lower(*abstract).compile()
+                source = "compiled"
+            except Exception as e:
+                # shape-polymorphic / backend quirks: stay on plain jit —
+                # the persistent cache still applies to its first call
+                log.info("AOT lowering unavailable for %s, plain jit: %s",
+                         label or "step", e)
+        dt = time.perf_counter() - t0
+        out_fn = compiled if compiled is not None else jitted
         with _state._lock:
-            _state.stats["aot_saves"] += 1
+            _state.stats["compile_seconds"] += dt
+            if compiled is not None:
+                _state.stats["aot_misses"] += 1
+            else:
+                _state.stats["jit_fallbacks"] += 1
+            _memo_put_locked(fp, out_fn)
+        saved = compiled is not None and _try_save_aot(path, compiled)
+        if saved:
+            with _state._lock:
+                _state.stats["aot_saves"] += 1
+        if store is not None and saved:
+            # publish-after-compile: the serialized executable plus the
+            # XLA persistent entries this compile wrote — one fetch
+            # warms a peer's whole ladder
+            members = _collect_new_persistent(xla_before)
+            try:
+                with open(path, "rb") as fh:
+                    members["aot"] = fh.read()
+            except OSError:
+                pass
+            store.publish(fp, members)
+    finally:
+        if lease is not None:
+            lease.release()
     return CachedStep(out_fn, source, fp, dt,
                       fallback=rebuild if compiled is not None else None)
 
@@ -674,16 +943,20 @@ def reset_stats_for_tests() -> None:
         _state.stats.update(
             persistent_enabled=False, persistent_dir="",
             persistent_hits=0, persistent_misses=0, memo_hits=0,
-            aot_hits=0, aot_misses=0, aot_saves=0,
-            jit_fallbacks=0, compile_seconds=0.0)
+            memo_evictions=0, aot_hits=0, aot_misses=0, aot_saves=0,
+            fleet_hits=0, jit_fallbacks=0, compile_seconds=0.0)
 
 
 def startup_block() -> Dict[str, Any]:
     """The compact summary bench.py embeds as the ``startup.compile_cache``
     block and the runner as ``result["compile_cache"]``: which rung served
     this process, plus the hit/miss ledger."""
+    from . import artifacts
+
     s = stats()
-    if s["aot_hits"]:
+    if s["fleet_hits"]:
+        cache = "fleet"
+    elif s["aot_hits"]:
         cache = "aot"
     elif s["persistent_hits"] > 0:
         cache = "warm"
@@ -696,9 +969,11 @@ def startup_block() -> Dict[str, Any]:
         "persistent_misses": s["persistent_misses"],
         "aot_hits": s["aot_hits"],
         "aot_misses": s["aot_misses"],
+        "fleet_hits": s["fleet_hits"],
         "memo_hits": s["memo_hits"],
         "jit_fallbacks": s["jit_fallbacks"],
         "compile_seconds": round(s["compile_seconds"], 2),
+        "artifacts": artifacts.stats_block(),
     }
 
 

@@ -167,6 +167,19 @@ def main(argv=None):
                          "repeatable; 'none' disables; default: the "
                          "stock goodput / time-to-running / step-latency "
                          "set (docs/observability.md \"Goodput & SLOs\")")
+    ap.add_argument("--artifact-store-bind-address", default="",
+                    help="bind for the fleet compile-artifact store "
+                         "('' disables; e.g. ':8083'): runners publish "
+                         "serialized AOT executables + persistent-cache "
+                         "entries + step costs after first compile and "
+                         "peers fetch by fingerprint before compiling "
+                         "(docs/design.md 'Fleet compile-artifact "
+                         "store'); point workers at it with "
+                         "TPUJOB_ARTIFACT_URL")
+    ap.add_argument("--artifact-store-dir", default="",
+                    help="bundle directory the artifact store serves "
+                         "(default: $TPUJOB_ARTIFACT_STORE, else "
+                         "~/.cache/tpujob/artifacts)")
     ap.add_argument("--fleet-sched", action="store_true",
                     help="enable the fleet capacity arbiter (sched/): "
                          "priority + weighted fair-share admission over "
@@ -299,6 +312,18 @@ def main(argv=None):
                 args.webhook_bind_address, cert_file=cert, key_file=key)
             webhook_srv.start()
 
+    artifact_srv = None
+    if args.artifact_store_bind_address:
+        from .artifacts.server import ArtifactServer
+
+        store_dir = (args.artifact_store_dir
+                     or os.environ.get("TPUJOB_ARTIFACT_STORE", "")
+                     or os.path.expanduser("~/.cache/tpujob/artifacts"))
+        artifact_srv = ArtifactServer(args.artifact_store_bind_address,
+                                      store_dir=store_dir).start()
+        log.info("artifact store serving %s at %s", store_dir,
+                 artifact_srv.url)
+
     arbiter = None
     if args.fleet_sched:
         from .sched import FeedbackController, FleetArbiter, feedback_enabled
@@ -363,6 +388,10 @@ def main(argv=None):
     )
     ctrl.backoff_provider = reconciler.current_backoff
     mgr.add_metrics_provider(job_metrics.metrics_block)
+    if artifact_srv is not None:
+        # tpujob_artifact_server_requests_total: the served tier's
+        # fetch/publish/lease traffic on the operator's own scrape
+        mgr.add_metrics_provider(artifact_srv.metrics_text)
     if arbiter is not None:
         mgr.add_metrics_provider(arbiter.metrics_block)
         if arbiter.feedback is not None:
@@ -455,6 +484,8 @@ def main(argv=None):
         coord_srv.stop()
     if webhook_srv is not None:
         webhook_srv.stop()
+    if artifact_srv is not None:
+        artifact_srv.stop()
     return exit_code[0]
 
 

@@ -37,6 +37,7 @@ class OperatorHarness:
         reconcile_workers: int = 1,
         metrics_clock=None,
         slo_specs=None,
+        artifact_server: bool = False,
     ):
         self.client = FakeKubeClient()
         self.client.register_kind(api.API_VERSION, api.KIND, api.PLURAL)
@@ -68,6 +69,13 @@ class OperatorHarness:
         # declarative SLOs evaluated at scrape time (None = the stock
         # default_slos set; pass [] to disable the evaluator entirely)
         self._slo_specs = slo_specs
+        # optional fleet compile-artifact store tier (artifacts.server):
+        # the backing bundle directory is cluster state — it survives an
+        # operator restart like the apiserver store does; the SERVER is
+        # operator-process memory and is rebuilt by _build_operator
+        self._artifact_server_enabled = artifact_server
+        self._artifact_dir: Optional[str] = None
+        self.artifact_server = None
         self.arbiter = None
         self.coord_server = None
         self._build_operator()
@@ -132,6 +140,17 @@ class OperatorHarness:
                 self.cached_client, ":0",
                 job_metrics=self.job_metrics).start()
             coord_url = self.coord_server.url
+        self.artifact_server = None
+        if self._artifact_server_enabled:
+            import tempfile
+
+            from .artifacts.server import ArtifactServer
+
+            if self._artifact_dir is None:
+                self._artifact_dir = tempfile.mkdtemp(
+                    prefix="tpujob-artifacts-")
+            self.artifact_server = ArtifactServer(
+                ":0", store_dir=self._artifact_dir).start()
         self.arbiter = None
         if self._arbiter_factory is not None:
             self.arbiter = self._arbiter_factory(self.cached_client,
@@ -152,6 +171,9 @@ class OperatorHarness:
                                cache=self.cache,
                                reconcile_workers=self._reconcile_workers)
         self.manager.add_metrics_provider(self.job_metrics.metrics_block)
+        if self.artifact_server is not None:
+            self.manager.add_metrics_provider(
+                self.artifact_server.metrics_text)
         if self.slo is not None:
             self.manager.add_metrics_provider(self.slo.metrics_block)
         if self.arbiter is not None:
@@ -231,6 +253,11 @@ class OperatorHarness:
         if self.coord_server is not None:
             self.coord_server.stop()
             self.coord_server = None
+        if self.artifact_server is not None:
+            # the server process memory dies; its bundle DIRECTORY is
+            # durable state and survives into the replacement
+            self.artifact_server.stop()
+            self.artifact_server = None
         # the crashed process's watch connections die with it — without
         # this, the old informer would keep feeding a zombie cache
         self.client.clear_watch_callbacks()
@@ -240,6 +267,13 @@ class OperatorHarness:
     def close(self) -> None:
         if self.coord_server is not None:
             self.coord_server.stop()
+        if self.artifact_server is not None:
+            self.artifact_server.stop()
+        if self._artifact_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._artifact_dir, ignore_errors=True)
+            self._artifact_dir = None
 
     # -- convenience -----------------------------------------------------
 

@@ -204,6 +204,76 @@ def selftest_worker_text() -> str:
     return text
 
 
+def selftest_artifact_text():
+    """Drive the fleet artifact store's client AND server expositions
+    through every op family: local publish/fetch/miss, a poisoned
+    local bundle (reject counter), a lease grant/deny/release, and a
+    real HTTP round trip (remote publish + fetch + a rejected poisoned
+    PUT) against a live ArtifactServer. Returns (client_text,
+    server_text)."""
+    import tempfile
+
+    from paddle_operator_tpu import artifacts
+    from paddle_operator_tpu.artifacts import bundle
+    from paddle_operator_tpu.artifacts.server import ArtifactServer
+
+    saved = {k: os.environ.get(k)
+             for k in ("TPUJOB_ARTIFACT_STORE", "TPUJOB_ARTIFACT_URL")}
+    try:
+        with tempfile.TemporaryDirectory() as local_dir, \
+                tempfile.TemporaryDirectory() as server_dir, \
+                ArtifactServer(":0", store_dir=server_dir) as srv:
+            os.environ["TPUJOB_ARTIFACT_STORE"] = local_dir
+            os.environ["TPUJOB_ARTIFACT_URL"] = srv.url
+            artifacts.reset_for_tests()
+            store = artifacts.get_store()
+            fp = "ab" * 16
+            store.fetch(fp)                      # miss, both tiers
+            store.publish(fp, {"aot": b"x" * 64})
+            store.fetch(fp)                      # hit (local first)
+            # poison the LOCAL bundle: the client's own verifier rejects
+            path = os.path.join(local_dir, fp + bundle.SUFFIX)
+            with open(path, "rb") as fh:
+                raw = bytearray(fh.read())
+            raw[-1] ^= 0xFF
+            with open(path, "wb") as fh:
+                fh.write(bytes(raw))
+            store.fetch(fp)   # local poisoned reject -> remote hit
+            lease = store.acquire_compile_lease(fp)
+            assert lease.granted
+            assert not store.acquire_compile_lease(fp).granted
+            lease.release()
+            # a poisoned PUT must be rejected server-side
+            code, _ = store._http("PUT", "/v1/artifact?fp=%s" % fp,
+                                  body=b"garbage not a bundle")
+            assert code == 400, "server accepted a poisoned publish"
+            client_text = artifacts.metrics_text()
+            server_text = srv.metrics_text()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        artifacts.reset_for_tests()
+    for fam in ("tpujob_artifact_hits_total",
+                "tpujob_artifact_misses_total",
+                "tpujob_artifact_publishes_total",
+                "tpujob_artifact_poisoned_rejected_total",
+                "tpujob_artifact_fetch_seconds",
+                "tpujob_artifact_lease_total"):
+        assert "# TYPE %s" % fam in client_text, \
+            "artifact selftest lost %s" % fam
+    assert 'tpujob_artifact_poisoned_rejected_total{tier="local"} 1' \
+        in client_text, "the poisoned reject never counted"
+    assert 'tpujob_artifact_hits_total{tier="remote"} 1' in client_text, \
+        "the remote tier never served the post-poison fetch"
+    assert "# TYPE tpujob_artifact_server_requests_total" in server_text
+    assert 'op="publish_rejected"} 1' in server_text, \
+        "the server accepted (or failed to count) a poisoned publish"
+    return client_text, server_text
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="Prometheus exposition linter")
     ap.add_argument("files", nargs="*", help="exposition text files")
@@ -219,6 +289,10 @@ def main(argv=None) -> int:
         targets.append(("selftest:Manager.metrics_text", selftest_text()))
         targets.append(("selftest:WorkerMetricsServer.metrics_text",
                         selftest_worker_text()))
+        art_client, art_server = selftest_artifact_text()
+        targets.append(("selftest:artifacts.metrics_text", art_client))
+        targets.append(("selftest:ArtifactServer.metrics_text",
+                        art_server))
     for path in args.files:
         with open(path) as f:
             targets.append((path, f.read()))

@@ -1,0 +1,368 @@
+"""Fleet bring-up benchmark: N fresh processes through the compile-
+artifact store vs. store-disabled — one compilation, N warm starts.
+
+What PR 8 did for one host, the artifact store does for the fleet: a
+new host/replica/preempt-resume fetches the serialized executable (+
+persistent-cache entries + step costs) by ``step_fingerprint`` instead
+of re-paying XLA. Each sample here is a FLEET: N fresh python
+interpreters (the perf_startup pattern), each initializing the CPU
+backend and building the same real train step through the
+``compile_cache`` ladder, sequentially (bring-up of N replicas):
+
+  off — ``TPUJOB_ARTIFACTS=0``, own empty cache dir per process: every
+        replica pays full lowering + XLA compile
+  on  — own empty cache dirs, shared operator-served HTTP store
+        (a live :class:`~paddle_operator_tpu.artifacts.server
+        .ArtifactServer`): replica 0 compiles + publishes, replicas
+        1..N-1 fetch by fingerprint (``cache == "fleet"``, compile
+        seconds == 0)
+
+Gates (the ``make artifacts`` / ``make verify`` quick lane):
+
+* aggregate COMPILE wall (the ladder's measured lowering+XLA seconds,
+  summed over the fleet) with the store >= ``PERF_ARTIFACTS_FLOOR``
+  (default 3x) lower than without — on MEDIANS of --samples fleets
+  (PR 14 gating style: medians gate, every sample must bit-match);
+* first-step losses BIT-IDENTICAL across every process of both modes
+  (EasyScale bar: the store may move time around, never numerics);
+* the goodput ledger's fleet ``compile`` badput collapses by the same
+  floor (each replica's compile seconds charged as ``compile`` badput
+  on a deterministic clock);
+* **stampede leg**: N processes started CONCURRENTLY against an empty
+  store resolve to EXACTLY ONE fleet-wide compilation (the
+  compile-lease/singleflight proof) with everyone converging on
+  bit-identical losses;
+* **poison leg**: the published bundle gets its payload bytes flipped;
+  the next replica must REJECT it (poisoned_rejected >= 1), recompile,
+  and still match the reference loss bit-for-bit.
+
+Run:   python scripts/perf_artifact_store.py          # full: publishes
+                                                      # BENCH_ARTIFACTS.json
+       python scripts/perf_artifact_store.py --quick  # CI lane
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPEEDUP_FLOOR = float(os.environ.get("PERF_ARTIFACTS_FLOOR", "3.0"))
+
+#: the child's train step: an UNROLL-step MLP training chain — sized so
+#: the cold XLA compile is a few seconds (a real restart tax) while one
+#: executed step stays milliseconds
+DEPTH, WIDTH, BATCH, UNROLL = 16, 256, 16, 4
+
+
+def emit(**kv):
+    print(json.dumps(kv))
+    sys.stdout.flush()
+
+
+# ---------------------------------------------------------------------------
+# child: one fresh-process replica bring-up
+# ---------------------------------------------------------------------------
+
+def child_main():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    t0 = time.perf_counter()
+    jax.devices()  # first backend touch
+    backend_init_s = time.perf_counter() - t0
+
+    from paddle_operator_tpu import artifacts, compile_cache
+
+    compile_cache.enable_persistent_cache()
+
+    # eager numpy init (no jit): the measured compile is the STEP's
+    rng = np.random.RandomState(0)
+    params = {"w%d" % i: jnp.asarray(
+        rng.standard_normal((WIDTH, WIDTH)).astype(np.float32) * 0.05)
+        for i in range(DEPTH)}
+    params["out"] = jnp.asarray(
+        rng.standard_normal((WIDTH, 10)).astype(np.float32) * 0.05)
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+    x = jnp.asarray(rng.standard_normal((BATCH, WIDTH)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((BATCH, 10)).astype(np.float32))
+
+    def train_step(params, mom, xx, yy):
+        loss = jnp.float32(0)
+        for _ in range(UNROLL):
+            def loss_fn(ps):
+                h = xx
+                for i in range(DEPTH):
+                    h = jnp.tanh(h @ ps["w%d" % i])
+                return (((h @ ps["out"]) - yy) ** 2).mean()
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            mom = jax.tree_util.tree_map(
+                lambda m, gg: 0.9 * m + gg, mom, g)
+            params = jax.tree_util.tree_map(
+                lambda pp, m: pp - 0.05 * m, params, mom)
+        return params, mom, loss
+
+    t0 = time.perf_counter()
+    step = compile_cache.cached_jit(train_step, (params, mom, x, y),
+                                    label="fleet-replica")
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = step(params, mom, x, y)
+    loss = float(out[2])  # host readback: truly executed
+    first_step_s = time.perf_counter() - t0
+
+    blk = compile_cache.startup_block()
+    store = artifacts.get_store()
+    emit(backend_init_s=round(backend_init_s, 3),
+         build_s=round(build_s, 3),
+         first_step_s=round(first_step_s, 3),
+         startup_s=round(build_s + first_step_s, 3),
+         # the gated quantity: wall actually spent lowering + compiling
+         compile_s=float(blk["compile_seconds"]),
+         loss_repr=repr(loss),
+         cache=blk["cache"],
+         fleet_hits=blk["fleet_hits"],
+         artifact_stats={k: v for k, v in (store.stats() if store else
+                                           {}).items() if v})
+
+
+# ---------------------------------------------------------------------------
+# parent: fleet sampling
+# ---------------------------------------------------------------------------
+
+def run_child(cache_dir, extra_env, label, timeout_s, start=True):
+    env = dict(os.environ,
+               PERF_ARTIFACTS_CHILD="1",
+               JAX_PLATFORMS="cpu",
+               TPUJOB_COMPILE_CACHE_DIR=cache_dir,
+               TPUJOB_ARTIFACT_POLL_S="0.05",
+               **extra_env)
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE,
+                            text=True, env=env, cwd=REPO)
+    if not start:
+        return proc
+    return collect_child(proc, label, timeout_s)
+
+
+def collect_child(proc, label, timeout_s):
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise RuntimeError("fleet child (%s) hung past %ss" % (label,
+                                                               timeout_s))
+    if proc.returncode != 0:
+        raise RuntimeError("fleet child (%s) failed:\n%s"
+                           % (label, err[-2000:]))
+    sample = json.loads(out.strip().splitlines()[-1])
+    sample["mode"] = label
+    emit(**sample)
+    return sample
+
+
+def fleet_sample(n, mode, server_url, timeout_s):
+    """Bring up one N-replica fleet sequentially; returns the child
+    samples. ``mode`` is "off" (store disabled) or "on" (HTTP tier)."""
+    extra = ({"TPUJOB_ARTIFACTS": "0"} if mode == "off"
+             else {"TPUJOB_ARTIFACT_URL": server_url})
+    samples, dirs = [], []
+    try:
+        for i in range(n):
+            d = tempfile.mkdtemp(prefix="tpujob_perf_art_")
+            dirs.append(d)
+            samples.append(run_child(d, extra, "%s-%d" % (mode, i),
+                                     timeout_s))
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    return samples
+
+
+def fleet_compile_badput(samples):
+    """Price each replica's measured compile seconds as ``compile``
+    badput in a goodput ledger on a deterministic clock, and return the
+    fleet compile badput — the number the ROADMAP says must collapse."""
+    from paddle_operator_tpu.obs.ledger import GoodputLedger
+
+    clock = {"now": 0.0}
+    ledger = GoodputLedger(clock=lambda: clock["now"])
+    total = 0.0
+    for i, s in enumerate(samples):
+        name = "replica-%d" % i
+        ledger.observe_phase("bench", name, "Running")
+        clock["now"] += s["compile_s"] + 60.0  # bring-up + steady window
+        moved = ledger.charge("bench", name, "compile", s["compile_s"])
+        ledger.observe_phase("bench", name, "Completed")
+        total += moved
+    return round(total, 3)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="fleet artifact-store bring-up bench")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI lane (make artifacts): gates only, no "
+                         "JSON artifact")
+    ap.add_argument("--fleet-size", type=int,
+                    default=int(os.environ.get("PERF_ARTIFACTS_FLEET",
+                                               "4")),
+                    help="replicas per fleet sample (N >= 4)")
+    ap.add_argument("--samples", type=int, default=3,
+                    help="fleet samples per mode (median-of)")
+    ap.add_argument("--timeout", type=float,
+                    default=float(os.environ.get(
+                        "PERF_ARTIFACTS_TIMEOUT", "420")),
+                    help="per-child timeout (seconds)")
+    ap.add_argument("--out", default=None,
+                    help="JSON path (default: BENCH_ARTIFACTS.json at "
+                         "the repo root; full mode only)")
+    args = ap.parse_args()
+    n = max(4, args.fleet_size)
+    n_samples = max(1, args.samples)
+
+    from paddle_operator_tpu.artifacts.server import ArtifactServer
+
+    off_fleets, on_fleets = [], []
+    store_dirs = []
+    try:
+        for _ in range(n_samples):
+            off_fleets.append(fleet_sample(n, "off", "", args.timeout))
+            d = tempfile.mkdtemp(prefix="tpujob_perf_store_")
+            store_dirs.append(d)
+            with ArtifactServer(":0", store_dir=d) as srv:
+                on_fleets.append(fleet_sample(n, "on", srv.url,
+                                              args.timeout))
+
+        # ---- stampede leg: concurrent cold start, ONE compile --------
+        stamp_store = tempfile.mkdtemp(prefix="tpujob_perf_stamp_")
+        store_dirs.append(stamp_store)
+        stamp_dirs = [tempfile.mkdtemp(prefix="tpujob_perf_art_")
+                      for _ in range(n)]
+        with ArtifactServer(":0", store_dir=stamp_store) as srv:
+            procs = [run_child(d, {"TPUJOB_ARTIFACT_URL": srv.url},
+                               "stampede-%d" % i, args.timeout,
+                               start=False)
+                     for i, d in enumerate(stamp_dirs)]
+            stampede = [collect_child(p, "stampede-%d" % i, args.timeout)
+                        for i, p in enumerate(procs)]
+            server_counts = srv.state.snapshot()
+        for d in stamp_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+        # ---- poison leg: flip bytes, expect reject + recompile -------
+        with ArtifactServer(":0", store_dir=stamp_store) as srv:
+            (bundle_path,) = glob.glob(
+                os.path.join(stamp_store, "*.tpuart"))
+            with open(bundle_path, "rb") as fh:
+                raw = bytearray(fh.read())
+            raw[-1] ^= 0xFF
+            with open(bundle_path, "wb") as fh:
+                fh.write(bytes(raw))
+            d = tempfile.mkdtemp(prefix="tpujob_perf_art_")
+            poisoned = run_child(d, {"TPUJOB_ARTIFACT_URL": srv.url},
+                                 "poisoned", args.timeout)
+            shutil.rmtree(d, ignore_errors=True)
+            poison_server_counts = srv.state.snapshot()
+    finally:
+        for d in store_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    agg_off = [round(sum(s["compile_s"] for s in f), 3)
+               for f in off_fleets]
+    agg_on = [round(sum(s["compile_s"] for s in f), 3)
+              for f in on_fleets]
+    med_off = statistics.median(agg_off)
+    med_on = statistics.median(agg_on)
+    speedup = med_off / max(med_on, 1e-9)
+    badput_off = fleet_compile_badput(off_fleets[-1])
+    badput_on = fleet_compile_badput(on_fleets[-1])
+
+    all_children = ([s for f in off_fleets + on_fleets for s in f]
+                    + stampede + [poisoned])
+    ref_loss = all_children[0]["loss_repr"]
+    bit_identical = all(s["loss_repr"] == ref_loss for s in all_children)
+    warm = [s for f in on_fleets for s in f[1:]]
+    stampede_compiles = sum(1 for s in stampede if s["compile_s"] > 0)
+    # verification is layered: the SERVER quarantines a poisoned stored
+    # bundle on read (serving a miss), and a client that does receive
+    # bad bytes rejects them itself — whichever layer fires first
+    # counts the reject
+    poison_rejects = sum(
+        v for k, v in poisoned["artifact_stats"].items()
+        if k.startswith("poisoned_")) + poison_server_counts.get(
+        "poisoned_quarantined", 0)
+
+    summary = {
+        "metric": "fleet_bringup_compile_wall",
+        "fleet_size": n,
+        "samples": n_samples,
+        "aggregate_compile_s_off": agg_off,
+        "aggregate_compile_s_on": agg_on,
+        "median_off_s": med_off,
+        "median_on_s": med_on,
+        "speedup": round(speedup, 2),
+        "floor": SPEEDUP_FLOOR,
+        "loss_bit_identical": bit_identical,
+        "warm_fleet_hits": sum(s["fleet_hits"] for s in warm),
+        "ledger_fleet_compile_badput_off_s": badput_off,
+        "ledger_fleet_compile_badput_on_s": badput_on,
+        "stampede_compiles": stampede_compiles,
+        "stampede_lease_grants": server_counts.get("lease_grant", 0),
+        "poisoned_rejected": poison_rejects,
+        "poisoned_recompiled": poisoned["compile_s"] > 0,
+    }
+    emit(**summary)
+
+    if not args.quick:
+        out = args.out or os.path.join(REPO, "BENCH_ARTIFACTS.json")
+        with open(out, "w") as fh:
+            json.dump({"summary": summary,
+                       "off_fleets": off_fleets, "on_fleets": on_fleets,
+                       "stampede": stampede, "poisoned": poisoned},
+                      fh, indent=2)
+        print("wrote %s" % out, file=sys.stderr)
+
+    # -- the gates -------------------------------------------------------
+    assert bit_identical, (
+        "losses not bit-identical across the fleet (%r) — the store "
+        "changed numerics"
+        % (sorted({s["loss_repr"] for s in all_children}),))
+    assert all(s["cache"] == "fleet" and s["compile_s"] == 0.0
+               for s in warm), (
+        "a with-store replica after the first did not warm-start from "
+        "the fleet store: %r"
+        % ([(s["mode"], s["cache"], s["compile_s"]) for s in warm],))
+    assert speedup >= SPEEDUP_FLOOR, (
+        "fleet aggregate compile wall with the store (median %.2fs) is "
+        "only %.2fx lower than without (median %.2fs; floor %.1fx)"
+        % (med_on, speedup, med_off, SPEEDUP_FLOOR))
+    assert badput_on <= badput_off / SPEEDUP_FLOOR, (
+        "ledger fleet compile badput did not collapse: %.2fs with store "
+        "vs %.2fs without" % (badput_on, badput_off))
+    assert stampede_compiles == 1, (
+        "concurrent cold-start stampede paid %d compilations; the "
+        "compile lease must resolve it to exactly one" % stampede_compiles)
+    assert poison_rejects >= 1 and poisoned["compile_s"] > 0, (
+        "poisoned artifact was not rejected-and-recompiled: %r"
+        % (poisoned,))
+
+
+if __name__ == "__main__":
+    if os.environ.get("PERF_ARTIFACTS_CHILD") == "1":
+        child_main()
+    else:
+        main()

@@ -1,0 +1,804 @@
+"""The fleet compile-artifact store tested end to end: envelope
+verification (flip/torn/stale all rejected), local + HTTP tiers, the
+compile-lease/singleflight protocol (exactly one compile under
+concurrent cold starts, dead leaseholders broken within the bounded
+deadline, atomic fetch-vs-publish), and the compile_cache rung-0
+integration — a peer's build served by the store with bit-identical
+loss, a poisoned artifact downgrading to a recompile.
+
+Included in ``make race``: the store's shared state (stats, inflight
+table, server lease table) is guard-spec declared, so every test here
+doubles as a happens-before check under TPUJOB_RACE_DETECT=1.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_operator_tpu import artifacts
+from paddle_operator_tpu.artifacts import bundle
+from paddle_operator_tpu.artifacts.server import ArtifactServer
+from paddle_operator_tpu.artifacts.store import ArtifactStore
+
+
+@pytest.fixture
+def local_store(tmp_path, monkeypatch):
+    d = str(tmp_path / "store")
+    monkeypatch.setenv("TPUJOB_ARTIFACT_STORE", d)
+    monkeypatch.delenv("TPUJOB_ARTIFACT_URL", raising=False)
+    artifacts.reset_for_tests()
+    yield d
+    artifacts.reset_for_tests()
+
+
+FP = "ab" * 16
+
+
+# ---------------------------------------------------------------------------
+# envelope
+# ---------------------------------------------------------------------------
+
+class TestBundle:
+    def test_roundtrip(self):
+        members = {"aot": b"\x00\x01payload", "cost": b"{}",
+                   "xla/entry-1": b"z" * 1000}
+        data = bundle.pack(FP, members)
+        assert bundle.parse(data, FP) == members
+
+    def test_flipped_byte_rejected(self):
+        data = bytearray(bundle.pack(FP, {"aot": b"x" * 100}))
+        data[-7] ^= 0x10
+        with pytest.raises(bundle.PoisonedArtifactError):
+            bundle.parse(bytes(data), FP)
+
+    def test_torn_file_rejected(self):
+        data = bundle.pack(FP, {"aot": b"x" * 100})
+        for cut in (3, len(data) // 2, len(data) - 1):
+            with pytest.raises(bundle.PoisonedArtifactError):
+                bundle.parse(data[:cut], FP)
+
+    def test_stale_fingerprint_rejected(self):
+        """A bundle re-keyed under the wrong digest (mis-served object)
+        must never satisfy a different fingerprint."""
+        data = bundle.pack(FP, {"aot": b"x"})
+        with pytest.raises(bundle.PoisonedArtifactError):
+            bundle.parse(data, "cd" * 16)
+
+    def test_trailing_garbage_rejected(self):
+        data = bundle.pack(FP, {"aot": b"x"}) + b"extra"
+        with pytest.raises(bundle.PoisonedArtifactError):
+            bundle.parse(data, FP)
+
+
+# ---------------------------------------------------------------------------
+# local tier
+# ---------------------------------------------------------------------------
+
+class TestLocalTier:
+    def test_publish_fetch_merge(self, local_store):
+        s = artifacts.get_store()
+        assert s.fetch(FP) == (None, None)
+        s.publish(FP, {"aot": b"exe"})
+        s.publish(FP, {"cost": b"{}"})  # merge, not replace
+        members, tier = s.fetch(FP)
+        assert tier == "local" and members == {"aot": b"exe", "cost": b"{}"}
+        st = s.stats()
+        assert st["publishes_local"] == 2 and st["hits_local"] == 1
+        assert st["misses_local"] == 1
+
+    def test_member_scoped_fetch(self, local_store):
+        s = artifacts.get_store()
+        s.publish(FP, {"aot": b"exe" * 100, "cost": b'{"flops": 1}'})
+        members, tier = s.fetch(FP, member="cost")
+        assert tier == "local" and members == {"cost": b'{"flops": 1}'}
+        assert s.fetch(FP, member="nope") == (None, None)
+
+    def test_fetch_seconds_accumulates_on_misses_too(self, local_store):
+        """A tier burning wall on misses must show in the gauge — an
+        operator debugging slow bring-up needs the fetch wall even (and
+        especially) when nothing is being served."""
+        s = artifacts.get_store()
+        s.fetch(FP)
+        s.fetch(FP)
+        assert s.stats()["fetch_seconds_local"] > 0.0
+        assert s.stats()["hits_local"] == 0
+
+    def test_poisoned_bundle_rejected_deleted_counted(self, local_store):
+        s = artifacts.get_store()
+        s.publish(FP, {"aot": b"exe" * 10})
+        path = os.path.join(local_store, FP + bundle.SUFFIX)
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(bytes(raw))
+        assert s.fetch(FP) == (None, None)
+        assert s.stats()["poisoned_local"] == 1
+        assert not os.path.exists(path)  # quarantined: next publish heals
+
+    def test_torn_tmp_files_invisible_to_fetch(self, local_store):
+        """The atomic-publish discipline: a writer's in-flight tmp file
+        must never be read as the bundle."""
+        s = artifacts.get_store()
+        os.makedirs(local_store, exist_ok=True)
+        with open(os.path.join(
+                local_store, FP + bundle.SUFFIX + ".tmp.999"), "wb") as fh:
+            fh.write(b"half a bundle being writt")
+        assert s.fetch(FP) == (None, None)
+        assert s.stats()["poisoned_local"] == 0
+
+    def test_concurrent_publish_fetch_never_torn(self, local_store):
+        """Readers racing atomic publishes observe either a verified
+        bundle or a miss — never a torn read (os.replace discipline)."""
+        s = artifacts.get_store()
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                s.publish(FP, {"aot": bytes([i % 256]) * 512})
+                i += 1
+
+        t = threading.Thread(target=writer, name="artifact-pub-test")
+        t.start()
+        try:
+            deadline = time.monotonic() + 0.5
+            while time.monotonic() < deadline:
+                members, _tier = s.fetch(FP, record=False)
+                if members is not None and len(members["aot"]) != 512:
+                    errors.append("short read")
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert not errors
+        assert s.stats()["poisoned_local"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the lease / singleflight protocol
+# ---------------------------------------------------------------------------
+
+class TestLeaseProtocol:
+    def _store(self, local_store, **kw):
+        kw.setdefault("poll_s", 0.01)
+        kw.setdefault("wait_s", 5.0)
+        return ArtifactStore(local_dir=local_store, **kw)
+
+    def test_one_grant_per_fingerprint(self, local_store):
+        s = artifacts.get_store()
+        l1 = s.acquire_compile_lease(FP)
+        assert l1.granted
+        assert not s.acquire_compile_lease(FP).granted
+        assert s.lease_state(FP) == "held"
+        l1.release()
+        assert s.lease_state(FP) == "free"
+        l2 = s.acquire_compile_lease(FP)
+        assert l2.granted
+        l2.release()
+
+    def test_cross_process_lease_file_denies(self, local_store):
+        """Two store CLIENTS (two processes, modeled as two instances)
+        share the lease file: the second acquire is denied while the
+        first holder is live."""
+        a = self._store(local_store)
+        b = self._store(local_store)
+        la = a.acquire_compile_lease(FP)
+        assert la.granted
+        assert not b.acquire_compile_lease(FP).granted
+        assert b.lease_state(FP) == "held"
+        la.release()
+        lb = b.acquire_compile_lease(FP)
+        assert lb.granted
+        lb.release()
+
+    def test_dead_leaseholder_broken_within_deadline(self, local_store):
+        """A leaseholder that died leaves an expired lease file; the
+        next acquirer BREAKS it instead of waiting forever."""
+        dead = self._store(local_store, lease_ttl_s=0.05)
+        assert dead.acquire_compile_lease(FP).granted
+        # the holder vanishes without release(); its TTL runs out
+        time.sleep(0.06)
+        live = self._store(local_store)
+        t0 = time.monotonic()
+        lease = live.acquire_compile_lease(FP)
+        assert lease.granted, "expired lease was not broken"
+        assert time.monotonic() - t0 < 1.0
+        assert live.stats()["lease_broken"] == 1
+        lease.release()
+
+    def test_two_breakers_at_most_one_granted(self, local_store):
+        """Both peers see the dead holder's expired lease at once: the
+        rename-aside break is atomic on the inode, so AT MOST one of
+        them is granted (a bare remove+create would let peer B's remove
+        delete the lease peer A just freshly created)."""
+        dead = self._store(local_store, lease_ttl_s=0.05)
+        assert dead.acquire_compile_lease(FP).granted
+        time.sleep(0.06)
+        stores = [self._store(local_store) for _ in range(4)]
+        grants = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(len(stores))
+
+        def breaker(s):
+            barrier.wait()
+            lease = s.acquire_compile_lease(FP)
+            if lease.granted:
+                with lock:
+                    grants.append(lease)
+
+        threads = [threading.Thread(target=breaker, args=(s,),
+                                    name="artifact-break-%d" % i)
+                   for i, s in enumerate(stores)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(grants) <= 1, \
+            "%d breakers both acquired the broken lease" % len(grants)
+        for lease in grants:
+            lease.release()
+
+    def test_wait_fetch_returns_on_publish(self, local_store):
+        s = self._store(local_store)
+        holder = self._store(local_store)
+        lease = holder.acquire_compile_lease(FP)
+        assert lease.granted
+
+        def publish_later():
+            time.sleep(0.05)
+            holder.publish(FP, {"aot": b"exe"})
+            lease.release()
+
+        t = threading.Thread(target=publish_later,
+                             name="artifact-lease-test")
+        t.start()
+        try:
+            members, tier = s.wait_fetch(FP, time.monotonic() + 5.0)
+        finally:
+            t.join(timeout=5)
+        assert members == {"aot": b"exe"} and tier == "local"
+
+    def test_wait_fetch_unblocks_when_lease_dies(self, local_store):
+        """A holder that dies WITHOUT publishing frees its waiters long
+        before their full deadline — they re-try the acquire."""
+        dead = self._store(local_store, lease_ttl_s=0.05)
+        assert dead.acquire_compile_lease(FP).granted
+        s = self._store(local_store)
+        t0 = time.monotonic()
+        members, _tier = s.wait_fetch(FP, time.monotonic() + 30.0)
+        waited = time.monotonic() - t0
+        assert members is None
+        assert waited < 5.0, "waiter blocked %.1fs past the dead lease" \
+            % waited
+        assert s.acquire_compile_lease(FP).granted
+
+    def test_wait_fetch_bounded_deadline(self, local_store):
+        """Worst case — the lease looks held forever (in-process holder
+        never publishes): the wait is bounded by the caller deadline."""
+        s = self._store(local_store)
+        lease = s.acquire_compile_lease(FP)
+        assert lease.granted
+        t0 = time.monotonic()
+        members, _ = s.wait_fetch(FP, time.monotonic() + 0.15)
+        assert members is None
+        assert 0.1 < time.monotonic() - t0 < 2.0
+        assert s.stats()["lease_timeout"] == 1
+        lease.release()
+
+    def test_concurrent_cold_start_single_compile(self, local_store):
+        """The stampede, in-process: N threads race a cold fingerprint;
+        the lease must resolve to EXACTLY one compile, everyone else
+        wait-then-fetches the published artifact."""
+        s = self._store(local_store)
+        compiles = []
+        results = []
+        lock = threading.Lock()
+
+        def cold_start():
+            deadline = time.monotonic() + 10.0
+            while True:
+                members, _t = s.fetch(FP, record=False)
+                if members is not None:
+                    with lock:
+                        results.append(members["aot"])
+                    return
+                lease = s.acquire_compile_lease(FP)
+                if lease.granted:
+                    # the protocol's re-fetch-under-lease step: a peer
+                    # may have published+released since our last miss
+                    members, _t = s.fetch(FP, record=False)
+                    if members is not None:
+                        lease.release()
+                        with lock:
+                            results.append(members["aot"])
+                        return
+                    try:
+                        with lock:
+                            compiles.append(threading.get_ident())
+                        time.sleep(0.05)  # the "compile"
+                        s.publish(FP, {"aot": b"exe"})
+                    finally:
+                        lease.release()
+                    with lock:
+                        results.append(b"exe")
+                    return
+                members, _t = s.wait_fetch(FP, deadline)
+                if members is not None:
+                    with lock:
+                        results.append(members["aot"])
+                    return
+                if time.monotonic() >= deadline:
+                    raise AssertionError("waiter starved")
+
+        threads = [threading.Thread(target=cold_start,
+                                    name="artifact-stampede-%d" % i)
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(compiles) == 1, \
+            "stampede paid %d compiles" % len(compiles)
+        assert results == [b"exe"] * 6
+
+
+# ---------------------------------------------------------------------------
+# HTTP tier
+# ---------------------------------------------------------------------------
+
+class TestHttpTier:
+    @pytest.fixture
+    def served(self, tmp_path, monkeypatch):
+        srv = ArtifactServer(":0", store_dir=str(tmp_path / "srv")).start()
+        monkeypatch.delenv("TPUJOB_ARTIFACT_STORE", raising=False)
+        monkeypatch.setenv("TPUJOB_ARTIFACT_URL", srv.url)
+        artifacts.reset_for_tests()
+        yield srv
+        srv.stop()
+        artifacts.reset_for_tests()
+
+    def test_publish_fetch_roundtrip(self, served):
+        s = artifacts.get_store()
+        assert s.fetch(FP) == (None, None)
+        s.publish(FP, {"aot": b"exe", "cost": b"{}"})
+        members, tier = s.fetch(FP)
+        assert tier == "remote"
+        assert members == {"aot": b"exe", "cost": b"{}"}
+        counts = served.state.snapshot()
+        assert counts["publish"] == 1 and counts["fetch_hit"] == 1
+
+    def test_poisoned_put_rejected(self, served):
+        s = artifacts.get_store()
+        code, _ = s._http("PUT", "/v1/artifact?fp=%s" % FP,
+                          body=b"not a bundle at all")
+        assert code == 400
+        assert served.state.snapshot()["publish_rejected"] == 1
+        assert s.fetch(FP) == (None, None)
+
+    def test_server_quarantines_poisoned_disk(self, served):
+        s = artifacts.get_store()
+        s.publish(FP, {"aot": b"exe" * 64})
+        path = os.path.join(served.store_dir, FP + bundle.SUFFIX)
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(bytes(raw))
+        assert s.fetch(FP) == (None, None)
+        assert served.state.snapshot()["poisoned_quarantined"] == 1
+        assert not os.path.exists(path)
+
+    def test_remote_lease_lifecycle(self, served):
+        a = ArtifactStore(url=served.url, poll_s=0.01)
+        b = ArtifactStore(url=served.url, poll_s=0.01)
+        la = a.acquire_compile_lease(FP)
+        assert la.granted
+        assert not b.acquire_compile_lease(FP).granted
+        assert b.lease_state(FP) == "held"
+        la.release()
+        lb = b.acquire_compile_lease(FP)
+        assert lb.granted
+        lb.release()
+
+    def test_member_scoped_remote_fetch(self, served):
+        """The cost-sidecar lookup must not download the executable:
+        the server re-packs just the asked-for member."""
+        s = artifacts.get_store()
+        big = b"x" * 100_000
+        s.publish(FP, {"aot": big, "cost": b'{"flops": 2}'})
+        members, tier = s.fetch(FP, member="cost")
+        assert tier == "remote" and members == {"cost": b'{"flops": 2}'}
+        assert s.fetch(FP, member="absent") == (None, None)
+
+    def test_remote_dead_holder_counts_broken(self, served):
+        dead = ArtifactStore(url=served.url, lease_ttl_s=1.0)
+        assert dead.acquire_compile_lease(FP).granted
+        time.sleep(1.05)
+        live = ArtifactStore(url=served.url, lease_ttl_s=30.0)
+        lease = live.acquire_compile_lease(FP)
+        assert lease.granted
+        assert live.stats()["lease_broken"] == 1
+        lease.release()
+
+    def test_remote_lease_ttl_expiry(self, served):
+        dead = ArtifactStore(url=served.url, lease_ttl_s=1.0)
+        assert dead.acquire_compile_lease(FP).granted
+        # server-side monotonic deadline: grant flips to free after TTL
+        # (no waiting here — drive the clock by asking with a tiny ttl)
+        live = ArtifactStore(url=served.url, lease_ttl_s=30.0)
+        assert live.lease_state(FP) == "held"
+        time.sleep(1.05)
+        assert live.lease_state(FP) == "free"
+        lease = live.acquire_compile_lease(FP)
+        assert lease.granted
+        lease.release()
+
+    def test_unreachable_endpoint_degrades(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("TPUJOB_ARTIFACT_STORE", raising=False)
+        monkeypatch.setenv("TPUJOB_ARTIFACT_URL",
+                           "http://127.0.0.1:1/artifacts")
+        artifacts.reset_for_tests()
+        s = artifacts.get_store()
+        s.http_timeout_s = 0.2
+        assert s.fetch(FP) == (None, None)      # miss, no raise
+        s.publish(FP, {"aot": b"x"})            # swallowed, no raise
+        lease = s.acquire_compile_lease(FP)     # no arbiter: compile on
+        assert lease.granted
+        lease.release()
+        artifacts.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# config / env plumbing + exposition
+# ---------------------------------------------------------------------------
+
+class TestConfigAndMetrics:
+    def test_disabled_by_default_and_by_switch(self, monkeypatch):
+        monkeypatch.delenv("TPUJOB_ARTIFACT_STORE", raising=False)
+        monkeypatch.delenv("TPUJOB_ARTIFACT_URL", raising=False)
+        artifacts.reset_for_tests()
+        assert artifacts.get_store() is None
+        monkeypatch.setenv("TPUJOB_ARTIFACT_STORE", "/tmp/whatever")
+        monkeypatch.setenv("TPUJOB_ARTIFACTS", "0")
+        assert artifacts.get_store() is None
+        monkeypatch.delenv("TPUJOB_ARTIFACTS", raising=False)
+        assert artifacts.get_store() is not None
+        artifacts.reset_for_tests()
+
+    def test_metrics_text_valid_exposition(self, local_store):
+        from paddle_operator_tpu import obs
+
+        s = artifacts.get_store()
+        s.publish(FP, {"aot": b"x"})
+        s.fetch(FP)
+        text = artifacts.metrics_text()
+        assert obs.parse_exposition(text) == []
+        for family in ("tpujob_artifact_hits_total",
+                       "tpujob_artifact_misses_total",
+                       "tpujob_artifact_publishes_total",
+                       "tpujob_artifact_poisoned_rejected_total",
+                       "tpujob_artifact_fetch_seconds",
+                       "tpujob_artifact_lease_total"):
+            assert "# TYPE %s " % family in text
+
+    def test_server_metrics_valid_exposition(self, tmp_path):
+        from paddle_operator_tpu import obs
+
+        with ArtifactServer(":0", store_dir=str(tmp_path)) as srv:
+            text = srv.metrics_text()
+        assert obs.parse_exposition(text) == []
+        assert "# TYPE tpujob_artifact_server_requests_total" in text
+
+    def test_harness_serves_artifact_tier(self):
+        """OperatorHarness(artifact_server=True): the operator-embedded
+        tier comes up, serves a real publish/fetch over HTTP, survives
+        an operator restart against the same durable bundle dir, and
+        its family rides the Manager scrape."""
+        from paddle_operator_tpu.testing import OperatorHarness
+
+        h = OperatorHarness(artifact_server=True)
+        try:
+            url = h.artifact_server.url
+            s = ArtifactStore(url=url)
+            s.publish(FP, {"aot": b"exe"})
+            members, tier = s.fetch(FP)
+            assert tier == "remote" and members == {"aot": b"exe"}
+            assert "tpujob_artifact_server_requests_total" in \
+                h.manager.metrics_text()
+            # operator restart: server process memory dies, the bundle
+            # DIRECTORY survives — the replacement serves the same data
+            h.restart_operator()
+            s2 = ArtifactStore(url=h.artifact_server.url)
+            members, _ = s2.fetch(FP)
+            assert members == {"aot": b"exe"}
+        finally:
+            h.close()
+
+
+# ---------------------------------------------------------------------------
+# compile_cache integration (rung 0)
+# ---------------------------------------------------------------------------
+
+class TestCompileCacheIntegration:
+    @pytest.fixture
+    def fleet(self, tmp_path, monkeypatch, local_store):
+        from paddle_operator_tpu import compile_cache
+
+        def fresh_host(name):
+            d = str(tmp_path / name)
+            monkeypatch.setenv("TPUJOB_COMPILE_CACHE_DIR", d)
+            compile_cache.reset_stats_for_tests()
+            return d
+
+        yield fresh_host
+        compile_cache.reset_stats_for_tests()
+
+    @staticmethod
+    def _setup():
+        import jax
+        import jax.numpy as jnp
+
+        def mlp_loss(params, batch):
+            h = jnp.tanh(batch["x"] @ params["w1"])
+            return (((h @ params["w2"]) - batch["y"]) ** 2).mean(), {}
+
+        k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(3), 4)
+        p = {"w1": jax.random.normal(k1, (16, 32), jnp.float32) * 0.1,
+             "w2": jax.random.normal(k2, (32, 4), jnp.float32) * 0.1}
+        b = {"x": jax.random.normal(k3, (8, 16), jnp.float32),
+             "y": jax.random.normal(k4, (8, 4), jnp.float32)}
+        return mlp_loss, p, b
+
+    def test_fleet_fetch_bit_identical(self, fleet):
+        from paddle_operator_tpu import compile_cache
+
+        fn, p, b = self._setup()
+        fleet("host-a")
+        f1 = compile_cache.cached_jit(fn, (p, b))
+        if f1.source != "compiled":
+            pytest.skip("backend cannot serialize executables")
+        loss_a, _ = f1(p, b)
+        assert artifacts.get_store().stats()["publishes_local"] >= 1
+
+        fleet("host-b")
+        f2 = compile_cache.cached_jit(fn, (p, b))
+        assert f2.source == "aot"
+        loss_b, _ = f2(p, b)
+        s = compile_cache.stats()
+        assert s["fleet_hits"] == 1 and s["compile_seconds"] == 0.0
+        assert float(loss_a) == float(loss_b)
+        assert compile_cache.startup_block()["cache"] == "fleet"
+
+    def test_poisoned_artifact_downgrades_to_recompile(self, fleet,
+                                                       local_store):
+        from paddle_operator_tpu import compile_cache
+
+        fn, p, b = self._setup()
+        fleet("host-a")
+        f1 = compile_cache.cached_jit(fn, (p, b))
+        if f1.source != "compiled":
+            pytest.skip("backend cannot serialize executables")
+        loss_a, _ = f1(p, b)
+        (name,) = [n for n in os.listdir(local_store)
+                   if n.endswith(bundle.SUFFIX)]
+        path = os.path.join(local_store, name)
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(bytes(raw))
+
+        before = artifacts.get_store().stats()["poisoned_local"]
+        fleet("host-b")
+        f2 = compile_cache.cached_jit(fn, (p, b))
+        loss_b, _ = f2(p, b)
+        assert float(loss_a) == float(loss_b)  # never a wrong answer
+        s = compile_cache.stats()
+        assert s["fleet_hits"] == 0 and s["compile_seconds"] > 0
+        assert artifacts.get_store().stats()["poisoned_local"] \
+            == before + 1
+
+    def test_compile_failure_releases_the_lease(self, fleet):
+        """An exception escaping the compile section must release the
+        granted lease — a leaked lease would wedge every later build of
+        the fingerprint for the full wait deadline, in-process (the
+        inflight entry never clears) and fleet-wide (peers wait out the
+        TTL)."""
+        from paddle_operator_tpu import compile_cache
+
+        fn, p, b = self._setup()
+        fleet("host-a")
+
+        def boom():
+            raise RuntimeError("compile section blew up")
+
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(compile_cache, "_snapshot_persistent_files", boom)
+            with pytest.raises(RuntimeError, match="blew up"):
+                compile_cache.cached_jit(fn, (p, b))
+        store = artifacts.get_store()
+        fp = compile_cache.step_fingerprint(fn, (p, b))
+        assert store.lease_state(fp) == "free"
+        # and the fingerprint is immediately compilable again
+        lease = store.acquire_compile_lease(fp)
+        assert lease.granted
+        lease.release()
+
+    def test_cost_sidecar_rides_the_store(self, fleet):
+        from paddle_operator_tpu import compile_cache
+
+        fn, p, b = self._setup()
+        fleet("host-a")
+        f1 = compile_cache.cached_jit(fn, (p, b))
+        if f1.source != "compiled":
+            pytest.skip("backend cannot serialize executables")
+        cost = {"flops": 123.0, "bytes": 456.0, "source": "probe"}
+        compile_cache.save_step_cost(f1.fingerprint, cost)
+
+        fleet("host-b")
+        assert compile_cache.load_step_cost(f1.fingerprint) == cost
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: memo bound + cost-sidecar hardening
+# ---------------------------------------------------------------------------
+
+class TestMemoBound:
+    def test_memo_bounded_under_churn(self, tmp_path, monkeypatch):
+        """The PR 10 churn-boundedness bar: a long-lived process
+        building many distinct step shapes keeps a bounded memo."""
+        import functools
+
+        import jax.numpy as jnp
+
+        from paddle_operator_tpu import compile_cache
+
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cache"))
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_MEMO_MAX", "8")
+        # keep the churn cheap: no AOT serialization, jit is lazy
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_AOT", "0")
+        compile_cache.reset_stats_for_tests()
+        try:
+            def base(scale, x):
+                return (x * scale).sum()
+
+            x = jnp.ones((4,))
+            for i in range(25):
+                compile_cache.cached_jit(
+                    functools.partial(base, float(i)), (x,))
+            assert compile_cache.memo_size() <= 8
+            s = compile_cache.stats()
+            assert s["memo_evictions"] >= 25 - 8
+        finally:
+            compile_cache.reset_stats_for_tests()
+
+    def test_lru_keeps_hot_entries(self, tmp_path, monkeypatch):
+        import functools
+
+        import jax.numpy as jnp
+
+        from paddle_operator_tpu import compile_cache
+
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cache"))
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_MEMO_MAX", "2")
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_AOT", "0")
+        compile_cache.reset_stats_for_tests()
+        try:
+            def base(scale, x):
+                return (x * scale).sum()
+
+            x = jnp.ones((4,))
+            hot = functools.partial(base, 1.0)
+            compile_cache.cached_jit(hot, (x,))
+            for i in range(2, 5):
+                compile_cache.cached_jit(
+                    functools.partial(base, float(i)), (x,))
+                # touching the hot entry keeps it resident
+                assert compile_cache.cached_jit(hot, (x,)).source == "memo"
+        finally:
+            compile_cache.reset_stats_for_tests()
+
+
+class TestCostSidecarHardening:
+    @pytest.fixture
+    def cache_dir(self, tmp_path, monkeypatch):
+        from paddle_operator_tpu import compile_cache
+
+        d = str(tmp_path / "compile")
+        monkeypatch.setenv("TPUJOB_COMPILE_CACHE_DIR", d)
+        monkeypatch.delenv("TPUJOB_ARTIFACT_STORE", raising=False)
+        monkeypatch.delenv("TPUJOB_ARTIFACT_URL", raising=False)
+        artifacts.reset_for_tests()
+        compile_cache.reset_stats_for_tests()
+        yield d
+        compile_cache.reset_stats_for_tests()
+        artifacts.reset_for_tests()
+
+    def _cost_path(self, fp):
+        from paddle_operator_tpu import compile_cache
+
+        compile_cache.enable_persistent_cache()
+        return compile_cache._cost_path(fp)
+
+    def test_torn_json_deleted_as_miss(self, cache_dir):
+        from paddle_operator_tpu import compile_cache
+
+        fp = "cd" * 16
+        compile_cache.save_step_cost(fp, {"flops": 1.0})
+        path = self._cost_path(fp)
+        with open(path, "w") as fh:
+            fh.write('{"flops": 1')  # torn mid-write
+        assert compile_cache.load_step_cost(fp) is None
+        assert not os.path.exists(path)  # deleted: next probe re-saves
+        assert compile_cache.load_step_cost(fp) is None  # quiet now
+
+    def test_wrong_shape_json_deleted_as_miss(self, cache_dir):
+        from paddle_operator_tpu import compile_cache
+
+        fp = "ef" * 16
+        path = self._cost_path(fp)
+        with open(path, "w") as fh:
+            json.dump([1, 2, 3], fh)
+        assert compile_cache.load_step_cost(fp) is None
+        assert not os.path.exists(path)
+
+    def test_unserializable_cost_never_raises(self, cache_dir):
+        from paddle_operator_tpu import compile_cache
+
+        fp = "aa" * 16
+        compile_cache.save_step_cost(fp, {"bad": object()})  # no raise
+        assert compile_cache.load_step_cost(fp) is None
+
+    def test_roundtrip_still_works(self, cache_dir):
+        from paddle_operator_tpu import compile_cache
+
+        fp = "bb" * 16
+        cost = {"flops": 2.5e12, "bytes": 1e9, "source": "probe"}
+        compile_cache.save_step_cost(fp, cost)
+        assert compile_cache.load_step_cost(fp) == cost
+
+
+# ---------------------------------------------------------------------------
+# chaos scenario (fast single seeds; the sweep runs in make chaos)
+# ---------------------------------------------------------------------------
+
+class TestArtifactPoisonScenario:
+    def test_clean_and_poisoned_seeds(self):
+        from paddle_operator_tpu.chaos import build_plan, run_scenario
+
+        # pick one clean and one poisoned seed deterministically from
+        # the plan builder so both arms are always exercised
+        clean = poisoned = None
+        for seed in range(12):
+            plan = build_plan("artifact_poison", seed)
+            has_poison = any(e.kind == "artifact_poison"
+                             for e in plan.events)
+            if has_poison and poisoned is None:
+                poisoned = seed
+            if not has_poison and clean is None:
+                clean = seed
+            if clean is not None and poisoned is not None:
+                break
+        assert clean is not None and poisoned is not None
+        for seed in (clean, poisoned):
+            report = run_scenario("artifact_poison", seed, quick=True)
+            assert report.violations == [], (seed, report.violations)
+            if report.extra.get("fetch") == "unsupported":
+                continue
+            if seed == poisoned:
+                assert report.extra["poisoned_rejected"] >= 1
+                assert report.extra["recompiles_b"] == 1
+            else:
+                assert report.extra["fleet_hits"] == 1
+                assert report.extra["recompiles_b"] == 0
+
+    def test_deterministic_replay(self):
+        from paddle_operator_tpu.chaos import run_scenario
+
+        a = run_scenario("artifact_poison", 1, quick=True)
+        b = run_scenario("artifact_poison", 1, quick=True)
+        assert a.violations == [] and b.violations == []
+        assert a.fingerprint() == b.fingerprint()
