@@ -4,7 +4,7 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup artifacts bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup artifacts serve bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
@@ -13,7 +13,9 @@ all: native test
 # + one seed of each durable-recovery chaos scenario + the fleet-
 # scheduler fast lane + the quick control-plane load profile + the quick
 # cold-vs-warm startup profile + the quick fleet artifact-store profile
-verify: analyze test-fast race recovery sched loadtest startup artifacts
+# + the serving-plane fast lane (unit tests, one brownout seed, the
+# quick continuous-batching/scale-out/bit-identity bench)
+verify: analyze test-fast race recovery sched loadtest startup artifacts serve
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -66,7 +68,8 @@ race:
 	  tests/test_observability.py tests/test_ops9xx.py \
 	  tests/test_reconciler.py \
 	  tests/test_recovery.py tests/test_runtime_edge.py \
-	  tests/test_scale_stress.py tests/test_sched.py tests/test_trace.py \
+	  tests/test_scale_stress.py tests/test_sched.py \
+	  tests/test_serving.py tests/test_trace.py \
 	  tests/test_websocket.py
 
 # deterministic fault-injection sweep: every chaos scenario under seeded
@@ -160,6 +163,22 @@ startup:
 #   `python scripts/perf_artifact_store.py` with no flags
 artifacts:
 	$(PY) scripts/perf_artifact_store.py --quick
+
+# serving-plane fast lane (docs/design.md "Serving plane"):
+#   serve — the serving unit suite (allocator/scheduler/autoscaler/
+#           webhook + the engine-vs-full-forward golden test), one seed
+#           of the serving_brownout chaos scenario (preemption wave
+#           mid-traffic: counted sheds, warm rejoins, SLO budget), and
+#           the quick serving bench: continuous >= 2x naive throughput,
+#           warm scale-out with zero compile seconds via the fleet
+#           store, paged-vs-reference token bit-identity
+#   the full artifact (BENCH_SERVING.json) is
+#   `python scripts/perf_serving.py` with no flags
+serve:
+	$(PY) -m pytest tests/test_serving.py -x -q -m "not slow"
+	$(PY) scripts/chaos_stress.py --scenario serving_brownout --seeds 1 \
+	  --quick
+	$(PY) scripts/perf_serving.py --quick
 
 bench:
 	$(PY) bench.py

@@ -107,6 +107,19 @@ SPECS: Tuple[GuardSpec, ...] = (
               "_lock",
               ("_streaks", "_pending", "_remediated", "_boosted",
                "_counts", "_commits")),
+    GuardSpec("paddle_operator_tpu.serving.autoscaler", "ServingAutoscaler",
+              "_lock", ("_calm_streak", "_decisions")),
+    GuardSpec("paddle_operator_tpu.serving.batching", "ContinuousBatcher",
+              "_lock", ("_active", "_counts")),
+    GuardSpec("paddle_operator_tpu.serving.batching", "RequestQueue",
+              "_lock", ("_q", "_counts")),
+    GuardSpec("paddle_operator_tpu.serving.kv_cache", "KvBlockAllocator",
+              "_lock",
+              ("_free", "_tables", "_lens", "_reserved", "_peak_used")),
+    GuardSpec("paddle_operator_tpu.serving.metrics", "ServeMetrics",
+              "_lock",
+              ("_requests", "_tokens", "_queue_depth", "_replicas",
+               "_hist", "_hist_sum", "_hist_count", "_pending_slo")),
 )
 
 

@@ -41,6 +41,12 @@ RESOURCE_ORDER = [RES_PS, RES_WORKER, RES_HETER]
 # role -> env role string (reference: paddlejob_types.go:43-48)
 TRAINING_ROLE = {RES_PS: "PSERVER", RES_WORKER: "TRAINER", RES_HETER: "HETER"}
 
+# serving-mode load-shed postures (spec.serving.shedPolicy). The vocabulary
+# lives HERE, not in serving/batching.py, so the API layer (CRD schema,
+# admission webhook) can validate serving specs without importing the
+# jax-backed data plane.
+SERVING_SHED_POLICIES = ("reject_new", "drop_oldest")
+
 
 class Phase:
     """Job phases (reference: paddlejob_types.go:64-79)."""
@@ -192,6 +198,15 @@ class TpuJob:
     @property
     def elastic(self) -> Optional[int]:
         return self.spec.get("elastic")
+
+    @property
+    def serving(self) -> Optional[dict]:
+        """``spec.serving`` — serving-mode config (None = training job).
+        Present = the worker role is an inference replica gang: the
+        reconciler scales it between ``minReplicas`` and ``maxReplicas``
+        at the serving autoscaler's direction instead of treating
+        ``replicas`` as a fixed training world size."""
+        return self.spec.get("serving")
 
     @property
     def clean_pod_policy(self) -> str:

@@ -23,6 +23,7 @@ from .plan import CONTROL_SCENARIOS, SCENARIOS, ChaosPlan, FaultEvent, \
     build_plan
 from .pod_faults import PodChaos
 from .recovery import run_recovery_scenario
+from .serving_faults import run_serving_scenario
 from .tenants import TenantFleetRun, run_tenant_scenario
 
 __all__ = [
@@ -30,5 +31,6 @@ __all__ = [
     "ChaosSourceError", "CONTROL_SCENARIOS", "FaultEvent", "FaultInjector",
     "FaultySource", "PodChaos", "SCENARIOS", "TenantFleetRun",
     "build_plan", "run_artifact_scenario", "run_loader_scenario",
-    "run_recovery_scenario", "run_scenario", "run_tenant_scenario",
+    "run_recovery_scenario", "run_scenario", "run_serving_scenario",
+    "run_tenant_scenario",
 ]

@@ -797,6 +797,22 @@ def run_scenario(scenario: str, seed: int, quick: bool = True) -> ChaosReport:
             ticks=summary["batches"], faults=dict(injector.counts),
             jobs={}, violations=violations,
             wall_s=time.perf_counter() - t0)
+    if scenario == "serving_brownout":
+        # the serving-plane leg (chaos.serving_faults): a replica gang
+        # under a preemption wave mid-traffic — requests drain or are
+        # counted shed, rejoins come back warm from the fleet store,
+        # incident spans cover each brownout, the latency error budget
+        # survives
+        from .serving_faults import run_serving_scenario
+
+        t0 = time.perf_counter()
+        injector = FaultInjector()
+        facts, violations = run_serving_scenario(plan, injector)
+        return ChaosReport(
+            scenario, seed, converged=not violations, ticks=plan.horizon,
+            faults=dict(injector.counts), jobs={},
+            violations=violations, wall_s=time.perf_counter() - t0,
+            extra=facts)
     if scenario == "artifact_poison":
         # the compile-plane leg (chaos.artifact_faults): two fresh-
         # ladder hosts over one store tier; a poisoned bundle must

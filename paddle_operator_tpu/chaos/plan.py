@@ -47,6 +47,14 @@ Fault taxonomy (``FaultEvent.kind``):
                           (flipped bytes / torn file / stale fingerprint)
                           before a peer fetches it (``artifact_poison``
                           scenario, chaos.artifact_faults)
+``serve_burst``           a burst of inference requests lands on the serving
+                          gang's queue (``serving_brownout`` scenario,
+                          chaos.serving_faults)
+``replica_preempt``       preempt k serving replicas mid-traffic: in-flight
+                          sequences requeue or are counted shed
+                          (``serving_brownout``)
+``replica_rejoin``        the preempted replicas come back — warm from the
+                          fleet artifact store (``serving_brownout``)
 ========================  ====================================================
 
 ``graceful_drain`` runs a second, training-plane leg after the control-plane
@@ -75,7 +83,7 @@ CONTROL_SCENARIOS = (
     "goodput_audit",
 )
 SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant",
-                                 "artifact_poison")
+                                 "artifact_poison", "serving_brownout")
 
 #: control_plane_storm fleet shape: 500+ TpuJobs (the ISSUE-7 scale bar)
 #: churning through the PARALLEL workqueue (drain workers > 1) while api
@@ -135,6 +143,7 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "loader_faults": _loader_faults,
         "multi_tenant": _multi_tenant,
         "artifact_poison": _artifact_poison,
+        "serving_brownout": _serving_brownout,
     }[scenario]
     events, horizon = builder(rng, quick)
     return ChaosPlan(scenario, seed, events, horizon)
@@ -455,6 +464,36 @@ def _artifact_poison(rng: random.Random, quick: bool
                                      list(("flip_bytes", "torn_file",
                                            "stale_fingerprint")))}))
     return events, 8
+
+
+def _serving_brownout(rng: random.Random, quick: bool
+                      ) -> Tuple[List[FaultEvent], int]:
+    """A preemption wave hits a serving gang mid-traffic (see
+    chaos.serving_faults): request bursts arrive against a replica gang
+    running the REAL queue/batcher/KV-allocator/autoscaler stack on a
+    tick clock; one (or two) waves preempt replicas, whose in-flight
+    sequences must requeue or be COUNTED shed — never silently lost —
+    and whose rejoins must come back warm from the fleet store. The
+    latency SLOs burn through the brownout and the error budget must
+    survive the run."""
+    horizon = 160 if quick else 320
+    events: List[FaultEvent] = [FaultEvent(0, "serve_config", {
+        "shed_policy": rng.choice(list(("reject_new", "drop_oldest"))),
+        "queue_capacity": rng.randint(8, 16),
+    })]
+    for _ in range(rng.randint(5, 8)):
+        events.append(FaultEvent(rng.randint(1, horizon - 40),
+                                 "serve_burst",
+                                 {"n": rng.randint(3, 10)}))
+    waves = 1 if rng.random() < 0.5 else 2
+    t = rng.randint(horizon // 5, horizon // 3)
+    for _ in range(waves):
+        k = rng.randint(1, 2)
+        events.append(FaultEvent(t, "replica_preempt", {"replicas": k}))
+        events.append(FaultEvent(t + rng.randint(10, 20),
+                                 "replica_rejoin", {"replicas": k}))
+        t += rng.randint(35, 55)
+    return events, horizon
 
 
 def _loader_faults(rng: random.Random, quick: bool

@@ -25,6 +25,7 @@ from ..k8s import objects as k8s
 from ..k8s.client import EventRecorder, KubeClient
 from ..k8s.errors import ApiError, ConflictError, NotFoundError
 from ..obs import JobMetrics, ObservedEventRecorder, incident_cause
+from ..serving import controller as serving_ctrl
 from ..utils.trace import SpanContext, tracer
 from . import helper
 from .hostport import PortRangeAllocator
@@ -265,6 +266,24 @@ class TpuJobReconciler:
         # restarted operator adopts the newest incident, not whatever a
         # stale pod annotation remembers
         self._sync_trace_annotation(job)
+
+        # -- serving gang sync (serving/controller.py) ------------------
+        # Apply the autoscaler's desired-replica annotation to
+        # spec.worker.replicas (clamped to the serving bounds); the
+        # ordinary scale-up/scale-down passes below then move the actual
+        # pods — serving adds no pod-lifecycle code of its own.
+        if job.serving is not None and serving_ctrl.sync_serving_spec(job):
+            self.recorder.event(
+                job.obj, "Normal", "ServingScale",
+                "serving autoscaler: worker replicas -> %d"
+                % serving_ctrl.serving_replicas(job.obj))
+            try:
+                self.client.update(job.obj)
+            except ConflictError:
+                return self._requeue_error((namespace, name))
+            except NotFoundError:
+                return Result()
+            return Result(requeue_after=0.5)
 
         # -- elastic preemption: whole-slice restart (SURVEY §7) --------
         if job.elastic is not None:

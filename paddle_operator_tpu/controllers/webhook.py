@@ -127,6 +127,65 @@ def validate_scheduling(obj: dict) -> list:
     return errs
 
 
+def validate_serving(obj: dict) -> list:
+    """Admission checks for ``spec.serving`` (the inference serving
+    mode, serving/): reject what the serving controller could only
+    misapply later. Mirrors :func:`validate_scheduling`'s posture —
+    every check here prevents a SILENT runtime failure:
+
+    * replica bounds must be positive with ``minReplicas <=
+      maxReplicas`` — the autoscaler clamps desires to these bounds, so
+      an inverted or non-positive range would pin the gang at a
+      nonsense size without any error surfacing;
+    * ``shedPolicy`` must be a policy the request queue implements — an
+      unknown value would only explode when the first replica
+      constructs its queue, long after admission;
+    * ``queueCapacity``/``maxBatch`` must be positive — zero-capacity
+      admission sheds every request while the job reads Running;
+    * serving cannot combine with ``spec.elastic`` — elastic resize
+      renegotiates the training world size via per-pod env, while
+      serving replicas are INDEPENDENT gangs the autoscaler sizes;
+      wiring both would have two controllers fighting over
+      ``spec.worker.replicas``.
+    """
+    spec = (obj.get("spec") or {})
+    serving = spec.get("serving")
+    if serving is None:
+        return []
+    errs = []
+    where = "spec.serving"
+
+    def is_count(v):
+        return isinstance(v, int) and not isinstance(v, bool)
+
+    lo = serving.get("minReplicas")
+    hi = serving.get("maxReplicas")
+    for field, v in (("minReplicas", lo), ("maxReplicas", hi),
+                     ("queueCapacity", serving.get("queueCapacity")),
+                     ("maxBatch", serving.get("maxBatch"))):
+        if v is not None and (not is_count(v) or v <= 0):
+            errs.append("%s.%s must be a positive integer (got %r)"
+                        % (where, field, v))
+    if is_count(lo) and is_count(hi) and 0 < hi < lo:
+        errs.append(
+            "%s: minReplicas (%d) must be <= maxReplicas (%d) — the "
+            "autoscaler clamps to these bounds and an inverted range "
+            "would silently pin the gang" % (where, lo, hi))
+    policy = serving.get("shedPolicy")
+    if policy is not None and policy not in api.SERVING_SHED_POLICIES:
+        errs.append(
+            "%s.shedPolicy must be one of %s (got %r) — an unknown "
+            "policy only fails when a replica builds its request queue"
+            % (where, "|".join(api.SERVING_SHED_POLICIES), policy))
+    if spec.get("elastic") is not None:
+        errs.append(
+            "%s cannot be combined with spec.elastic: elastic resize "
+            "renegotiates the training world size while serving "
+            "replicas are independent gangs — both would fight over "
+            "spec.worker.replicas" % where)
+    return errs
+
+
 def validate_admission(review: dict) -> dict:
     """AdmissionReview request dict -> AdmissionReview response dict.
 
@@ -166,6 +225,8 @@ def validate_admission(review: dict) -> dict:
                     errs = ["semantic validation failed: %r" % (e,)]
             if not errs:
                 errs = validate_scheduling(obj)
+            if not errs:
+                errs = validate_serving(obj)
     response = {"uid": uid, "allowed": not errs}
     if errs:
         response["status"] = {

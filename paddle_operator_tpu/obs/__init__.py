@@ -55,6 +55,7 @@ from .metrics import (  # noqa: F401
 )
 from .slo import (  # noqa: F401
     SloEvaluator, SloSpec, default_slos, parse_slo_spec,
+    serving_slos,
 )
 from .worker import (  # noqa: F401
     STEP_PHASES, STRAGGLER_K, StepProfiler, StragglerDetector,
@@ -75,6 +76,6 @@ __all__ = [
     "device_memory_stats", "median",
     "default_slos", "format_float", "format_value", "http_respond",
     "incident_cause", "job_key", "parse_exposition", "parse_slo_spec",
-    "resolve_chip", "roofline_class", "step_cost_of",
+    "resolve_chip", "roofline_class", "serving_slos", "step_cost_of",
     "wire_checkpoint_observer",
 ]

@@ -48,9 +48,11 @@ from ..k8s.runtime import escape_label_value
 #: goodput_ratio (ledger), time_to_running (JobMetrics),
 #: step_latency_p99 (worker step profiles), mfu (the ledger's worker
 #: MFU samples, ISSUE 13), mttr (closed-incident recovery totals from
-#: the incident registry, ISSUE 14) — plus anything custom.
+#: the incident registry, ISSUE 14), ttft / tpot (per-request
+#: time-to-first-token and time-per-output-token from the serving
+#: plane's continuous batcher, ISSUE 17) — plus anything custom.
 KNOWN_OBJECTIVES = ("goodput_ratio", "time_to_running",
-                    "step_latency_p99", "mfu", "mttr")
+                    "step_latency_p99", "mfu", "mttr", "ttft", "tpot")
 
 
 @dataclass(frozen=True)
@@ -139,6 +141,22 @@ def default_slos() -> List[SloSpec]:
         # squeeze stretching every reschedule stage
         SloSpec("mttr", "mttr", target=300.0, comparator="<=",
                 budget=0.25),
+    ]
+
+
+def serving_slos(ttft_target: float = 2.0,
+                 tpot_target: float = 0.25) -> List[SloSpec]:
+    """The stock serving-plane SLO pair (ISSUE 17): per-request
+    time-to-first-token (queue wait + prefill — what an interactive user
+    feels as "it started") and time-per-output-token (the steady decode
+    cadence). Both ride the same burn-window evaluator the training SLOs
+    use, and the TpuServe autoscaler consumes their ``burn_rates()`` as
+    its scale-out signal (serving/autoscaler.py)."""
+    return [
+        SloSpec("ttft", "ttft", target=ttft_target, comparator="<=",
+                budget=0.1),
+        SloSpec("tpot", "tpot", target=tpot_target, comparator="<=",
+                budget=0.1),
     ]
 
 

@@ -483,3 +483,164 @@ def _check_blocks(q_shape, block_q, block_k):
         raise ValueError(
             "seq len %d must divide block_q=%d and block_k=%d"
             % (s, block_q, block_k))
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention (TpuServe, ISSUE 17)
+# ---------------------------------------------------------------------------
+#
+# Serving decode is the inverse workload of training prefill: ONE query
+# token per sequence against a KV history scattered across fixed-size
+# cache pages (serving/kv_cache.py — the vLLM layout). The kernel grid is
+# (batch, page): the page axis is the fast, sequential one, so the online
+# softmax accumulates across a sequence's pages in fp32 VMEM scratch (the
+# same revisited-output-block pattern as _dkv_kernel) and writes the
+# context row once on the last page. Block tables and sequence lengths
+# ride in as scalar prefetch (pltpu.PrefetchScalarGridSpec), so the page
+# index_map can dereference the table BEFORE the body runs — the DMA for
+# page t of sequence b fetches k_pages[table[b, t]] directly; no gather
+# materializes.
+
+
+def _reference_paged_decode(q, k_pages, v_pages, block_tables, seq_lens,
+                            scale):
+    """Gather-then-einsum reference: q [B,H,D], pages [P,bs,H,D],
+    block_tables [B,T] int32, seq_lens [B] int32 -> [B,H,D]. fp32
+    softmax, identical math to the kernel up to summation order."""
+    bs = k_pages.shape[1]
+    b, h, d = q.shape
+    t = block_tables.shape[1]
+    # [B, T, bs, H, D] -> [B, T*bs, H, D]
+    k = jnp.take(k_pages, block_tables, axis=0).reshape(b, t * bs, h, d)
+    v = jnp.take(v_pages, block_tables, axis=0).reshape(b, t * bs, h, d)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = jnp.arange(t * bs)[None, :] < seq_lens[:, None]     # [B, T*bs]
+    s = jnp.where(valid[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+def _paged_decode_kernel(seq_lens_ref, tables_ref, q_ref, k_ref, v_ref,
+                         o_ref, acc_ref, m_ref, l_ref, *, scale,
+                         block_size, pages_per_seq):
+    """One (sequence, page) cell: score the query row against this page's
+    tokens, fold into the running online softmax held in scratch."""
+    b = pl.program_id(0)
+    t = pl.program_id(1)
+    heads = q_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale             # [H, D]
+    k = k_ref[0].astype(jnp.float32)                     # [bs, H, D]
+    v = v_ref[0].astype(jnp.float32)
+    # s[h, j] = Σ_d q[h, d] · k[j, h, d]  (h is a batch dim)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )                                                    # [H, bs]
+    pos = t * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (heads, block_size), 1)
+    s = jnp.where(pos < seq_lens_ref[b], s, NEG_INF)
+    # scratch m/l are lane-replicated [H, MIN_BLOCK] (every lane equal);
+    # a rowwise max recovers the [H, 1] column exactly
+    m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)
+    l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                               # [H, bs]
+    correction = jnp.exp(m_prev - m_new)
+    l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+    # ctx[h, d] = Σ_j p[h, j] · v[j, h, d]
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )                                                    # [H, D]
+    acc_ref[...] = acc_ref[...] * correction + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(t == pages_per_seq - 1)
+    def _write():
+        l = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def supports_paged(q_shape, block_size: int) -> bool:
+    """Kernel applicability for decode: [B, H, D] single-token queries,
+    lane-friendly head_dim, sublane-aligned page size."""
+    if len(q_shape) != 3:
+        return False
+    _, _, d = q_shape
+    return d in (64, 128, 256) and block_size % 8 == 0
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                           scale=None, interpret: bool = False):
+    """Single-token decode attention over a paged KV cache.
+
+    q: ``[B, H, D]`` (one new query token per sequence) — k_pages /
+    v_pages: ``[P, bs, H, D]`` page pools — block_tables: ``[B, T]``
+    int32 page ids per sequence (entries past the sequence's pages may
+    be any valid id; their tokens are masked by ``seq_lens``) —
+    seq_lens: ``[B]`` int32 tokens live in each sequence's cache.
+    Returns the attention context ``[B, H, D]``.
+
+    Inference-only by design (no VJP): decode never backpropagates.
+    Numerics match :func:`_reference_paged_decode` to fp32 online-softmax
+    reassociation (same tolerance class as ``flash_attention`` vs its
+    reference — the equivalence tests pin it).
+    """
+    b, h, d = q.shape
+    p_total, block_size, kh, kd = k_pages.shape
+    if (kh, kd) != (h, d) or v_pages.shape != k_pages.shape:
+        raise ValueError(
+            "page pools %r/%r do not match q heads/dim %r"
+            % (k_pages.shape, v_pages.shape, (h, d)))
+    if block_tables.shape[0] != b or seq_lens.shape != (b,):
+        raise ValueError(
+            "block_tables %r / seq_lens %r do not cover batch %d"
+            % (block_tables.shape, seq_lens.shape, b))
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    pages_per_seq = block_tables.shape[1]
+    grid = (b, pages_per_seq)
+
+    def q_index(bi, ti, seq_lens_ref, tables_ref):
+        return (bi, 0, 0)
+
+    def page_index(bi, ti, seq_lens_ref, tables_ref):
+        # the scalar-prefetch dereference: page t of sequence b IS
+        # pages[table[b, t]] — the whole point of the layout
+        return (tables_ref[bi, ti], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, d), q_index),
+            pl.BlockSpec((1, block_size, h, d), page_index),
+            pl.BlockSpec((1, block_size, h, d), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),           # ctx accumulator
+            pltpu.VMEM((h, MIN_BLOCK), jnp.float32),   # running max
+            pltpu.VMEM((h, MIN_BLOCK), jnp.float32),   # running denom
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale,
+                          block_size=block_size,
+                          pages_per_seq=pages_per_seq),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(seq_lens.astype(jnp.int32), block_tables.astype(jnp.int32),
+      q, k_pages, v_pages)

@@ -1,0 +1,174 @@
+"""SLO-driven replica autoscaling for serving gangs.
+
+Training autoscaling (PR 11's boost/burn arbiter) answers "is this JOB
+earning its chips"; serving autoscaling answers "are there enough
+replicas for the OFFERED LOAD" — and the wrong answer in either
+direction costs real money (idle replicas) or real users (latency SLO
+burn). Two signals drive the decision, both already computed by existing
+planes:
+
+* **queue depth** — the leading indicator: backlog per replica above
+  ``target_queue_per_replica`` means arrivals outrun service no matter
+  what the latency percentiles say yet;
+* **SLO burn rate** — the lagging confirmation: ``ttft``/``tpot`` burn
+  (from the stock :class:`..obs.slo.SloEvaluator` multi-window
+  evaluator, specs in :func:`..obs.slo.serving_slos`) past threshold on
+  BOTH windows means users are already hurting.
+
+The MFU plane (PR 13) disambiguates WHY latency burns: a **saturated**
+replica (MFU at or above ``saturation_mfu``) is giving all it has — add
+replicas; a **degraded** one (MFU below ``degraded_mfu`` while latency
+burns) is sick — multiplying it multiplies the sickness, so the decision
+is ``replace``, not scale-out, and the replica should be recycled
+through the warm fleet-store path.
+
+Hysteresis: scale-up needs nothing (under-capacity is the expensive
+state) but acts one step per decision; scale-down needs
+``scale_down_patience`` consecutive calm decisions, stepping one replica
+at a time. Desired count always clamps to [min_replicas, max_replicas].
+The autoscaler only ever RECOMMENDS (:class:`ScaleDecision`); the
+controller (:mod:`.controller`) applies it through the TpuJob spec so
+the reconciler moves the actual pods.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: decision actions, in the order of how alarmed the operator should be
+ACTIONS = ("hold", "scale_down", "scale_up", "replace")
+
+
+@dataclass(frozen=True)
+class ScaleDecision:
+    """One autoscaler recommendation (pure data, safe to log/compare)."""
+
+    action: str                 # one of ACTIONS
+    current: int
+    desired: int
+    reason: str
+    signals: Dict[str, float] = field(default_factory=dict)
+
+
+class ServingAutoscaler:
+    """Queue-depth + burn-rate replica recommender with hysteresis."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 target_queue_per_replica: float = 4.0,
+                 burn_threshold: float = 2.0,
+                 saturation_mfu: float = 0.30,
+                 degraded_mfu: float = 0.10,
+                 scale_down_patience: int = 3,
+                 evaluator=None,
+                 mfu_fn: Optional[Callable[[], Optional[float]]] = None):
+        if not 0 < min_replicas <= max_replicas:
+            raise ValueError(
+                "need 0 < min_replicas <= max_replicas, got [%d, %d]"
+                % (min_replicas, max_replicas))
+        if degraded_mfu >= saturation_mfu:
+            raise ValueError("degraded_mfu must be < saturation_mfu")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.target_queue_per_replica = target_queue_per_replica
+        self.burn_threshold = burn_threshold
+        self.saturation_mfu = saturation_mfu
+        self.degraded_mfu = degraded_mfu
+        self.scale_down_patience = scale_down_patience
+        self._evaluator = evaluator
+        self._mfu_fn = mfu_fn
+        self._lock = threading.Lock()
+        self._calm_streak = 0
+        self._decisions: List[ScaleDecision] = []
+
+    # -- signal plumbing -------------------------------------------------
+
+    def _latency_burn(self, burn: Optional[Dict[Tuple[str, str], float]]
+                      ) -> float:
+        """Worst fast∧slow burn across the serving SLOs — both windows
+        must agree (the evaluator's own multi-window rule) before the
+        autoscaler treats latency as real."""
+        if burn is None:
+            burn = (self._evaluator.burn_rates()
+                    if self._evaluator is not None else {})
+        worst = 0.0
+        for slo in ("ttft", "tpot"):
+            fast = burn.get((slo, "fast"), 0.0)
+            slow = burn.get((slo, "slow"), 0.0)
+            worst = max(worst, min(fast, slow))
+        return worst
+
+    # -- the decision ----------------------------------------------------
+
+    def decide(self, current: int, queue_depth: int,
+               burn: Optional[Dict[Tuple[str, str], float]] = None,
+               mfu: Optional[float] = None) -> ScaleDecision:
+        """One autoscaling evaluation. ``burn`` defaults to the wired
+        evaluator's :meth:`burn_rates`; ``mfu`` (fleet-average, 0..1) to
+        the wired ``mfu_fn``; both None = that signal abstains."""
+        if mfu is None and self._mfu_fn is not None:
+            mfu = self._mfu_fn()
+        latency_burn = self._latency_burn(burn)
+        per_replica = queue_depth / max(current, 1)
+        signals = {"queue_depth": float(queue_depth),
+                   "queue_per_replica": per_replica,
+                   "latency_burn": latency_burn,
+                   "mfu": -1.0 if mfu is None else float(mfu)}
+        backlog = per_replica > self.target_queue_per_replica
+        burning = latency_burn >= self.burn_threshold
+        degraded = (burning and mfu is not None
+                    and mfu < self.degraded_mfu)
+        saturated = mfu is None or mfu >= self.saturation_mfu
+
+        with self._lock:
+            if degraded:
+                # sick replicas: more of them would burn budget faster
+                self._calm_streak = 0
+                decision = ScaleDecision(
+                    "replace", current, current,
+                    "latency burn %.2f with MFU %.3f < %.3f: replica(s) "
+                    "degraded, recycle through the warm fleet path "
+                    "instead of scaling out"
+                    % (latency_burn, mfu, self.degraded_mfu), signals)
+            elif (backlog or (burning and saturated)) \
+                    and current < self.max_replicas:
+                self._calm_streak = 0
+                why = ("queue %.1f/replica > %.1f"
+                       % (per_replica, self.target_queue_per_replica)
+                       if backlog else
+                       "latency burn %.2f >= %.2f with replicas saturated"
+                       % (latency_burn, self.burn_threshold))
+                decision = ScaleDecision("scale_up", current, current + 1,
+                                         why, signals)
+            elif (backlog or burning) and current >= self.max_replicas:
+                self._calm_streak = 0
+                decision = ScaleDecision(
+                    "hold", current, current,
+                    "overloaded but already at max_replicas %d"
+                    % self.max_replicas, signals)
+            elif (not backlog and not burning and queue_depth == 0
+                  and current > self.min_replicas):
+                self._calm_streak += 1
+                if self._calm_streak >= self.scale_down_patience:
+                    self._calm_streak = 0
+                    decision = ScaleDecision(
+                        "scale_down", current, current - 1,
+                        "idle for %d consecutive decisions"
+                        % self.scale_down_patience, signals)
+                else:
+                    decision = ScaleDecision(
+                        "hold", current, current,
+                        "calm %d/%d before scale-down"
+                        % (self._calm_streak, self.scale_down_patience),
+                        signals)
+            else:
+                self._calm_streak = 0
+                decision = ScaleDecision("hold", current, current,
+                                         "within targets", signals)
+            self._decisions.append(decision)
+            return decision
+
+    def history(self) -> List[ScaleDecision]:
+        with self._lock:
+            return list(self._decisions)

@@ -1,0 +1,99 @@
+"""Serving control plane: spec.serving <-> the reconciler's replica path.
+
+The deliberate design here is that serving adds NO new pod-lifecycle
+code: a serving gang IS a worker role, so scale-out/scale-in rides the
+reconciler's existing machinery (create pods up to ``replicas``, drain
+pods with ``idx >= replicas``), membership rides the same coordination
+plane, and warm restarts ride the fleet artifact store. What this module
+adds is only the glue:
+
+* the autoscaler RECORDS its desired count as an annotation
+  (:data:`ANNOT_DESIRED_REPLICAS`, via :func:`apply_desired_replicas`) —
+  annotations survive spec round-trips and make the autoscaler's intent
+  auditable separately from what the reconciler actually applied;
+* the reconciler APPLIES it (:func:`sync_serving_spec`): clamp to the
+  spec's ``[minReplicas, maxReplicas]`` and write
+  ``spec.worker.replicas``, the exact field the scale-down/scale-up
+  passes already consume. A desire outside bounds is clamped, never
+  rejected — the autoscaler is advisory, the spec is law.
+
+The defaulted view of a serving config (queue capacity, batch size, shed
+policy) comes from :func:`serving_config`; the webhook
+(``validate_serving``) has already rejected malformed specs by the time
+anything here runs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import types as api
+
+#: where the autoscaler parks its desired replica count (stringified int)
+ANNOT_DESIRED_REPLICAS = "tpujob-serving-desired-replicas"
+
+#: spec.serving defaults — one place, shared by controller and runners
+SERVING_DEFAULTS = dict(
+    minReplicas=1, maxReplicas=4, queueCapacity=64, maxBatch=8,
+    shedPolicy="reject_new",
+)
+
+
+def serving_config(obj: dict) -> Optional[dict]:
+    """The job's serving section with defaults filled in, or None for a
+    training job. ``obj`` is the raw TpuJob dict (or a TpuJob's .obj)."""
+    spec = (obj.get("spec") or {})
+    serving = spec.get("serving")
+    if serving is None:
+        return None
+    return dict(SERVING_DEFAULTS, **serving)
+
+
+def serving_replicas(obj: dict) -> int:
+    """Current worker replica count (the gang size the reconciler is
+    holding the job at right now)."""
+    worker = (obj.get("spec") or {}).get(api.RES_WORKER) or {}
+    return int(worker.get("replicas", 0))
+
+
+def apply_desired_replicas(obj: dict, desired: int) -> bool:
+    """The autoscaler's write: stamp the desired count as an annotation
+    (the caller persists the object). Returns True when the annotation
+    changed — an unchanged desire must not burn an apiserver write."""
+    annots = obj.setdefault("metadata", {}).setdefault("annotations", {})
+    value = str(int(desired))
+    if annots.get(ANNOT_DESIRED_REPLICAS) == value:
+        return False
+    annots[ANNOT_DESIRED_REPLICAS] = value
+    return True
+
+
+def sync_serving_spec(job: "api.TpuJob") -> bool:
+    """The reconciler's read: apply the desired-replica annotation to
+    ``spec.worker.replicas``, clamped to the serving bounds. Returns True
+    when the spec changed (the reconciler persists and requeues; the
+    existing scale passes then move the actual pods).
+
+    Malformed annotation values are ignored, not fatal: an operator
+    typo'ing a manual ``kubectl annotate`` must not wedge the reconcile
+    loop.
+    """
+    cfg = serving_config(job.obj)
+    if cfg is None:
+        return False
+    annots = job.metadata.get("annotations") or {}
+    raw = annots.get(ANNOT_DESIRED_REPLICAS)
+    if raw is None:
+        return False
+    try:
+        desired = int(raw)
+    except (TypeError, ValueError):
+        return False
+    desired = max(cfg["minReplicas"], min(cfg["maxReplicas"], desired))
+    worker = job.spec.get(api.RES_WORKER)
+    if worker is None:
+        return False
+    if int(worker.get("replicas", 0)) == desired:
+        return False
+    worker["replicas"] = desired
+    return True

@@ -274,6 +274,41 @@ def selftest_artifact_text():
     return client_text, server_text
 
 
+def selftest_serving_text() -> str:
+    """Drive :class:`~paddle_operator_tpu.serving.ServeMetrics` through
+    every outcome label plus both latency histograms (with an
+    adversarial job name to prove escaping) and lint the serving
+    plane's ``tpujob_serve_*`` exposition."""
+    from paddle_operator_tpu.serving import Request, ServeMetrics
+    from paddle_operator_tpu.serving.metrics import OUTCOMES
+
+    m = ServeMetrics(job='default/evil"serve\\x')
+    ok = Request("r0", prompt=[1, 2, 3], max_new_tokens=4)
+    ok.t_arrival, ok.t_admitted = 0.0, 0.25
+    ok.t_first_token, ok.t_done = 0.5, 1.1
+    ok.generated = [7, 7, 7, 7]
+    m.observe_request(ok, outcome="ok")
+    for outcome in OUTCOMES:
+        if outcome != "ok":
+            m.observe_request(Request("r-" + outcome, prompt=[1]),
+                              outcome=outcome)
+    m.set_queue_depth(5)
+    m.set_replicas(3)
+    text = m.metrics_block() + "\n"
+    for fam in ("tpujob_serve_requests_total",
+                "tpujob_serve_tokens_total",
+                "tpujob_serve_queue_depth",
+                "tpujob_serve_replicas",
+                "tpujob_serve_ttft_seconds",
+                "tpujob_serve_tpot_seconds"):
+        assert "# TYPE %s" % fam in text, "serving selftest lost %s" % fam
+    assert 'outcome="shed_overflow"} 1' in text, \
+        "an outcome label fell out of the requests counter"
+    assert 'job="default/evil\\"serve\\\\x"' in text, \
+        "adversarial job label not escaped"
+    return text
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="Prometheus exposition linter")
     ap.add_argument("files", nargs="*", help="exposition text files")
@@ -293,6 +328,8 @@ def main(argv=None) -> int:
         targets.append(("selftest:artifacts.metrics_text", art_client))
         targets.append(("selftest:ArtifactServer.metrics_text",
                         art_server))
+        targets.append(("selftest:ServeMetrics.metrics_block",
+                        selftest_serving_text()))
     for path in args.files:
         with open(path) as f:
             targets.append((path, f.read()))

@@ -1,0 +1,361 @@
+"""Serving-plane benchmark: continuous batching vs naive per-request
+serving, warm replica scale-out, and paged-vs-reference bit-identity.
+
+Three legs, mirroring what the serving plane promises:
+
+* **throughput** — the same ragged request workload served two ways
+  through the SAME scheduler code: ``max_batch=1`` (naive per-request —
+  each request runs alone, the convoy tax in person) vs continuous
+  batching (``max_batch=8`` — new sequences join the in-flight batch the
+  moment a slot frees). Fixed decode shapes mean a batched step costs
+  about what a single-row step does, so iteration-level scheduling
+  converts batch slots into throughput almost linearly. Gate:
+  continuous >= ``PERF_SERVING_FLOOR`` (default 2x) the naive tokens/s,
+  on MEDIANS of 3 timed passes (compiles warmed first — this leg prices
+  scheduling, not XLA);
+* **warm scale-out** — the perf_artifact_store pattern on the serving
+  step functions: replica 0 (fresh process, empty cache dir) compiles
+  prefill+decode and publishes through a live ArtifactServer; replica
+  N+1 (fresh process, empty cache dir, same server) must serve its
+  FIRST token from the fleet rung with ZERO in-process compile seconds
+  — and produce bit-identical tokens;
+* **bit-identity** — the full workload decoded on ``attn="paged"`` (the
+  Pallas kernel, interpret-mode off TPU) and ``attn="reference"`` (the
+  gather-einsum path) must agree token for token, every request. The
+  kernel is an optimization, never a numerics change.
+
+Run:   python scripts/perf_serving.py           # full: publishes
+                                                # BENCH_SERVING.json
+       python scripts/perf_serving.py --quick   # CI lane (make serve)
+"""
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+THROUGHPUT_FLOOR = float(os.environ.get("PERF_SERVING_FLOOR", "2.0"))
+
+#: the bench model: TINY_CONFIG shrunk to max_seq 64 so the paged
+#: block tables stay small (8 pages/sequence) and compiles stay seconds
+BENCH_MAX_SEQ = 64
+
+#: the ragged workload every leg serves (prompt ids, token budget) —
+#: deterministic so the bit-identity gates can compare exact ids.
+#: Budgets are decode-heavy on purpose: prefill is serialized per
+#: request in BOTH modes, so the decode tail is where continuous
+#: batching earns (or fails to earn) its throughput multiple.
+WORKLOAD = [
+    ([5, 99, 7], 16), ([11, 3, 250, 42, 8], 14), ([1023], 18),
+    ([17, 17, 4, 9], 15), ([301, 2], 20), ([7, 600, 31, 31, 90, 12], 13),
+    ([44, 8, 15], 17), ([256, 512, 768, 1], 16),
+    ([900, 13, 77, 2], 18), ([66], 15), ([345, 345, 1, 0, 8], 16),
+    ([23, 94], 19), ([501, 7, 7, 120, 4, 4], 14), ([818, 220, 3], 17),
+    ([159, 26, 535, 8], 15), ([2, 4, 6, 8, 10], 18),
+]
+
+
+def emit(**kv):
+    print(json.dumps(kv))
+    sys.stdout.flush()
+
+
+def _bench_config():
+    from paddle_operator_tpu.models import gpt
+
+    return dict(gpt.TINY_CONFIG, max_seq=BENCH_MAX_SEQ)
+
+
+def _requests(extra_budget=0, count=None):
+    """Fresh Request objects for the workload. ``extra_budget`` deepens
+    every decode tail (the throughput leg wants the decode-bound regime
+    continuous batching exists for); ``count`` truncates (the interpret-
+    mode bit-identity leg keeps its token count small)."""
+    from paddle_operator_tpu.serving import Request
+
+    items = WORKLOAD if count is None else WORKLOAD[:count]
+    return [Request("w%02d" % i, prompt=p,
+                    max_new_tokens=n + extra_budget)
+            for i, (p, n) in enumerate(items)]
+
+
+def _serve_all(engine, reqs, max_batch):
+    """Run the workload to completion through the continuous batcher;
+    returns (wall_s, tokens_generated)."""
+    from paddle_operator_tpu.serving import ContinuousBatcher, RequestQueue
+
+    q = RequestQueue(capacity=len(reqs) + 1)
+    b = ContinuousBatcher(q, max_batch, on_admit=engine.admit,
+                          on_retire=engine.retire)
+    for r in reqs:
+        q.submit(r)
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        if b.step(engine.step_fn) == 0 and q.depth() == 0:
+            break
+    else:
+        raise RuntimeError("workload did not finish")
+    wall = time.perf_counter() - t0
+    assert b.counts()["completed"] == len(reqs)
+    return wall, sum(len(r.generated) for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# leg: continuous vs naive throughput (in-process)
+# ---------------------------------------------------------------------------
+
+def throughput_leg(samples=3):
+    import jax
+
+    from paddle_operator_tpu.models import gpt
+    from paddle_operator_tpu.serving.engine import ServingEngine
+
+    cfg = _bench_config()
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    engines = {
+        "naive": ServingEngine(params, cfg, max_batch=1, prompt_pad=16,
+                               num_blocks=64, block_size=8,
+                               attn="reference", label="bench-naive"),
+        "continuous": ServingEngine(params, cfg, max_batch=8,
+                                    prompt_pad=16, num_blocks=64,
+                                    block_size=8, attn="reference",
+                                    label="bench-cont"),
+    }
+    # +20 tokens on every budget: the timed region must be DECODE-bound
+    # (prefill is serialized per request in both modes, so a prompt-
+    # bound workload would just measure shared overhead and flake the
+    # ratio on machine noise)
+    extra = 20
+    walls = {"naive": [], "continuous": []}
+    tokens = {}
+    for mode, eng in engines.items():
+        _serve_all(eng, _requests(extra), eng.max_batch)  # compile warmup
+        for _ in range(samples):
+            reqs = _requests(extra)
+            wall, n_tok = _serve_all(eng, reqs, eng.max_batch)
+            walls[mode].append(round(wall, 4))
+            tokens[mode] = n_tok
+    assert tokens["naive"] == tokens["continuous"]
+    med = {m: statistics.median(w) for m, w in walls.items()}
+    tput = {m: tokens[m] / med[m] for m in med}
+    return {
+        "walls_s": walls,
+        "median_wall_s": {m: round(v, 4) for m, v in med.items()},
+        "tokens_per_request_set": tokens["continuous"],
+        "tokens_per_s": {m: round(v, 1) for m, v in tput.items()},
+        "speedup": round(tput["continuous"] / tput["naive"], 2),
+        "floor": THROUGHPUT_FLOOR,
+    }
+
+
+# ---------------------------------------------------------------------------
+# leg: paged kernel vs reference bit-identity (in-process)
+# ---------------------------------------------------------------------------
+
+def bit_identity_leg():
+    import jax
+
+    from paddle_operator_tpu.models import gpt
+    from paddle_operator_tpu.serving.engine import ServingEngine
+
+    cfg = _bench_config()
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    streams = {}
+    for attn in ("reference", "paged"):
+        eng = ServingEngine(params, cfg, max_batch=4, prompt_pad=16,
+                            num_blocks=32, block_size=8, attn=attn,
+                            label="bench-%s" % attn)
+        # first 8 requests only: interpret-mode Pallas off-TPU prices
+        # every grid cell in Python, so this leg stays token-frugal
+        reqs = _requests(count=8)
+        _serve_all(eng, reqs, 4)
+        streams[attn] = [r.generated for r in reqs]
+    identical = streams["paged"] == streams["reference"]
+    return {
+        "requests": len(streams["reference"]),
+        "tokens": sum(len(t) for t in streams["reference"]),
+        "paged_matches_reference": identical,
+        "streams": streams["reference"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# leg: warm replica scale-out through the fleet artifact store
+# ---------------------------------------------------------------------------
+
+def child_main():
+    """One fresh-process serving replica: build the engine, serve the
+    first workload request, report first-token wall + cache rung."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+
+    from paddle_operator_tpu import compile_cache
+    from paddle_operator_tpu.models import gpt
+    from paddle_operator_tpu.serving import (
+        ContinuousBatcher, Request, RequestQueue)
+    from paddle_operator_tpu.serving.engine import ServingEngine
+
+    compile_cache.enable_persistent_cache()
+    cfg = _bench_config()
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, max_batch=2, prompt_pad=16,
+                        num_blocks=32, block_size=8, attn="reference",
+                        label="serve-replica")
+    prompt, budget = WORKLOAD[0]
+    req = Request("r0", prompt=list(prompt), max_new_tokens=budget)
+    q = RequestQueue(4)
+    b = ContinuousBatcher(q, 2, on_admit=eng.admit, on_retire=eng.retire)
+    q.submit(req)
+    t0 = time.perf_counter()
+    first_token_s = None
+    for _ in range(64):
+        left = b.step(eng.step_fn)
+        if first_token_s is None and req.generated:
+            first_token_s = time.perf_counter() - t0
+        if left == 0 and q.depth() == 0:
+            break
+    blk = compile_cache.startup_block()
+    emit(first_token_s=round(first_token_s, 3),
+         total_s=round(time.perf_counter() - t0, 3),
+         compile_s=float(blk["compile_seconds"]),
+         cache=blk["cache"], fleet_hits=blk["fleet_hits"],
+         tokens=req.generated)
+
+
+def run_replica(cache_dir, server_url, label, timeout_s):
+    env = dict(os.environ,
+               PERF_SERVING_CHILD="1",
+               JAX_PLATFORMS="cpu",
+               TPUJOB_COMPILE_CACHE_DIR=cache_dir,
+               TPUJOB_ARTIFACT_POLL_S="0.05",
+               TPUJOB_ARTIFACT_URL=server_url)
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout_s, cwd=REPO)
+    if proc.returncode != 0:
+        raise RuntimeError("serving replica (%s) failed:\n%s"
+                           % (label, proc.stderr[-2000:]))
+    sample = json.loads(proc.stdout.strip().splitlines()[-1])
+    sample["replica"] = label
+    emit(**sample)
+    return sample
+
+
+def scale_out_leg(timeout_s):
+    """Replica 0 compiles + publishes; replica 1 (the scale-out) must
+    serve its first token entirely from the fleet rung."""
+    from paddle_operator_tpu.artifacts.server import ArtifactServer
+
+    store = tempfile.mkdtemp(prefix="tpujob_perf_serve_store_")
+    dirs = []
+    try:
+        with ArtifactServer(":0", store_dir=store) as srv:
+            samples = []
+            for i in range(2):
+                d = tempfile.mkdtemp(prefix="tpujob_perf_serve_")
+                dirs.append(d)
+                samples.append(run_replica(d, srv.url,
+                                           "replica-%d" % i, timeout_s))
+    finally:
+        for d in dirs + [store]:
+            shutil.rmtree(d, ignore_errors=True)
+    cold, warm = samples
+    return {
+        "cold_first_token_s": cold["first_token_s"],
+        "warm_first_token_s": warm["first_token_s"],
+        "cold_compile_s": cold["compile_s"],
+        "warm_compile_s": warm["compile_s"],
+        "warm_cache": warm["cache"],
+        "warm_fleet_hits": warm["fleet_hits"],
+        "tokens_bit_identical": cold["tokens"] == warm["tokens"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# parent
+# ---------------------------------------------------------------------------
+
+def main():
+    ap = argparse.ArgumentParser(description="serving-plane bench")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI lane (make serve): gates only, no JSON "
+                         "artifact")
+    ap.add_argument("--samples", type=int, default=3,
+                    help="timed passes per throughput mode (median-of)")
+    ap.add_argument("--timeout", type=float,
+                    default=float(os.environ.get("PERF_SERVING_TIMEOUT",
+                                                 "420")),
+                    help="per-replica subprocess timeout (seconds)")
+    ap.add_argument("--out", default=None,
+                    help="JSON path (default: BENCH_SERVING.json at the "
+                         "repo root; full mode only)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    throughput = throughput_leg(max(1, args.samples))
+    emit(leg="throughput", **throughput)
+    identity = bit_identity_leg()
+    emit(leg="bit_identity",
+         **{k: v for k, v in identity.items() if k != "streams"})
+    scale_out = scale_out_leg(args.timeout)
+    emit(leg="scale_out", **scale_out)
+
+    summary = {
+        "metric": "serving_continuous_vs_naive",
+        "speedup": throughput["speedup"],
+        "floor": THROUGHPUT_FLOOR,
+        "tokens_per_s": throughput["tokens_per_s"],
+        "paged_matches_reference": identity["paged_matches_reference"],
+        "warm_scale_out_compile_s": scale_out["warm_compile_s"],
+        "warm_scale_out_cache": scale_out["warm_cache"],
+        "scale_out_tokens_bit_identical":
+            scale_out["tokens_bit_identical"],
+    }
+    emit(**summary)
+
+    if not args.quick:
+        out = args.out or os.path.join(REPO, "BENCH_SERVING.json")
+        with open(out, "w") as fh:
+            json.dump({"summary": summary, "throughput": throughput,
+                       "bit_identity": identity,
+                       "scale_out": scale_out}, fh, indent=2)
+        print("wrote %s" % out, file=sys.stderr)
+
+    # -- the gates -------------------------------------------------------
+    assert identity["paged_matches_reference"], (
+        "paged decode diverged from the reference path — the kernel "
+        "changed numerics")
+    assert throughput["speedup"] >= THROUGHPUT_FLOOR, (
+        "continuous batching is only %.2fx the naive per-request "
+        "throughput (floor %.1fx): %r"
+        % (throughput["speedup"], THROUGHPUT_FLOOR,
+           throughput["median_wall_s"]))
+    assert scale_out["warm_compile_s"] == 0, (
+        "scale-out replica recompiled (%.2fs) instead of warming from "
+        "the fleet store" % scale_out["warm_compile_s"])
+    assert scale_out["warm_cache"] == "fleet", (
+        "scale-out replica served from rung %r, wanted the fleet store"
+        % scale_out["warm_cache"])
+    assert scale_out["tokens_bit_identical"], (
+        "warm replica's tokens differ from the cold replica's — the "
+        "artifact path changed numerics")
+
+
+if __name__ == "__main__":
+    if os.environ.get("PERF_SERVING_CHILD") == "1":
+        child_main()
+    else:
+        main()
