@@ -4,7 +4,7 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup artifacts serve bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup artifacts serve fleetweek bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
@@ -15,7 +15,8 @@ all: native test
 # cold-vs-warm startup profile + the quick fleet artifact-store profile
 # + the serving-plane fast lane (unit tests, one brownout seed, the
 # quick continuous-batching/scale-out/bit-identity bench)
-verify: analyze test-fast race recovery sched loadtest startup artifacts serve
+# + one seed of the fleet_week soak reconstructed from trace alone
+verify: analyze test-fast race recovery sched loadtest startup artifacts serve fleetweek
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -54,6 +55,7 @@ analyze-changed:
 # jax-version reasons — they would mask this gate's signal).
 race:
 	env TPUJOB_RACE_DETECT=1 $(PY) -m pytest -x -q -m "not slow" \
+	  tests/test_aggregate.py \
 	  tests/test_analysis.py tests/test_artifacts.py \
 	  tests/test_bench_supervision.py \
 	  tests/test_chaos.py tests/test_compile_cache.py \
@@ -132,6 +134,16 @@ obs:
 
 metrics-lint:
 	$(PY) scripts/metrics_lint.py --selftest
+
+# fleet-week soak (docs/observability.md "Scale tiers"): one seed of the
+# compressed week — diurnal tenant load, maintenance drains, preemption
+# storms, a poisoned artifact, degraded hosts, an operator crash — with
+# conservation/MTTR/rollup-vs-truth audited every tick, then the WHOLE
+# week reconstructed from trace alone (era-split waterfall, incidents,
+# hardware) and the final-era fold checked against the aggregation
+# tier's counters. The multi-seed sweep is part of `make chaos`.
+fleetweek:
+	$(PY) scripts/obs_report.py --chaos fleet_week --seed 0
 
 # control-plane load harness (docs/design.md "Control-plane scale"):
 #   loadtest — quick 1k-job profile: bring-up, read-only resync,

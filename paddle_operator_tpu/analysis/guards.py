@@ -75,6 +75,12 @@ SPECS: Tuple[GuardSpec, ...] = (
               ("_lanes", "_lane_of", "_deferred", "_active", "_dirty",
                "_high_streak", "_pops", "_max_high_depth",
                "_max_normal_behind_high")),
+    GuardSpec("paddle_operator_tpu.obs.aggregate", "ObsAggregator", "_lock",
+              ("_fleet", "_open_count", "_open_since", "_job_open",
+               "_job_banked", "_job_badput", "_tenant_of",
+               "_tenant_banked",
+               "_tenant_open_count", "_tenant_open_since", "_tenant_jobs",
+               "_phase_of", "_phase_pop", "_mttr_sum", "_mttr_count")),
     GuardSpec("paddle_operator_tpu.obs.hardware", "HardwarePlane", "_lock",
               ("_steps", "_step_seconds", "_hbm")),
     GuardSpec("paddle_operator_tpu.obs.incidents", "IncidentRegistry",

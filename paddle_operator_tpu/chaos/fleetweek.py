@@ -1,0 +1,399 @@
+"""``fleet_week`` chaos: a week of fleet life, audited at every tick.
+
+One run = the :class:`~.tenants.TenantFleetRun` fleet (goodput-aware
+arbiter + feedback loop, obs ledger on the tick clock) driven through a
+compressed seven-day :class:`~.plan.ChaosPlan`: diurnal tenant load, a
+rolling maintenance drain and a terminal-job GC every day, preemption
+storms, a poisoned compile artifact, degraded-host windows, an operator
+crash mid-week, and apiserver flake throughout. Where the other
+scenarios audit at quiescence, this one is the aggregation tier's
+endurance proof (ISSUE 18): **every tick** of the run re-asserts
+
+* **conservation** — each job's ``wall == goodput + Σ badput[cause]``
+  and ``wall == observed clock span``;
+* **MTTR == episode** — every incident the registry closes reconciles
+  with the ledger badput episode sharing its id, checked incrementally
+  as incidents close (both logs are bounded rings — a quiescence-only
+  sweep would miss everything the week scrolled past);
+* **no capacity leak** — live worker chips never exceed the fleet (the
+  parent's per-tick accounting);
+* **rollup == truth** — :meth:`ObsAggregator.fleet_totals` equals the
+  fold of per-job ledger snapshots plus the frozen contributions of
+  GC'd jobs, under churn, at every tick.
+
+The daily GC exercises the forget path end-to-end: terminal jobs leave
+the apiserver, the reconciler drops them from every obs registry, and
+the fleet rollup must RETAIN their seconds (retired work is still work
+the fleet did). The run snapshots each job's frozen ledger truth the
+moment it is GC'd, so the rollup audit always has an exact reference —
+terminal jobs accrue nothing, making the snapshot timeless.
+
+The operator crash starts a new *era*: every obs registry is rebuilt
+empty, so the retired snapshots and the incremental MTTR cursor reset
+with it. The run emits an ``operator_restart`` trace marker at the
+crash so ``obs_report`` can split the trace into eras and compare the
+final era's rebuilt waterfall against the aggregation tier's final
+counters (see ``scripts/obs_report.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+from ..api import types as api
+from ..k8s.errors import NotFoundError
+from ..obs.ledger import GOODPUT
+from ..utils.trace import tracer
+from .harness import AUDIT_HEALTHY_MFU, AUDIT_PEAK_FLOPS, ChaosReport
+from .plan import ChaosPlan
+from .tenants import DRAIN_GRACE, TenantFleetRun
+
+#: absolute tolerance of the per-tick audits: everything runs on the
+#: integer-second tick clock (charges in tenths), so disagreement means
+#: a lost or double-counted contribution, not float noise
+AUDIT_TOL = 1e-6
+#: first N audit violations kept verbatim; the rest are counted — a
+#: broken invariant fails on tick one, no need for thousands of copies
+MAX_VIOLATIONS = 20
+#: ticks an incident may wait for its ledger episode before the
+#: incremental MTTR check calls it a violation (close and episode land
+#: in the same drain in practice; the grace absorbs ordering within it)
+MTTR_GRACE_TICKS = 2
+
+
+class FleetWeekRun(TenantFleetRun):
+    """The ``fleet_week`` soak: the fair-mode tenant fleet plus daily
+    maintenance, GC, storms, and the per-tick audit plane."""
+
+    def __init__(self, plan: ChaosPlan):
+        super().__init__(plan, mode="fair")
+        #: frozen ledger truth of GC'd jobs, THIS operator era:
+        #: job key -> bucket -> seconds (zero buckets omitted)
+        self._retired: Dict[str, Dict[str, float]] = {}
+        self.audit_violations: List[str] = []
+        self._suppressed = 0
+        #: incremental cursor into closed_incidents() (a bounded ring)
+        self._mttr_seen = 0
+        #: incidents awaiting their ledger episode: (seen_tick, closed)
+        self._mttr_queue: List[Tuple[int, dict]] = []
+        self._last_tick = 0
+        #: recompile seconds the poisoned artifact still owes the fleet
+        self._poison_debt = 0.0
+        self.rollup_audits = 0
+        self.gc_deleted = 0
+        self.storm_kills = 0
+        self.maint_drains = 0
+
+    # -- plan events -----------------------------------------------------
+
+    def _fire(self, tick: int, ev) -> None:
+        p = ev.params
+        if ev.kind == "maint_drain":
+            self._maint_drain(int(p.get("count", 1)))
+        elif ev.kind == "preempt_storm":
+            self._storm(int(p.get("count", 2)))
+        elif ev.kind == "artifact_poison":
+            self._poison(float(p.get("compile_s", 3.0)))
+        elif ev.kind == "operator_crash":
+            self._crash(tick)
+        elif ev.kind == "job_gc":
+            self._gc()
+        else:
+            super()._fire(tick, ev)
+
+    def _running_gangs(self) -> List[str]:
+        """Non-terminal jobs with live pods, oldest submission first —
+        the deterministic target pool for maintenance and storms."""
+        out = []
+        for name, st in self.jobs.items():
+            if st["terminal"]:
+                continue
+            if any((p.get("status") or {}).get("phase")
+                   in ("Pending", "Running")
+                   and not p["metadata"].get("deletionTimestamp")
+                   for p in self._job_pods(name)):
+                out.append(name)
+        return sorted(out, key=lambda n: (self.jobs[n]["submitted"], n))
+
+    def _maint_drain(self, count: int) -> None:
+        """Rolling maintenance: gracefully drain the whole gang of the
+        ``count`` oldest running jobs — drain notice, final checkpoint
+        (the evictor cuts ckpt to progress), no work lost. Degraded-host
+        targets are passed over: the feedback invariant proves their
+        remediation budget-FREE by asserting the preemption budget was
+        never touched, and a maintenance drain on the same job would
+        spend budget for reasons outside the loop and blind that check.
+        """
+        pool = [n for n in self._running_gangs()
+                if n not in self.degrade_targets]
+        for name in pool[:count]:
+            self.maint_drains += 1
+            for pod in self._job_pods(name):
+                if (pod.get("status") or {}).get("phase") \
+                        in ("Pending", "Running") and \
+                        not pod["metadata"].get("deletionTimestamp"):
+                    self._evict(pod, DRAIN_GRACE)
+
+    def _storm(self, count: int) -> None:
+        """A preemption storm: ``count`` hard kills across random live
+        gangs in one tick. No grace window — work since the last
+        checkpoint is lost, exactly as the model books it."""
+        for _ in range(count):
+            names = self._running_gangs()
+            if not names:
+                return
+            name = names[self._rng.randrange(len(names))]
+            pods = [p for p in self._job_pods(name)
+                    if (p.get("status") or {}).get("phase")
+                    not in ("Failed", "Succeeded")
+                    and not p["metadata"].get("deletionTimestamp")]
+            if not pods:
+                continue
+            self.pod_chaos.preempt(pods[self._rng.randrange(len(pods))])
+            self.storm_kills += 1
+            st = self.jobs[name]
+            st["hard_kills"] += 1
+            st["lost"] += st["progress"] - st["ckpt"]
+            st["progress"] = st["ckpt"]
+
+    def _poison(self, compile_s: float) -> None:
+        """A poisoned published artifact: running jobs pay a surprise
+        recompile. The ledger's charge is clamped to goodput actually
+        banked, so the seconds are carried as a debt and drained
+        richest-first at every tick until fully attributed — the
+        recompile happens whenever a victim actually has work to lose."""
+        self._poison_debt += compile_s
+
+    def _drain_poison_debt(self) -> None:
+        if self._poison_debt <= 0.0:
+            return
+        ledger = self.h.job_metrics.ledger
+        names = sorted(
+            self._running_gangs(),
+            key=lambda n: -ledger.snapshot("default", n)["goodput"])
+        for name in names:
+            self._poison_debt -= ledger.charge(
+                "default", name, "compile", self._poison_debt)
+            if self._poison_debt <= 0.0:
+                return
+
+    def _crash(self, tick: int) -> None:
+        """The operator process dies and a replacement starts against
+        the surviving cluster. Every obs registry is rebuilt empty —
+        a new era for the retired snapshots and the MTTR cursor. The
+        trace marker is what lets obs_report split the week into eras
+        and reconcile the final one against the rollup counters."""
+        tracer().event("operator_restart", tick=tick)
+        self.h.restart_operator()
+        # provider registrations are operator memory: re-wire the fault
+        # injector's block the way __init__ did
+        self.h.manager.add_metrics_provider(self.injector.metrics_block)
+        self._retired = {}
+        self._mttr_seen = 0
+        self._mttr_queue = []
+
+    def _gc(self) -> None:
+        """Midnight GC: every terminal job leaves the apiserver, which
+        drives the reconciler's forget path through every obs registry.
+        The frozen ledger truth is snapshotted FIRST — terminal jobs
+        accrue nothing, so the snapshot equals whatever the ledger held
+        at forget time, and the rollup audit keeps an exact reference
+        for seconds the fleet counters retain."""
+        ledger = self.h.job_metrics.ledger
+        for name in sorted(self.jobs):
+            st = self.jobs[name]
+            key = "default/" + name
+            if not st["terminal"] or key in self._retired:
+                continue
+            try:
+                self.h.client.get(api.KIND, "default", name)
+            except NotFoundError:
+                continue
+            snap = ledger.snapshot("default", name)
+            buckets = {GOODPUT: snap["goodput"]}
+            buckets.update(snap["badput"])
+            self._retired[key] = {b: s for b, s in buckets.items() if s}
+            self.h.client.delete(api.KIND, "default", name)
+            self.gc_deleted += 1
+
+    # -- model hooks -----------------------------------------------------
+
+    def _gang_tick(self, name: str, st: dict, live: List[dict]) -> int:
+        divisor = super()._gang_tick(name, st, live)
+        # the worker-plane MFU feed a scrape would deliver: healthy
+        # samples only (the degraded-host model collapses examples/s,
+        # which the eps detector owns), so the hardware lane can rebuild
+        # the fleet picture from mfu_sample trace events alone
+        self.h.job_metrics.ledger.observe_mfu(
+            "default", name, AUDIT_HEALTHY_MFU,
+            peak_flops=AUDIT_PEAK_FLOPS)
+        return divisor
+
+    # -- the per-tick audit plane ----------------------------------------
+
+    def _account(self, tick: int) -> None:
+        super()._account(tick)
+        self._last_tick = tick
+        self._drain_poison_debt()
+        self._audit_conservation(tick)
+        self._audit_mttr(tick)
+        self._audit_rollup(tick)
+
+    def _violate(self, msg: str) -> None:
+        if len(self.audit_violations) < MAX_VIOLATIONS:
+            self.audit_violations.append(msg)
+        else:
+            self._suppressed += 1
+
+    def _audit_conservation(self, tick: int) -> None:
+        """Every attributed second exists exactly once, mid-run — not
+        just at quiescence like the goodput_audit scenario."""
+        ledger = self.h.job_metrics.ledger
+        for name in sorted(self.jobs):
+            if "default/" + name in self._retired:
+                continue
+            snap = ledger.snapshot("default", name)
+            if snap["wall"] <= 0.0:
+                continue
+            attributed = snap["goodput"] + sum(snap["badput"].values())
+            if abs(attributed - snap["wall"]) > AUDIT_TOL:
+                self._violate(
+                    "tick %d: job %s attributed %.6fs != wall %.6fs"
+                    % (tick, name, attributed, snap["wall"]))
+            if abs(snap["wall"] - snap["observed_s"]) > AUDIT_TOL:
+                self._violate(
+                    "tick %d: job %s wall %.6fs != observed span %.6fs"
+                    % (tick, name, snap["wall"], snap["observed_s"]))
+
+    def _audit_mttr(self, tick: int, final: bool = False) -> None:
+        """MTTR-equals-episode, incrementally: both ``closed_incidents``
+        and ``episode_log`` are bounded rings, so each newly closed
+        incident is reconciled against its ledger episode as it closes
+        — before the week scrolls either one away."""
+        reg = self.h.job_metrics.incidents
+        ledger = self.h.job_metrics.ledger
+        closed = reg.closed_incidents()
+        if len(closed) < self._mttr_seen:
+            self._mttr_seen = 0
+        for inc in closed[self._mttr_seen:]:
+            self._mttr_queue.append((tick, inc))
+        self._mttr_seen = len(closed)
+        if not self._mttr_queue:
+            return
+        by_id: Dict[str, float] = {}
+        for ep in ledger.episode_log():
+            iid = ep.get("incident")
+            if iid:
+                by_id[iid] = by_id.get(iid, 0.0) + \
+                    float(ep.get("badput_s") or 0.0)
+        keep: List[Tuple[int, dict]] = []
+        for seen, inc in self._mttr_queue:
+            iid = inc["incident"]
+            got = by_id.get(iid)
+            if got is not None and \
+                    abs(got - float(inc["total_s"])) <= AUDIT_TOL:
+                continue  # reconciled
+            if not final and tick - seen < MTTR_GRACE_TICKS:
+                keep.append((seen, inc))  # episode may land next drain
+                continue
+            if got is None:
+                self._violate(
+                    "tick %d: closed incident %s (%s, %.3fs) has no "
+                    "ledger episode" % (tick, iid, inc.get("cause"),
+                                        float(inc["total_s"])))
+            else:
+                self._violate(
+                    "tick %d: incident %s (%s) MTTR %.6fs != episode "
+                    "badput %.6fs" % (tick, iid, inc.get("cause"),
+                                      float(inc["total_s"]), got))
+        self._mttr_queue = keep
+
+    def _audit_rollup(self, tick: int) -> None:
+        """The tentpole check: the aggregation tier's fleet counters
+        equal the fold of the per-job truth — live snapshots plus the
+        frozen contributions of everything GC'd this era — under churn,
+        at every tick."""
+        agg = self.h.job_metrics.aggregate
+        ledger = self.h.job_metrics.ledger
+        rollup = agg.fleet_totals()
+        truth: Dict[str, float] = {}
+        for buckets in self._retired.values():
+            for b, s in buckets.items():
+                truth[b] = truth.get(b, 0.0) + s
+        for name in self.jobs:
+            if "default/" + name in self._retired:
+                continue
+            snap = ledger.snapshot("default", name)
+            truth[GOODPUT] = truth.get(GOODPUT, 0.0) + snap["goodput"]
+            for cause, s in snap["badput"].items():
+                if s:
+                    truth[cause] = truth.get(cause, 0.0) + s
+        for b in sorted(set(rollup) | set(truth)):
+            want, got = truth.get(b, 0.0), rollup.get(b, 0.0)
+            if abs(got - want) > AUDIT_TOL * max(1.0, abs(want)):
+                self._violate(
+                    "tick %d: rollup[%s] %.6fs != per-job truth %.6fs"
+                    % (tick, b, got, want))
+        self.rollup_audits += 1
+
+    # -- results ---------------------------------------------------------
+
+    def check_invariants(self) -> List[str]:
+        v = super().check_invariants()
+        # flush the MTTR queue: at quiescence nothing may still be
+        # waiting on its episode
+        self._audit_mttr(self._last_tick, final=True)
+        v.extend(self.audit_violations)
+        if self._suppressed:
+            v.append("... and %d further audit violation(s) suppressed"
+                     % self._suppressed)
+        if self.rollup_audits == 0:
+            v.append("the rollup-vs-truth audit never ran")
+        if self._poison_debt > AUDIT_TOL:
+            v.append("%.3fs of poisoned-artifact recompile debt never "
+                     "attributed" % self._poison_debt)
+        reg = self.h.job_metrics.incidents
+        if reg.open_count():
+            v.append("%d incident chain(s) still open at quiescence"
+                     % reg.open_count())
+        return v
+
+
+def run_fleet_week_scenario(plan: ChaosPlan) -> ChaosReport:
+    """The ``fleet_week`` entry point for chaos.harness.run_scenario.
+    The report's ``extra`` carries the aggregation tier's final fleet
+    counters (``rollup_<bucket>_s``) — the reference obs_report's
+    trace-alone reconstruction must agree with."""
+    t0 = time.perf_counter()
+    run = FleetWeekRun(plan)
+    ticks = run.run()
+    violations = run.check_invariants()
+    jm = run.h.job_metrics
+    agg = jm.aggregate
+    extra = {
+        "rollup_audits": run.rollup_audits,
+        "gc_deleted": run.gc_deleted,
+        "maint_drains": run.maint_drains,
+        "storm_kills": run.storm_kills,
+        "tenants": agg.tenant_count(),
+        "live_jobs": agg.job_count(),
+        "fleet_goodput_ratio": round(
+            float(jm.ledger.fleet_snapshot()["ratio"]), 4),
+    }
+    for bucket, s in sorted(agg.fleet_totals().items()):
+        if s:
+            extra["rollup_%s_s" % bucket] = round(s, 6)
+    mttr = agg.mttr_totals()
+    extra["mttr_incidents"] = sum(n for _s, n in mttr.values())
+    extra["mttr_s"] = round(sum(s for s, _n in mttr.values()), 3)
+    for cause, n in sorted(jm.incidents.incident_counts().items()):
+        extra["incidents_%s" % cause] = n
+    jobs = run.job_states()
+    converged = all(st["completed"] is not None
+                    for st in run.jobs.values())
+    faults = dict(plan.counts())
+    run.close()
+    return ChaosReport(plan.scenario, plan.seed, converged, ticks, faults,
+                       jobs, violations, time.perf_counter() - t0,
+                       extra=extra)

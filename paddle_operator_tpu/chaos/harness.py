@@ -788,6 +788,14 @@ def run_scenario(scenario: str, seed: int, quick: bool = True) -> ChaosReport:
         from .tenants import run_tenant_scenario
 
         return run_tenant_scenario(plan)
+    if scenario == "fleet_week":
+        # the aggregation tier's endurance soak (chaos.fleetweek): the
+        # tenant fleet through a compressed week — conservation,
+        # MTTR-equals-episode, no-capacity-leak, and rollup-vs-truth
+        # re-asserted at every tick
+        from .fleetweek import run_fleet_week_scenario
+
+        return run_fleet_week_scenario(plan)
     if scenario == "loader_faults":
         t0 = time.perf_counter()
         injector = FaultInjector()

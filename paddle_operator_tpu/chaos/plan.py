@@ -55,6 +55,13 @@ Fault taxonomy (``FaultEvent.kind``):
                           (``serving_brownout``)
 ``replica_rejoin``        the preempted replicas come back — warm from the
                           fleet artifact store (``serving_brownout``)
+``maint_drain``           rolling maintenance: gracefully drain the N oldest
+                          running gangs (``fleet_week``, chaos.fleetweek)
+``preempt_storm``         k hard pod preemptions across random live gangs in
+                          one tick (``fleet_week``)
+``job_gc``                delete every terminal TpuJob from the apiserver —
+                          the reconciler's forget path must release every obs
+                          registry, rollups conserved (``fleet_week``)
 ========================  ====================================================
 
 ``graceful_drain`` runs a second, training-plane leg after the control-plane
@@ -83,7 +90,8 @@ CONTROL_SCENARIOS = (
     "goodput_audit",
 )
 SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant",
-                                 "artifact_poison", "serving_brownout")
+                                 "artifact_poison", "serving_brownout",
+                                 "fleet_week")
 
 #: control_plane_storm fleet shape: 500+ TpuJobs (the ISSUE-7 scale bar)
 #: churning through the PARALLEL workqueue (drain workers > 1) while api
@@ -144,6 +152,7 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "multi_tenant": _multi_tenant,
         "artifact_poison": _artifact_poison,
         "serving_brownout": _serving_brownout,
+        "fleet_week": _fleet_week,
     }[scenario]
     events, horizon = builder(rng, quick)
     return ChaosPlan(scenario, seed, events, horizon)
@@ -357,6 +366,126 @@ def _multi_tenant(rng: random.Random, quick: bool
             {"code": rng.choice([409, 500, 503]),
              "count": rng.randint(1, 2)}))
     return events, 200 if quick else 300
+
+
+#: fleet_week shape: 7 compressed "days" on the tick clock plus a tail
+#: for the last day's batch work to drain. Quick is the make-verify
+#: lane; the full soak is the multi-thousand-tick week.
+FLEET_WEEK_DAYS = 7
+FLEET_WEEK_TPD_QUICK = 72
+FLEET_WEEK_TPD_FULL = 288
+
+
+def _fleet_week(rng: random.Random, quick: bool
+                ) -> Tuple[List[FaultEvent], int]:
+    """A week of fleet life compressed onto the tick clock (ISSUE 18):
+    diurnal tenant load — business-hours jobs from two interactive
+    tenants plus an overnight ``batch`` tenant — with one rolling
+    maintenance drain and one terminal-job GC per day, two preemption
+    storms, a poisoned compile artifact, two degraded-host windows
+    (remediated by the feedback loop), an operator crash mid-week, and
+    apiserver flake throughout. chaos.fleetweek audits conservation,
+    MTTR-equals-episode, no-capacity-leak, and rollup-vs-truth at every
+    tick; obs_report must reconstruct the run from trace alone.
+
+    The degraded-host windows are scheduled clear of the crash: a
+    detector rebuilt mid-collapse would only ever see degraded samples
+    and could never prime the healthy baseline its collapse trigger
+    compares against — the one fault sequencing the model cannot
+    attribute, so the plan does not produce it."""
+    tpd = FLEET_WEEK_TPD_QUICK if quick else FLEET_WEEK_TPD_FULL
+    days = FLEET_WEEK_DAYS
+    tail = 60 if quick else 150
+    horizon = days * tpd + tail
+    events: List[FaultEvent] = []
+    tenants = ("team-a", "team-b")
+    classes = ("tpu-low", "tpu-standard")
+    seq = 0
+    # degraded-host targets: the first batch job of day 0 (remediated
+    # long before the crash) and of day 4 or 5 (remediated by the
+    # REBUILT feedback controller — proving the replacement closes the
+    # loop too). Their durations are forced long so the window is live
+    # well past detector baseline priming.
+    degrade_days = (0, rng.choice([4, 5]))
+    degrades: List[Tuple[int, str]] = []
+    # faults that need LIVE targets (maintenance drains, storms, the
+    # poisoned artifact) anchor to that day's submission ticks instead
+    # of uniform day positions: at the full 288-tick day a 4-10-step
+    # interactive job is long gone by mid-day, and a storm that always
+    # finds an idle fleet proves nothing
+    batch_at: Dict[int, int] = {}        # day -> first batch submit tick
+    interactive_at: Dict[int, int] = {}  # day -> a business-hours tick
+    for day in range(days):
+        day0 = day * tpd
+        # business hours: interactive work in the first ~60% of the day
+        for j in range(rng.randint(3, 5)):
+            seq += 1
+            t = day0 + rng.randint(1, (tpd * 3) // 5)
+            if j == 0:
+                interactive_at[day] = t
+            events.append(FaultEvent(t, "job_submit", {
+                "name": "d%dj%02d" % (day, seq),
+                "tenant": tenants[rng.randrange(2)],
+                "class": classes[rng.randrange(2)],
+                "hosts": rng.choice([1, 1, 2]), "min_hosts": 1,
+                "duration": rng.randint(4, 10), "elastic": True,
+            }))
+        # overnight batch: bigger, longer, arrives late in the day
+        for b in range(rng.randint(1, 2)):
+            seq += 1
+            t = day0 + rng.randint((tpd * 7) // 10, tpd - 1)
+            target = b == 0 and day in degrade_days
+            dur = rng.randint(12, 16) if target else rng.randint(8, 16)
+            name = "n%db%02d" % (day, seq)
+            if b == 0:
+                batch_at[day] = t
+            if target:
+                degrades.append((t, name))
+            events.append(FaultEvent(t, "job_submit", {
+                "name": name, "tenant": "batch", "class": "tpu-low",
+                "hosts": rng.choice([2, 2, 4]), "min_hosts": 1,
+                "duration": dur, "elastic": True,
+            }))
+        # rolling maintenance: graceful drain of the oldest running
+        # work, a few ticks after the day's first interactive submit
+        events.append(FaultEvent(
+            interactive_at[day] + rng.randint(3, 8),
+            "maint_drain", {"count": rng.randint(1, 2)}))
+        # midnight GC: terminal jobs leave the apiserver (and, via the
+        # reconciler's forget path, every obs registry)
+        if day > 0:
+            events.append(FaultEvent(day0, "job_gc", {}))
+    # two preemption storms on distinct days (maintenance events without
+    # the grace window: hard kills, work lost back to the checkpoint),
+    # landing while that night's batch gang is up
+    for day in rng.sample(range(1, days), k=2):
+        events.append(FaultEvent(
+            batch_at[day] + rng.randint(3, 7),
+            "preempt_storm", {"count": rng.randint(2, 4)}))
+    # one poisoned artifact: a live job pays a surprise recompile, the
+    # seconds charged (and conserved) in the ledger's compile bucket
+    # anchored a half-dozen ticks past the batch submit so the victim
+    # has goodput banked for the clamped charge to draw on
+    events.append(FaultEvent(
+        batch_at[rng.choice([1, 3, 4])] + rng.randint(6, 12),
+        "artifact_poison",
+        {"compile_s": round(rng.uniform(2.0, 6.0), 1)}))
+    # the operator process dies mid-week (day 2-3); the replacement
+    # rebuilds every obs registry from the surviving cluster state
+    events.append(FaultEvent(
+        rng.randint(2 * tpd + tpd // 2, 3 * tpd + tpd // 2),
+        "operator_crash", {}))
+    # degraded-host windows ride the multi_tenant machinery (throughput
+    # collapse -> detector -> feedback remediation), pinned to the long
+    # batch jobs chosen above — days clear of the crash (see docstring)
+    for t, name in degrades:
+        events.append(FaultEvent(t + 3, "backend_degrade", {"job": name}))
+    for _ in range(rng.randint(3, 6)):
+        events.append(FaultEvent(
+            rng.randint(1, days * tpd - 1), "api_error",
+            {"code": rng.choice([409, 500, 503]),
+             "count": rng.randint(1, 2)}))
+    return events, horizon
 
 
 def _goodput_audit(rng: random.Random, quick: bool

@@ -36,6 +36,10 @@ Layout:
 Everything is stdlib-only and cheap when idle; nothing imports jax.
 """
 
+from .aggregate import (  # noqa: F401
+    DEFAULT_TOP_K, DETAIL_JOBS_ENV, TOP_K_ENV, ObsAggregator,
+    configured_top_k, detail_jobs_threshold,
+)
 from .exposition import (  # noqa: F401
     format_float, format_value, http_respond, parse_exposition,
 )
@@ -63,16 +67,20 @@ from .worker import (  # noqa: F401
 )
 
 __all__ = [
-    "BADPUT_CAUSES", "CHIP_PEAKS", "GOODPUT", "INCIDENT_CAUSES",
+    "BADPUT_CAUSES", "CHIP_PEAKS", "DEFAULT_TOP_K", "DETAIL_JOBS_ENV",
+    "GOODPUT", "INCIDENT_CAUSES",
     "INCIDENT_STAGES", "IncidentRegistry", "MFU_COLLAPSE_FLOOR",
     "MTTR_BUCKETS",
     "PHASE_BUCKETS", "RESTART_CAUSES",
-    "STEP_PHASES", "STRAGGLER_K", "ChipSpec", "FlightRecorder",
+    "STEP_PHASES", "STRAGGLER_K", "TOP_K_ENV", "ChipSpec",
+    "FlightRecorder",
     "GoodputLedger", "HardwarePlane",
-    "JobMetrics", "MfuBaseline", "ObservedEventRecorder", "SloEvaluator",
+    "JobMetrics", "MfuBaseline", "ObsAggregator",
+    "ObservedEventRecorder", "SloEvaluator",
     "SloSpec", "StepCost",
     "StepProfiler", "StragglerDetector", "ThroughputBaseline",
     "WorkerMetricsServer", "analytic_cost", "clamped_mfu",
+    "configured_top_k", "detail_jobs_threshold",
     "device_memory_stats", "median",
     "default_slos", "format_float", "format_value", "http_respond",
     "incident_cause", "job_key", "parse_exposition", "parse_slo_spec",

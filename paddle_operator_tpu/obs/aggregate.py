@@ -1,0 +1,501 @@
+"""ObsAggregator — the bounded-cardinality aggregation tier of the obs
+pyramid (ROADMAP item 4: fleet scale).
+
+Every observability plane since PR 10 (goodput ledger, incident MTTR,
+MFU series) keeps per-job state and exports per-job label sets. At a
+100k-job fleet the scrape itself becomes the outage: 100k jobs x a
+dozen families x the phase state-set is millions of text lines, built
+by iterating every job. This module is the fix's first half (the
+second is :meth:`~.ledger.GoodputLedger.metrics_block` snapshotting
+raw state under its lock and rendering outside it): fleet / tenant /
+cause rollups maintained INCREMENTALLY at the ledger's banking sites —
+called with the ledger's lock held, so the rollup can never drift from
+the per-job truth it folds — and rendered in O(tenants + causes +
+phases) regardless of fleet size.
+
+Families (all label-bounded by fixed taxonomies or the tenant set):
+
+* ``tpujob_fleet_goodput_seconds_total`` /
+  ``tpujob_fleet_badput_seconds_total{cause}`` — lifetime fleet
+  counters. Retired (forgotten) jobs' banked seconds are RETAINED, so
+  the counters stay monotonic under churn — the fleet's history does
+  not un-happen when a job's per-job series are GC'd.
+* ``tpujob_tenant_goodput_ratio{tenant}`` /
+  ``tpujob_tenant_jobs{tenant}`` — LIVE jobs only; ``on_forget`` drops
+  the job's contribution and the tenant label itself once its last job
+  is gone, so churn leaves no stale tenant labels.
+* ``tpujob_job_phase_population{phase}`` — the per-job phase state-set
+  collapsed to population counts.
+* ``tpujob_fleet_mttr_seconds{cause}`` — closed-incident MTTR summary
+  (sum/count) fed by the incident registry's close hook; the per-cause
+  per-stage histograms stay in :mod:`.incidents` (already bounded).
+
+Open segments fold in EXACTLY: per bucket the aggregator keeps
+``(open_count, Σ since)``, so the in-progress virtual time at read time
+is ``open_count·now − Σ since`` — equal (to float eps) to summing every
+job's own virtual snapshot at the same clock read. Chaos drives both
+planes on one tick clock, so the ``fleet_week`` soak can assert
+``rollup == fold(per-job truth)`` at every tick under churn.
+
+Above :func:`detail_jobs_threshold` live jobs (``TPUJOB_OBS_DETAIL_JOBS``;
+default 0 = unlimited, today's behavior) the scrape flips to
+**aggregated mode**: unbounded ``{job=...}`` families are restricted to
+the top-K-by-badput exemplar set (:meth:`ObsAggregator.top_badput_jobs`,
+``TPUJOB_OBS_TOP_K``) — the jobs an operator would page on — while the
+rollup families above carry the fleet picture. The mode switch lives in
+:meth:`~.metrics.JobMetrics.metrics_block`.
+
+Thread-safe: all state under ``self._lock`` (declared in
+analysis/guards.py, so ``make race`` asserts the contract and the
+OPS9xx static passes prove it on unscheduled paths). Lock order is
+strictly ledger/registry lock → aggregator lock; the aggregator never
+calls back out.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..k8s.runtime import escape_label_value
+from .ledger import BADPUT_CAUSES, GOODPUT
+
+#: env knob: live-job count above which the scrape flips to aggregated
+#: mode (0 = never — today's fully-detailed behavior)
+DETAIL_JOBS_ENV = "TPUJOB_OBS_DETAIL_JOBS"
+#: env knob: how many worst-badput exemplar jobs keep their per-job
+#: series in aggregated mode
+TOP_K_ENV = "TPUJOB_OBS_TOP_K"
+DEFAULT_TOP_K = 10
+
+
+def detail_jobs_threshold() -> int:
+    """The configured detail→aggregated switchover (0 = never)."""
+    try:
+        return max(0, int(os.environ.get(DETAIL_JOBS_ENV, "0") or "0"))
+    except ValueError:
+        return 0
+
+
+def configured_top_k() -> int:
+    try:
+        return max(1, int(os.environ.get(TOP_K_ENV, "") or DEFAULT_TOP_K))
+    except ValueError:
+        return DEFAULT_TOP_K
+
+
+class ObsAggregator:
+    """Incrementally-maintained fleet/tenant/cause rollups.
+
+    Fed under the feeding plane's lock (ledger banking sites, registry
+    close); every mutator re-locks ``self._lock`` — cheap dict updates,
+    and the one order (feeder lock → aggregator lock) is deadlock-free
+    because nothing here calls back into a feeder.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        # fleet lifetime counters: bucket -> banked seconds (goodput +
+        # every badput cause); retired jobs' contributions retained
+        self._fleet: Dict[str, float] = {}
+        # exact open-segment rollup: bucket -> (count, Σ since)
+        self._open_count: Dict[str, int] = {}
+        self._open_since: Dict[str, float] = {}
+        # per-job mirrors (internal memory — exported cardinality is
+        # what the tier bounds): open segment, banked seconds, tenant
+        self._job_open: Dict[str, Tuple[str, float]] = {}
+        self._job_banked: Dict[str, Dict[str, float]] = {}
+        # running banked-badput score (jobs with badput only): keeps
+        # top_badput_jobs from rescanning every job's buckets per
+        # scrape — the 10k→100k curve showed that scan dominating the
+        # aggregated-mode scrape
+        self._job_badput: Dict[str, float] = {}
+        self._tenant_of: Dict[str, str] = {}
+        # live-tenant rollups (dropped with their last job)
+        self._tenant_banked: Dict[str, Dict[str, float]] = {}
+        self._tenant_open_count: Dict[Tuple[str, str], int] = {}
+        self._tenant_open_since: Dict[Tuple[str, str], float] = {}
+        self._tenant_jobs: Dict[str, int] = {}
+        # phase population (live jobs)
+        self._phase_of: Dict[str, str] = {}
+        self._phase_pop: Dict[str, int] = {}
+        # closed-incident MTTR rollup, by inception cause
+        self._mttr_sum: Dict[str, float] = {}
+        self._mttr_count: Dict[str, int] = {}
+
+    # -- registration -----------------------------------------------------
+
+    def _ensure_locked(self, key: str) -> str:
+        tenant = self._tenant_of.get(key)
+        if tenant is None:
+            # default tenancy is the namespace; set_tenant refines it
+            tenant = key.split("/", 1)[0]
+            self._tenant_of[key] = tenant
+            self._tenant_jobs[tenant] = self._tenant_jobs.get(tenant, 0) + 1
+        return tenant
+
+    def set_tenant(self, namespace: str, name: str, tenant: str) -> None:
+        """Attribute the job to a named tenant (the scheduler's queue);
+        moves any contribution already rolled up under the default."""
+        key = "%s/%s" % (namespace, name)
+        with self._lock:
+            old = self._tenant_of.get(key)
+            if old == tenant:
+                return
+            if old is None:
+                self._tenant_of[key] = tenant
+                self._tenant_jobs[tenant] = \
+                    self._tenant_jobs.get(tenant, 0) + 1
+                return
+            # migrate banked + open contributions old -> new
+            self._tenant_of[key] = tenant
+            self._tenant_jobs[tenant] = self._tenant_jobs.get(tenant, 0) + 1
+            banked = self._job_banked.get(key, {})
+            if banked:
+                tb_new = self._tenant_banked.setdefault(tenant, {})
+                tb_old = self._tenant_banked.get(old, {})
+                for bucket, s in banked.items():
+                    tb_old[bucket] = tb_old.get(bucket, 0.0) - s
+                    tb_new[bucket] = tb_new.get(bucket, 0.0) + s
+            cur = self._job_open.get(key)
+            if cur is not None:
+                bucket, since = cur
+                self._tenant_open_dec_locked(old, bucket, since)
+                self._tenant_open_inc_locked(tenant, bucket, since)
+            self._tenant_release_locked(old)
+
+    # -- ledger sink (called under the ledger's lock) ---------------------
+
+    def on_state(self, key: str, old_bucket: Optional[str],
+                 new_bucket: Optional[str], now: float) -> None:
+        """The job's open segment switched buckets (``old → new``),
+        opened (``None → new``), or fully closed (``old → None``), all
+        stamped at one shared clock read. The preceding banking call
+        (:meth:`on_bank`) has already advanced the open mirror to
+        ``now``, so removal at ``now`` is exact."""
+        with self._lock:
+            tenant = self._ensure_locked(key)
+            if old_bucket is not None:
+                self._open_count[old_bucket] = \
+                    self._open_count.get(old_bucket, 0) - 1
+                self._open_since[old_bucket] = \
+                    self._open_since.get(old_bucket, 0.0) - now
+                self._tenant_open_dec_locked(tenant, old_bucket, now)
+            if new_bucket is not None:
+                self._open_count[new_bucket] = \
+                    self._open_count.get(new_bucket, 0) + 1
+                self._open_since[new_bucket] = \
+                    self._open_since.get(new_bucket, 0.0) + now
+                self._tenant_open_inc_locked(tenant, new_bucket, now)
+                self._job_open[key] = (new_bucket, now)
+            else:
+                self._job_open.pop(key, None)
+
+    def on_bank(self, key: str, bucket: str, dur: float) -> None:
+        """The ledger banked ``dur`` seconds of the job's open segment
+        into ``bucket`` (the segment stays open, its since advanced by
+        exactly ``dur``)."""
+        with self._lock:
+            tenant = self._ensure_locked(key)
+            self._fleet[bucket] = self._fleet.get(bucket, 0.0) + dur
+            tb = self._tenant_banked.setdefault(tenant, {})
+            tb[bucket] = tb.get(bucket, 0.0) + dur
+            jb = self._job_banked.setdefault(key, {})
+            jb[bucket] = jb.get(bucket, 0.0) + dur
+            if bucket != GOODPUT and dur > 0:
+                self._job_badput[key] = \
+                    self._job_badput.get(key, 0.0) + dur
+            cur = self._job_open.get(key)
+            if cur is not None and cur[0] == bucket:
+                self._job_open[key] = (bucket, cur[1] + dur)
+                self._open_since[bucket] = \
+                    self._open_since.get(bucket, 0.0) + dur
+                self._tenant_open_since[(tenant, bucket)] = \
+                    self._tenant_open_since.get((tenant, bucket), 0.0) + dur
+
+    def on_charge(self, key: str, cause: str, moved: float) -> None:
+        """``moved`` already-banked goodput seconds re-attributed to a
+        badput cause (the ledger's clamped charge channel)."""
+        with self._lock:
+            tenant = self._ensure_locked(key)
+            for store in (self._fleet,
+                          self._tenant_banked.setdefault(tenant, {}),
+                          self._job_banked.setdefault(key, {})):
+                store[GOODPUT] = store.get(GOODPUT, 0.0) - moved
+                store[cause] = store.get(cause, 0.0) + moved
+            if moved > 0:
+                self._job_badput[key] = \
+                    self._job_badput.get(key, 0.0) + moved
+
+    def on_forget(self, key: str) -> None:
+        """Terminal-job GC: drop the job's live contributions (tenant
+        gauges, phase population, mirrors). The fleet lifetime counters
+        keep its banked seconds — retirement is not amnesia."""
+        with self._lock:
+            tenant = self._tenant_of.pop(key, None)
+            if tenant is None:
+                return
+            cur = self._job_open.pop(key, None)
+            if cur is not None:
+                # defensive: the ledger closes the segment before it
+                # forgets, so normally nothing is open here
+                bucket, since = cur
+                self._open_count[bucket] = \
+                    self._open_count.get(bucket, 0) - 1
+                self._open_since[bucket] = \
+                    self._open_since.get(bucket, 0.0) - since
+                self._tenant_open_dec_locked(tenant, bucket, since)
+            banked = self._job_banked.pop(key, None)
+            self._job_badput.pop(key, None)
+            if banked:
+                tb = self._tenant_banked.setdefault(tenant, {})
+                for bucket, s in banked.items():
+                    tb[bucket] = tb.get(bucket, 0.0) - s
+            phase = self._phase_of.pop(key, None)
+            if phase is not None:
+                n = self._phase_pop.get(phase, 0) - 1
+                if n > 0:
+                    self._phase_pop[phase] = n
+                else:
+                    self._phase_pop.pop(phase, None)
+            self._tenant_release_locked(tenant)
+
+    # -- metrics/registry sinks -------------------------------------------
+
+    def on_phase(self, key: str, phase: str) -> None:
+        with self._lock:
+            self._ensure_locked(key)
+            old = self._phase_of.get(key)
+            if old == phase:
+                return
+            if old is not None:
+                n = self._phase_pop.get(old, 0) - 1
+                if n > 0:
+                    self._phase_pop[old] = n
+                else:
+                    self._phase_pop.pop(old, None)
+            self._phase_of[key] = phase
+            self._phase_pop[phase] = self._phase_pop.get(phase, 0) + 1
+
+    def on_incident_close(self, cause: str, total_s: float,
+                          resolved: bool) -> None:
+        """A recovery incident closed (resolved or not — mirroring
+        ``tpujob_incidents_total``): roll its MTTR into the fleet
+        per-cause summary."""
+        with self._lock:
+            self._mttr_sum[cause] = self._mttr_sum.get(cause, 0.0) + total_s
+            self._mttr_count[cause] = self._mttr_count.get(cause, 0) + 1
+
+    # -- readout ----------------------------------------------------------
+
+    def job_count(self) -> int:
+        """Live jobs the aggregator tracks (churn-boundedness checks)."""
+        with self._lock:
+            return len(self._tenant_of)
+
+    def tenant_count(self) -> int:
+        with self._lock:
+            return len(self._tenant_jobs)
+
+    def fleet_totals(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Bucket -> seconds (banked + exact open-virtual at ``now``) —
+        the rollup-vs-truth audit surface."""
+        with self._lock:
+            if now is None:
+                now = self._clock()
+            return self._fleet_totals_locked(now)
+
+    def _fleet_totals_locked(self, now: float) -> Dict[str, float]:
+        out = dict(self._fleet)
+        for bucket, n in self._open_count.items():
+            if n:
+                out[bucket] = (out.get(bucket, 0.0) + n * now
+                               - self._open_since.get(bucket, 0.0))
+        return out
+
+    def tenant_totals(self, now: Optional[float] = None
+                      ) -> Dict[str, Dict[str, float]]:
+        """Tenant -> bucket -> seconds over LIVE jobs (open-virtual
+        folded at ``now``)."""
+        with self._lock:
+            if now is None:
+                now = self._clock()
+            out: Dict[str, Dict[str, float]] = {}
+            for tenant in self._tenant_jobs:
+                out[tenant] = dict(self._tenant_banked.get(tenant, {}))
+            for (tenant, bucket), n in self._tenant_open_count.items():
+                if n:
+                    tb = out.setdefault(tenant, {})
+                    since = self._tenant_open_since.get((tenant, bucket),
+                                                        0.0)
+                    tb[bucket] = tb.get(bucket, 0.0) + n * now - since
+            return out
+
+    def phase_population(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._phase_pop)
+
+    def mttr_totals(self) -> Dict[str, Tuple[float, int]]:
+        with self._lock:
+            return {c: (self._mttr_sum[c], self._mttr_count.get(c, 0))
+                    for c in self._mttr_sum}
+
+    def top_badput_jobs(self, k: int,
+                        now: Optional[float] = None) -> Set[str]:
+        """The worst-badput exemplar set: the K jobs with the largest
+        badput seconds (banked + an open badput stretch's virtual time)
+        — the jobs whose per-job series survive aggregated mode.
+        Deterministic: ties break on the job key."""
+        with self._lock:
+            if now is None:
+                now = self._clock()
+            # the running banked-badput score plus any OPEN badput
+            # stretch's virtual time — O(badput jobs), not O(fleet)
+            scored: Dict[str, float] = dict(self._job_badput)
+            for key, cur in self._job_open.items():
+                if cur[0] != GOODPUT and now > cur[1]:
+                    scored[key] = scored.get(key, 0.0) + (now - cur[1])
+            fill: List[str] = []
+            if len(scored) < k and len(self._tenant_of) > len(scored):
+                # not enough badput-bearing jobs: fill with the largest
+                # remaining keys — the same zero-score tie-break the
+                # full scan used, so the exemplar set is unchanged
+                fill = heapq.nlargest(
+                    k - len(scored),
+                    (key for key in self._tenant_of
+                     if key not in scored))
+        out = {key for _s, key in heapq.nlargest(
+            max(0, k), ((s, key) for key, s in scored.items()))}
+        out.update(fill)
+        return out
+
+    # -- exposition -------------------------------------------------------
+
+    def metrics_block(self, now: Optional[float] = None,
+                      include_fleet_ratio: bool = False) -> str:
+        """Text-exposition lines (no trailing newline) for the rollup
+        families; O(tenants + causes + phases). ``include_fleet_ratio``
+        adds ``tpujob_fleet_goodput_ratio`` (aggregated mode only — in
+        detail mode the ledger exports it over live jobs)."""
+        with self._lock:
+            if now is None:
+                now = self._clock()
+            fleet = self._fleet_totals_locked(now)
+            tenants: Dict[str, Tuple[float, float, int]] = {}
+            for tenant, jobs in self._tenant_jobs.items():
+                tb = dict(self._tenant_banked.get(tenant, {}))
+                tenants[tenant] = (tb.get(GOODPUT, 0.0),
+                                   sum(tb.values()), jobs)
+            for (tenant, bucket), n in self._tenant_open_count.items():
+                if not n or tenant not in tenants:
+                    continue
+                good, total, jobs = tenants[tenant]
+                virt = (n * now
+                        - self._tenant_open_since.get((tenant, bucket),
+                                                      0.0))
+                if bucket == GOODPUT:
+                    good += virt
+                total += virt
+                tenants[tenant] = (good, total, jobs)
+            phase_pop = dict(self._phase_pop)
+            mttr = {c: (self._mttr_sum[c], self._mttr_count.get(c, 0))
+                    for c in self._mttr_sum}
+        esc = escape_label_value
+        lines: List[str] = []
+        good = fleet.get(GOODPUT, 0.0)
+        bad_total = sum(s for b, s in fleet.items() if b != GOODPUT)
+        lines.append("# HELP tpujob_fleet_goodput_seconds_total Fleet "
+                     "lifetime goodput seconds (rollup; retired jobs "
+                     "retained).")
+        lines.append("# TYPE tpujob_fleet_goodput_seconds_total counter")
+        lines.append("tpujob_fleet_goodput_seconds_total %.6f" % good)
+        lines.append("# HELP tpujob_fleet_badput_seconds_total Fleet "
+                     "lifetime badput seconds by cause (rollup; retired "
+                     "jobs retained).")
+        lines.append("# TYPE tpujob_fleet_badput_seconds_total counter")
+        for cause in BADPUT_CAUSES:
+            lines.append('tpujob_fleet_badput_seconds_total{cause="%s"} '
+                         '%.6f' % (cause, fleet.get(cause, 0.0)))
+        if include_fleet_ratio:
+            wall = good + bad_total
+            lines.append("# HELP tpujob_fleet_goodput_ratio Fleet-wide "
+                         "goodput over observed wall clock, all jobs.")
+            lines.append("# TYPE tpujob_fleet_goodput_ratio gauge")
+            lines.append("tpujob_fleet_goodput_ratio %.6f"
+                         % ((good / wall) if wall > 0 else 1.0))
+        if tenants:
+            lines.append("# HELP tpujob_tenant_jobs Live jobs per "
+                         "tenant (rollup).")
+            lines.append("# TYPE tpujob_tenant_jobs gauge")
+            for tenant in sorted(tenants):
+                lines.append('tpujob_tenant_jobs{tenant="%s"} %d'
+                             % (esc(tenant), tenants[tenant][2]))
+            lines.append("# HELP tpujob_tenant_goodput_ratio Per-tenant "
+                         "goodput over observed wall clock, live jobs "
+                         "(rollup).")
+            lines.append("# TYPE tpujob_tenant_goodput_ratio gauge")
+            for tenant in sorted(tenants):
+                t_good, t_total, _jobs = tenants[tenant]
+                lines.append('tpujob_tenant_goodput_ratio{tenant="%s"} '
+                             '%.6f' % (esc(tenant),
+                                       (t_good / t_total)
+                                       if t_total > 0 else 1.0))
+        if phase_pop:
+            lines.append("# HELP tpujob_job_phase_population Jobs "
+                         "currently in each phase (rollup of the "
+                         "per-job phase state set).")
+            lines.append("# TYPE tpujob_job_phase_population gauge")
+            for phase in sorted(phase_pop):
+                lines.append('tpujob_job_phase_population{phase="%s"} %d'
+                             % (esc(phase), phase_pop[phase]))
+        if mttr:
+            lines.append("# HELP tpujob_fleet_mttr_seconds Closed-"
+                         "incident recovery seconds by inception cause "
+                         "(rollup summary).")
+            lines.append("# TYPE tpujob_fleet_mttr_seconds summary")
+            for cause in sorted(mttr):
+                s, n = mttr[cause]
+                lines.append('tpujob_fleet_mttr_seconds_sum{cause="%s"} '
+                             '%.6f' % (esc(cause), s))
+                lines.append('tpujob_fleet_mttr_seconds_count{cause="%s"} '
+                             '%d' % (esc(cause), n))
+        return "\n".join(lines)
+
+    # -- internals (called with self._lock held) --------------------------
+
+    def _tenant_open_inc_locked(self, tenant: str, bucket: str,
+                                since: float) -> None:
+        tk = (tenant, bucket)
+        self._tenant_open_count[tk] = self._tenant_open_count.get(tk, 0) + 1
+        self._tenant_open_since[tk] = \
+            self._tenant_open_since.get(tk, 0.0) + since
+
+    def _tenant_open_dec_locked(self, tenant: str, bucket: str,
+                                since: float) -> None:
+        tk = (tenant, bucket)
+        n = self._tenant_open_count.get(tk, 0) - 1
+        if n > 0:
+            self._tenant_open_count[tk] = n
+            self._tenant_open_since[tk] = \
+                self._tenant_open_since.get(tk, 0.0) - since
+        else:
+            self._tenant_open_count.pop(tk, None)
+            self._tenant_open_since.pop(tk, None)
+
+    def _tenant_release_locked(self, tenant: str) -> None:
+        """One job left the tenant: drop the tenant's labels entirely
+        when it was the last (no stale tenant series under churn)."""
+        n = self._tenant_jobs.get(tenant, 0) - 1
+        if n > 0:
+            self._tenant_jobs[tenant] = n
+            return
+        self._tenant_jobs.pop(tenant, None)
+        self._tenant_banked.pop(tenant, None)
+        for tk in [tk for tk in self._tenant_open_count
+                   if tk[0] == tenant]:
+            self._tenant_open_count.pop(tk, None)
+            self._tenant_open_since.pop(tk, None)

@@ -132,6 +132,15 @@ class IncidentRegistry:
         # closed-incident log the chaos audit reconciles with the ledger
         self._mttr_pending: Deque[float] = deque(maxlen=1024)
         self._closed_log: Deque[Dict[str, Any]] = deque(maxlen=256)
+        # the fleet aggregation tier (obs.aggregate): fed at close,
+        # under self._lock — lock order registry -> aggregator only
+        self._sink: Optional[Any] = None
+
+    def attach_aggregator(self, sink: Any) -> None:
+        """Wire the fleet aggregation tier: every closed incident's
+        MTTR rolls into the per-cause fleet summary."""
+        with self._lock:
+            self._sink = sink
 
     # -- inception --------------------------------------------------------
 
@@ -289,6 +298,8 @@ class IncidentRegistry:
             }
             self._closed_log.append(closed)
             emits.append(("incident_close", dict(closed)))
+            if self._sink is not None:
+                self._sink.on_incident_close(cause, total, resolved)
         for name_, attrs in emits:
             tracer().event(name_, **attrs)
         return closed
@@ -305,11 +316,17 @@ class IncidentRegistry:
         with self._lock:
             return dict(self._stage_totals)
 
-    def closed_incidents(self) -> List[Dict[str, Any]]:
+    def closed_incidents(self, limit: Optional[int] = None
+                         ) -> List[Dict[str, Any]]:
         """The bounded closed-incident log (chaos audit: each entry must
-        reconcile with the ledger episode sharing its incident id)."""
+        reconcile with the ledger episode sharing its incident id).
+        ``limit`` caps the snapshot to the newest N entries (the
+        obs_report export path)."""
         with self._lock:
-            return [dict(e) for e in self._closed_log]
+            entries = list(self._closed_log)
+        if limit is not None and limit >= 0:
+            entries = entries[len(entries) - min(limit, len(entries)):]
+        return [dict(e) for e in entries]
 
     def was_closed(self, incident_id: str) -> bool:
         """Whether THIS process closed the incident (bounded lookback).

@@ -61,7 +61,9 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from typing import (
+    Any, Callable, Deque, Dict, List, Optional, Set, Tuple,
+)
 
 from ..k8s.runtime import escape_label_value
 from ..utils.trace import tracer
@@ -170,6 +172,18 @@ class GoodputLedger:
         self._hw_mfu: Dict[str, Tuple[float, int, float]] = {}
         self._hw_peak: Dict[str, float] = {}
         self._mfu_collapse_total: Dict[str, int] = {}
+        # the fleet aggregation tier (obs.aggregate.ObsAggregator),
+        # mirrored at every banking site below UNDER self._lock — lock
+        # order is strictly ledger -> aggregator, so the rollup can
+        # never drift from the per-job truth it folds
+        self._sink: Optional[Any] = None
+
+    def attach_aggregator(self, sink: Any) -> None:
+        """Wire the fleet aggregation tier: every banking site from now
+        on mirrors into the rollups under this ledger's lock. Attach
+        before feeding jobs — the aggregator does not back-fill."""
+        with self._lock:
+            self._sink = sink
 
     # -- segment machine (reconciler hooks) ------------------------------
 
@@ -182,11 +196,15 @@ class GoodputLedger:
             if key in self._finished:
                 return
             if phase in _PHASE_TERMINAL:
-                emit = self._close_locked(key)
+                cur = self._state.get(key)
+                now = self._clock()
+                emit = self._close_locked(key, now=now)
                 episode = self._close_episode_locked(key)
                 self._state.pop(key, None)
                 self._pending.pop(key, None)
                 self._finished.add(key)
+                if self._sink is not None and cur is not None:
+                    self._sink.on_state(key, cur[0], None, now)
             elif phase == _PHASE_RUNNING:
                 self._ran.add(key)
                 self._pending.pop(key, None)
@@ -262,6 +280,8 @@ class GoodputLedger:
             if moved > 0:
                 buckets[GOODPUT] = buckets[GOODPUT] - moved
                 buckets[cause] = buckets.get(cause, 0.0) + moved
+                if self._sink is not None:
+                    self._sink.on_charge(key, cause, moved)
             total = sum(buckets.values())
         self._emit_segments(key, emit)
         if moved > 0:
@@ -448,27 +468,54 @@ class GoodputLedger:
 
     def fleet_snapshot(self) -> Dict[str, Any]:
         """Aggregate attribution across every job the ledger has seen
-        (live + finished, until forgotten)."""
+        (live + finished, until forgotten). ONE clock read and straight
+        arithmetic — hot at fleet scale."""
         with self._lock:
-            wall = good = 0.0
+            now = self._clock()
+            good = 0.0
             badput: Dict[str, float] = {}
             for key in set(self._buckets) | set(self._state):
-                snap = self._snapshot_locked(key)
-                wall += snap["wall"]
-                good += snap["goodput"]
-                for cause, s in snap["badput"].items():
-                    badput[cause] = badput.get(cause, 0.0) + s
+                b = self._buckets.get(key)
+                if b:
+                    for bucket, s in b.items():
+                        if bucket == GOODPUT:
+                            good += s
+                        elif s > 0:
+                            badput[bucket] = badput.get(bucket, 0.0) + s
+                cur = self._state.get(key)
+                if cur is not None and now > cur[1]:
+                    open_s = now - cur[1]
+                    if cur[0] == GOODPUT:
+                        good += open_s
+                    else:
+                        badput[cur[0]] = badput.get(cur[0], 0.0) + open_s
+        wall = good + sum(badput.values())
         return {"wall": wall, "goodput": good, "badput": badput,
                 "ratio": (good / wall) if wall > 0 else 1.0}
 
     def job_ratios(self) -> Dict[str, float]:
-        """Per-job goodput ratio — the SLO evaluator's pull source."""
+        """Per-job goodput ratio — the SLO evaluator's pull source.
+        Called at every SLO evaluation over every live job, so this is
+        the 100k-fleet hot path: ONE clock read, no per-job snapshot
+        dicts (the 10k→100k curve exposed exactly that allocation)."""
         with self._lock:
-            out = {}
+            now = self._clock()
+            out: Dict[str, float] = {}
             for key in set(self._buckets) | set(self._state):
-                snap = self._snapshot_locked(key)
-                if snap["wall"] > 0:
-                    out[key] = snap["ratio"]
+                b = self._buckets.get(key)
+                if b:
+                    good = b.get(GOODPUT, 0.0)
+                    wall = sum(b.values())
+                else:
+                    good = wall = 0.0
+                cur = self._state.get(key)
+                if cur is not None and now > cur[1]:
+                    open_s = now - cur[1]
+                    wall += open_s
+                    if cur[0] == GOODPUT:
+                        good += open_s
+                if wall > 0:
+                    out[key] = good / wall
             return out
 
     def recovery_stats(self, namespace: str, name: str) -> Dict[str, Any]:
@@ -504,12 +551,18 @@ class GoodputLedger:
             return {"episodes": episodes, "recovery_s": recovery,
                     "open_bucket": open_bucket, "open_s": open_s}
 
-    def episode_log(self) -> List[Dict[str, Any]]:
-        """Closed badput episodes (bounded), each carrying the incident
-        id the registry minted — the chaos audit reconciles every closed
-        incident's stage sum against the matching entry here."""
+    def episode_log(self, limit: Optional[int] = None
+                    ) -> List[Dict[str, Any]]:
+        """Closed badput episodes (bounded ring), each carrying the
+        incident id the registry minted — the chaos audit reconciles
+        every closed incident's stage sum against the matching entry
+        here. ``limit`` caps the snapshot to the newest N entries (the
+        obs_report export path)."""
         with self._lock:
-            return [dict(e) for e in self._episode_log]
+            entries = list(self._episode_log)
+        if limit is not None and limit >= 0:
+            entries = entries[len(entries) - min(limit, len(entries)):]
+        return [dict(e) for e in entries]
 
     def job_count(self) -> int:
         """Jobs with live ledger series (churn-boundedness checks)."""
@@ -528,7 +581,9 @@ class GoodputLedger:
         key = _job_key(namespace, name)
         episode: Optional[Dict[str, Any]] = None
         with self._lock:
-            emit = self._close_locked(key)
+            cur = self._state.get(key)
+            now = self._clock()
+            emit = self._close_locked(key, now=now)
             episode = self._close_episode_locked(key)
             self._state.pop(key, None)
             self._buckets.pop(key, None)
@@ -547,27 +602,77 @@ class GoodputLedger:
             self._hw_mfu.pop(key, None)
             self._hw_peak.pop(key, None)
             self._mfu_collapse_total.pop(key, None)
+            if self._sink is not None:
+                if cur is not None:
+                    self._sink.on_state(key, cur[0], None, now)
+                self._sink.on_forget(key)
         self._emit_segments(key, emit)
         if episode is not None:
             tracer().event("ledger_episode", **episode)
 
     # -- exposition ------------------------------------------------------
 
-    def metrics_block(self) -> str:
+    def metrics_block(self, detail_jobs: Optional[Set[str]] = None,
+                      include_fleet: bool = True) -> str:
         """Text-exposition lines (no trailing newline); merged into the
-        operator scrape by :meth:`~.metrics.JobMetrics.metrics_block`."""
+        operator scrape by :meth:`~.metrics.JobMetrics.metrics_block`.
+
+        Snapshot-then-render: ONE clock read and raw dict copies under
+        the lock, every string built after it drops — a slow scrape can
+        no longer stall the reconcile workers feeding the ledger (the
+        lock-hold regression test pins both properties).
+
+        ``detail_jobs`` (aggregated mode, obs.aggregate) restricts the
+        per-job families to the exemplar set; fleet numbers then come
+        from the aggregation tier, so callers pass
+        ``include_fleet=False`` to skip ``tpujob_fleet_goodput_ratio``
+        (the aggregator exports it instead)."""
         esc = escape_label_value
         with self._lock:
-            snaps = {key: self._snapshot_locked(key)
-                     for key in sorted(set(self._buckets)
-                                       | set(self._state))}
+            now = self._clock()
+            state = dict(self._state)
+            keys = set(self._buckets) | set(self._state)
+            if detail_jobs is not None:
+                # aggregated mode: only the exemplars render per-job
+                # series, plus the MFU-reporting jobs the fleet
+                # effective-FLOPs fold needs
+                keys &= detail_jobs | set(self._hw_mfu)
+            raw = {key: dict(self._buckets.get(key) or ())
+                   for key in keys}
             degraded_total = dict(self._degraded_total)
             hw_mfu = dict(self._hw_mfu)
-            # computed inside the same lock hold as the per-job copies,
-            # by the same helper the arbiter-facing method uses
-            effective_flops = self._effective_flops_locked()
+            hw_peak = dict(self._hw_peak)
+        # fold each open segment virtually at the one clock read above
+        snaps: Dict[str, Dict[str, Any]] = {}
+        for key in sorted(raw):
+            buckets = raw[key]
+            cur = state.get(key)
+            if cur is not None and now > cur[1]:
+                buckets[cur[0]] = buckets.get(cur[0], 0.0) + (now - cur[1])
+            good = buckets.get(GOODPUT, 0.0)
+            badput = {c: s for c, s in buckets.items()
+                      if c != GOODPUT and s > 0}
+            wall = good + sum(badput.values())
+            snaps[key] = {"wall": wall, "goodput": good, "badput": badput,
+                          "ratio": (good / wall) if wall > 0 else 1.0}
+        effective_flops = 0.0
+        for key, (s, n, _last) in hw_mfu.items():
+            peak = hw_peak.get(key, 0.0)
+            snap = snaps.get(key)
+            if n <= 0 or peak <= 0 or snap is None:
+                continue
+            effective_flops += snap["goodput"] * (s / n) * peak
+        if detail_jobs is not None:
+            emit_snaps = {k: s for k, s in snaps.items()
+                          if k in detail_jobs}
+            degraded_total = {k: v for k, v in degraded_total.items()
+                              if k in detail_jobs}
+        else:
+            emit_snaps = snaps
         lines: List[str] = []
-        with_wall = {k: s for k, s in snaps.items() if s["wall"] > 0}
+        fleet_wall = sum(s["wall"] for s in snaps.values())
+        fleet_good = sum(s["goodput"] for s in snaps.values())
+        with_wall = {k: s for k, s in emit_snaps.items() if s["wall"] > 0}
         if with_wall:
             lines.append("# HELP tpujob_goodput_ratio Productive fraction "
                          "of the job's observed wall clock.")
@@ -595,14 +700,12 @@ class GoodputLedger:
                              "attributed to a named non-productive cause.")
                 lines.append("# TYPE tpujob_badput_seconds_total counter")
                 lines.extend(badput_lines)
-            fleet_wall = sum(s["wall"] for s in with_wall.values())
-            fleet_good = sum(s["goodput"] for s in with_wall.values())
+        if include_fleet and fleet_wall > 0:
             lines.append("# HELP tpujob_fleet_goodput_ratio Fleet-wide "
                          "goodput over observed wall clock, all jobs.")
             lines.append("# TYPE tpujob_fleet_goodput_ratio gauge")
             lines.append("tpujob_fleet_goodput_ratio %.6f"
-                         % ((fleet_good / fleet_wall)
-                            if fleet_wall > 0 else 1.0))
+                         % (fleet_good / fleet_wall))
         if degraded_total:
             lines.append("# HELP tpujob_backend_degraded_total Backend-"
                          "degradation episodes detected (throughput "
@@ -611,8 +714,11 @@ class GoodputLedger:
             for key in sorted(degraded_total):
                 lines.append('tpujob_backend_degraded_total{job="%s"} %d'
                              % (esc(key), degraded_total[key]))
+        have_mfu = any(n > 0 for (_s, n, _last) in hw_mfu.values())
         mfu_means = {key: s / n for key, (s, n, _last)
-                     in hw_mfu.items() if n > 0}
+                     in hw_mfu.items()
+                     if n > 0 and (detail_jobs is None
+                                   or key in detail_jobs)}
         if mfu_means:
             lines.append("# HELP tpujob_mfu Healthy-sample mean model "
                          "FLOP/s utilization per job (degraded samples "
@@ -621,6 +727,7 @@ class GoodputLedger:
             for key in sorted(mfu_means):
                 lines.append('tpujob_mfu{job="%s"} %.6f'
                              % (esc(key), mfu_means[key]))
+        if have_mfu:
             lines.append("# HELP tpujob_fleet_effective_flops Goodput-"
                          "seconds weighted by MFU x chip peak, summed "
                          "over the fleet (the number the arbiter and "
@@ -646,6 +753,9 @@ class GoodputLedger:
         self._state[key] = (bucket, now)
         self._first.setdefault(key, now)
         self._last[key] = now
+        if self._sink is not None:
+            self._sink.on_state(key, cur[0] if cur is not None else None,
+                                bucket, now)
         return emit
 
     def _close_locked(self, key: str,
@@ -665,6 +775,8 @@ class GoodputLedger:
             return []
         buckets = self._buckets.setdefault(key, {})
         buckets[bucket] = buckets.get(bucket, 0.0) + dur
+        if self._sink is not None:
+            self._sink.on_bank(key, bucket, dur)
         # episode accumulation rides segment banking only: badput
         # seconds that really passed while the episode was live — a
         # charge() moving PRE-incident goodput into a cause must not

@@ -30,8 +30,12 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 from ..api.types import Phase
 from ..k8s.runtime import escape_label_value
 from ..utils.trace import SpanContext, tracer
+from .aggregate import (
+    ObsAggregator, configured_top_k, detail_jobs_threshold,
+)
 from .exposition import format_float
 from .incidents import IncidentRegistry
+from .ledger import GOODPUT as LEDGER_GOODPUT
 from .ledger import GoodputLedger
 
 log = logging.getLogger("tpujob.obs")
@@ -129,7 +133,10 @@ class JobMetrics:
     def __init__(self, clock: Callable[[], float] = time.monotonic,
                  wall: Callable[[], float] = time.time,
                  recorder_depth: int = 64,
-                 ledger: Optional[GoodputLedger] = None):
+                 ledger: Optional[GoodputLedger] = None,
+                 aggregator: Optional[ObsAggregator] = None,
+                 detail_jobs: Optional[int] = None,
+                 top_k: Optional[int] = None):
         self._clock = clock
         self._lock = threading.Lock()
         # job key -> (phase, entered-at monotonic)
@@ -169,6 +176,25 @@ class JobMetrics:
         #: ledger's badput episodes, on the same clock, so the two
         #: planes cross-validate
         self.incidents = IncidentRegistry(clock=clock)
+        #: the fleet aggregation tier (obs.aggregate, ROADMAP item 4):
+        #: rollups fed at the ledger's banking sites and the registry's
+        #: close hook. Above ``detail_jobs`` live jobs
+        #: (TPUJOB_OBS_DETAIL_JOBS; 0 = never) the scrape flips to
+        #: aggregated mode: per-job families restricted to the top-K-
+        #: by-badput exemplars, the fleet picture carried by the rollups.
+        self.aggregate = aggregator if aggregator is not None \
+            else ObsAggregator(clock=clock)
+        self._detail_limit = detail_jobs if detail_jobs is not None \
+            else detail_jobs_threshold()
+        self._top_k = top_k if top_k is not None else configured_top_k()
+        self.ledger.attach_aggregator(self.aggregate)
+        self.incidents.attach_aggregator(self.aggregate)
+
+    def set_tenant(self, namespace: str, name: str, tenant: str) -> None:
+        """Attribute the job to a scheduler tenant in the aggregation
+        tier (the tier defaults to the namespace until told; the fleet
+        arbiter calls this with the schedulingPolicy queue)."""
+        self.aggregate.set_tenant(namespace, name, tenant)
 
     # -- feeding hooks (reconciler / coordination server) ----------------
 
@@ -207,6 +233,7 @@ class JobMetrics:
         # badput reconcile exactly
         self.incidents.on_phase(namespace, name, phase)
         self.ledger.observe_phase(namespace, name, phase)
+        self.aggregate.on_phase(key, phase)
 
     def observe_restart(self, namespace: str, name: str, cause: str) -> None:
         if cause not in RESTART_CAUSES:
@@ -353,6 +380,23 @@ class JobMetrics:
         with self._lock:
             return job_key(namespace, name) in self._first_seen
 
+    def slo_goodput_samples(self) -> List[float]:
+        """Goodput-ratio samples for the SLO evaluator's pull source:
+        per-job ratios in detail mode; ONE fleet-rollup sample above
+        the aggregation threshold. At 100k jobs the per-job pull was
+        the scrape's own outage (O(fleet) ledger fold per scrape), and
+        the evaluator's bounded sample window could only ever see an
+        arbitrary tail of those 100k pushes anyway — the rollup ratio
+        is both O(causes) and the number a fleet SLO actually means."""
+        with self._lock:
+            n_jobs = len(self._first_seen)
+        if 0 < self._detail_limit < n_jobs:
+            totals = self.aggregate.fleet_totals()
+            good = totals.get(LEDGER_GOODPUT, 0.0)
+            wall = sum(totals.values())
+            return [(good / wall) if wall > 0 else 1.0]
+        return list(self.ledger.job_ratios().values())
+
     def pop_time_to_running_samples(self) -> List[float]:
         """Drain the pending first-Running latencies (seconds) — the
         ``time_to_running`` SLO source consumes them at evaluation."""
@@ -409,6 +453,7 @@ class JobMetrics:
         ``Manager.add_metrics_provider``."""
         esc = escape_label_value
         with self._lock:
+            n_jobs = len(self._first_seen)
             phases = dict(self._phase)
             hist = {p: list(c) for p, c in self._hist.items()}
             hist_sum = dict(self._hist_sum)
@@ -423,6 +468,30 @@ class JobMetrics:
             ckpt_saves = dict(self._ckpt_saves)
             ckpt_corrupt = dict(self._ckpt_corrupt)
             ckpt_restore = dict(self._ckpt_restore_step)
+        now = self._clock()
+        aggregated = 0 < self._detail_limit < n_jobs
+        detail: Optional[set] = None
+        if aggregated:
+            # above the detail threshold only the top-K-by-badput
+            # exemplars keep per-job {job=...} series; everything else
+            # is carried by the aggregation tier's rollup families
+            detail = self.aggregate.top_badput_jobs(self._top_k, now=now)
+
+            def _keep(d: Dict[str, Any]) -> Dict[str, Any]:
+                return {k: v for k, v in d.items() if k in detail}
+
+            phases = _keep(phases)
+            resizes = _keep(resizes)
+            barrier = _keep(barrier)
+            releases = _keep(releases)
+            drains = _keep(drains)
+            sched_evictions = _keep(sched_evictions)
+            gang_stranded = _keep(gang_stranded)
+            ckpt_saves = _keep(ckpt_saves)
+            ckpt_corrupt = _keep(ckpt_corrupt)
+            ckpt_restore = _keep(ckpt_restore)
+            restarts = {k: v for k, v in restarts.items()
+                        if k[0] in detail}
         lines: List[str] = []
         if phases:
             lines.append("# HELP tpujob_job_phase Job phase state set "
@@ -533,12 +602,21 @@ class JobMetrics:
             for key in sorted(ckpt_restore):
                 lines.append('tpujob_checkpoint_restore_step{job="%s"} %d'
                              % (esc(key), ckpt_restore[key]))
-        ledger_block = self.ledger.metrics_block()
+        ledger_block = self.ledger.metrics_block(
+            detail_jobs=detail, include_fleet=not aggregated)
         if ledger_block:
             lines.append(ledger_block)
         incident_block = self.incidents.metrics_block()
         if incident_block:
             lines.append(incident_block)
+        # the rollup families render in O(tenants + causes + phases)
+        # regardless of fleet size — present in BOTH modes, so a
+        # dashboard built on them never cares which side of the
+        # threshold the fleet is on
+        agg_block = self.aggregate.metrics_block(
+            now=now, include_fleet_ratio=aggregated)
+        if agg_block:
+            lines.append(agg_block)
         return "\n".join(lines)
 
 

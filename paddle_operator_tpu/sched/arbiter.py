@@ -374,6 +374,18 @@ class FleetArbiter:
         if self.feedback is not None:
             self.feedback.forget_job(namespace, name)
 
+    def decision_entries(self, limit: Optional[int] = None) -> List[dict]:
+        """Size-capped snapshot of the preempt/shrink decision ring
+        (newest ``limit`` entries; None = the whole ring, itself bounded
+        by ``decision_log_depth``). The export surface obs_report reads —
+        callers get copies, never the live deque."""
+        with self._lock:
+            entries = list(self.decision_log)
+        if limit is not None:
+            n = max(0, int(limit))
+            entries = entries[-n:] if n else []
+        return [dict(e) for e in entries]
+
     def job_count(self) -> int:
         """Jobs with live per-job arbiter series — decision counters and
         the own-write np ledger (churn-boundedness checks)."""
@@ -477,6 +489,15 @@ class FleetArbiter:
             draining[key] = bool(pods) and all(
                 p["metadata"].get("deletionTimestamp") for p in pods)
             candidates.append(job)
+        if self.obs is not None:
+            # Tenant attribution for the obs aggregation tier: the
+            # arbiter is the one component that already resolves every
+            # job's schedulingPolicy queue, so the fleet rollup's tenant
+            # labels follow the same spelling fair share bills.
+            set_tenant = getattr(self.obs, "set_tenant", None)
+            if set_tenant is not None:
+                for job in candidates:
+                    set_tenant(job.namespace, job.name, tenant_of(job))
         # Effective priorities for this plan, computed ONCE per job: the
         # SLO-burn feedback boost (bounded, hysteretic) rides on top of
         # the static priority so a job burning its error budget bids for

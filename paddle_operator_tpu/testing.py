@@ -119,8 +119,8 @@ class OperatorHarness:
             self.slo = SloEvaluator(specs, on_alert=self._slo_alert, **kw)
             self.slo.add_source(
                 lambda: [("goodput_ratio", r)
-                         for r in self.job_metrics.ledger
-                         .job_ratios().values()])
+                         for r in self.job_metrics
+                         .slo_goodput_samples()])
             self.slo.add_source(
                 lambda: [("time_to_running", s) for s in self.job_metrics
                          .pop_time_to_running_samples()])
@@ -210,6 +210,7 @@ class OperatorHarness:
         if racedetect.enabled():
             for obj in (self.job_metrics, self.job_metrics.ledger,
                         self.job_metrics.incidents,
+                        self.job_metrics.aggregate,
                         self.slo, self.arbiter,
                         getattr(self.arbiter, "feedback", None)
                         if self.arbiter is not None else None,

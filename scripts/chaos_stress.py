@@ -32,9 +32,10 @@ import logging
 
 from paddle_operator_tpu.chaos import SCENARIOS, run_scenario
 
-#: scenarios whose single run is itself fleet-scale (hundreds of jobs):
-#: swept at --heavy-seeds instead of --seeds
-HEAVY_SCENARIOS = ("control_plane_storm",)
+#: scenarios whose single run is itself fleet-scale (hundreds of jobs,
+#: or — fleet_week — a multi-thousand-tick compressed week): swept at
+#: --heavy-seeds instead of --seeds
+HEAVY_SCENARIOS = ("control_plane_storm", "fleet_week")
 
 
 def main(argv=None) -> int:

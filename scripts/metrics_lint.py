@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -159,6 +160,74 @@ def selftest_text() -> str:
     assert 'tpujob_sched_feedback_total{action="remediate"} 1' in text, \
         "the degradation remediation did not fire"
     h.close()
+    return text
+
+
+def selftest_aggregated_text() -> str:
+    """The AGGREGATED-mode leg (docs/observability.md "Scale tiers"):
+    force the cardinality threshold low (TPUJOB_OBS_DETAIL_JOBS=3,
+    TPUJOB_OBS_TOP_K=2), feed more jobs than the threshold through the
+    real JobMetrics chain, and lint what a fleet-scale scrape actually
+    serves — the bounded rollup families must be present, per-job
+    families must be restricted to the top-K-by-badput exemplar set,
+    and the fleet goodput ratio must be emitted exactly once (by the
+    aggregator, not the ledger)."""
+    from paddle_operator_tpu.testing import OperatorHarness
+
+    saved = {k: os.environ.get(k)
+             for k in ("TPUJOB_OBS_DETAIL_JOBS", "TPUJOB_OBS_TOP_K")}
+    os.environ["TPUJOB_OBS_DETAIL_JOBS"] = "3"
+    os.environ["TPUJOB_OBS_TOP_K"] = "2"
+    try:
+        clock = [0.0]
+        h = OperatorHarness(init_image="", metrics_clock=lambda: clock[0])
+        jm = h.job_metrics
+        for i in range(8):
+            name = "agg-%02d" % i
+            jm.set_tenant("default", name, "team-%d" % (i % 2))
+            jm.observe_phase("default", name, "Pending")
+            clock[0] += 0.25
+            jm.observe_phase("default", name, "Running")
+        # the first two jobs take drain badput, making them the
+        # top-K-by-badput exemplars; the other six must vanish from
+        # every per-job family
+        for name in ("agg-00", "agg-01"):
+            jm.observe_drain("default", name)
+            jm.observe_phase("default", name, "Pending")
+            clock[0] += 0.5
+            jm.observe_phase("default", name, "Running")
+        clock[0] += 1.0
+        text = h.manager.metrics_text()
+        h.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for fam in ("tpujob_fleet_goodput_seconds_total",
+                "tpujob_fleet_badput_seconds_total",
+                "tpujob_tenant_jobs",
+                "tpujob_tenant_goodput_ratio",
+                "tpujob_job_phase_population",
+                "tpujob_fleet_mttr_seconds",
+                "tpujob_fleet_goodput_ratio"):
+        assert "# TYPE %s" % fam in text, \
+            "aggregated selftest lost rollup family %s" % fam
+    exemplars = set(re.findall(r'job="default/(agg-[0-9]+)"', text))
+    assert exemplars, "aggregated mode dropped the exemplar set entirely"
+    assert exemplars <= {"agg-00", "agg-01"}, \
+        "per-job labels leaked beyond the top-K exemplars: %s" \
+        % sorted(exemplars)
+    ratio_samples = [line for line in text.splitlines()
+                     if line.startswith("tpujob_fleet_goodput_ratio ")]
+    assert len(ratio_samples) == 1, \
+        "fleet ratio emitted %d times (ledger/aggregator overlap?)" \
+        % len(ratio_samples)
+    assert 'tpujob_tenant_jobs{tenant="team-0"} 4' in text, \
+        "tenant population gauge lost a tenant"
+    assert 'tpujob_fleet_badput_seconds_total{cause="drain"}' in text, \
+        "the drain badput never rolled up"
     return text
 
 
@@ -322,6 +391,8 @@ def main(argv=None) -> int:
     targets = []
     if args.selftest:
         targets.append(("selftest:Manager.metrics_text", selftest_text()))
+        targets.append(("selftest:aggregated-mode Manager.metrics_text",
+                        selftest_aggregated_text()))
         targets.append(("selftest:WorkerMetricsServer.metrics_text",
                         selftest_worker_text()))
         art_client, art_server = selftest_artifact_text()

@@ -36,11 +36,28 @@ incident — exit 1 on an orphan span, a broken chain, dropped
 propagation, or an event-plane/time-plane mismatch. ``--trace`` is
 repeatable: multiple per-process files are merged on their
 ``clock_anchor`` records, so ordering survives wall-clock skew.
+
+With a single ``--trace`` file, the lane flags and ``--job`` read
+through a :class:`TraceIndex` — a one-pass byte-offset index (per-lane,
+per-job, per-incident) built once per file and cached on
+``(path, mtime, size)`` — so ``--incidents`` / ``--waterfall`` /
+``--job`` re-parse only the records they need instead of re-scanning a
+multi-million-record trace per lane.
+
+``--chaos fleet_week`` (ISSUE 18) runs the week-compressed fleet soak
+and then reconstructs the WHOLE week from its trace alone: the goodput
+waterfall per operator era (the run's ``operator_restart`` marker
+splits eras — the ledger's running totals restart at the crash, so
+conservation is checked within each era), the incident chains, and the
+hardware lane — and requires the final era's rebuilt per-cause fleet
+sums to agree with the aggregation tier's own final counters (the
+report's ``rollup_*_s`` extras) — exit 1 on any mismatch.
 """
 
 from __future__ import annotations
 
 import argparse
+import bisect
 import datetime
 import json
 import os
@@ -522,6 +539,19 @@ def incident_chains(records: List[dict], job: Optional[str] = None
         name = rec.get("name", "")
         attrs = rec.get("attrs") or {}
         inc = attrs.get("incident")
+        if name == "operator_restart":
+            # the process died with these segments open: their closes
+            # (and ledger episodes) died with it. Chains the NEW process
+            # re-adopts arrive as incident_restored; ones it never sees
+            # again (job completed or GC'd before re-adoption) would
+            # otherwise read as broken — the restart marker is the
+            # trace's own proof they ended with the process.
+            for ch in chains.values():
+                if ch["live"]:
+                    ch["lost"] += 1
+                    ch["live"] = False
+                    ch["seg"] = None
+            continue
         if name in ("incident_open", "incident_restored"):
             if not _matches(attrs.get("job"), job):
                 continue
@@ -860,6 +890,176 @@ def render_report(timeline: List[dict], metrics_text: str = "",
 
 
 # ---------------------------------------------------------------------------
+# trace index (ISSUE 18): one pass over the file, then every lane reads
+# only its own byte offsets — --incidents/--waterfall/--job stay fast on
+# multi-million-record traces instead of re-scanning per lane
+# ---------------------------------------------------------------------------
+
+#: final-era rebuilt fleet sums vs the aggregation tier's own counters.
+#: Both planes round per event at 1e-6; a real misattribution in the
+#: fleet_week soak is a whole charge (>= 0.5s), so 10ms of accumulated
+#: rounding headroom cannot mask one.
+ROLLUP_TOL_S = 0.01
+
+
+class TraceIndex:
+    """A one-pass byte-offset index over one trace file (rotated
+    segments included). Locations are ``(file_index, byte_offset)``
+    pairs — file order is oldest-first, so location order IS emission
+    order across a rotation. Lanes:
+
+    * ``ledger`` — ``ledger_segment`` / ``ledger_charge`` (waterfall);
+    * ``incident`` — every incident-plane record: ``incident_*``,
+      ``ledger_episode``, the inception events, and any record stamped
+      with an ``incident`` attribute (a superset of what the
+      --incidents audit scans, so the lane can answer it alone);
+    * ``hardware`` — ``hardware_block`` / ``mfu_sample`` /
+      ``mfu_collapse``;
+    * ``decision`` — ``sched_feedback``.
+
+    ``by_job`` / ``by_incident`` map each job / incident id to its
+    locations. ``restart_offsets`` marks ``operator_restart`` events
+    (the fleet_week crash marker) so readers can split operator eras.
+    ``read()`` re-parses only the requested locations, re-timing each
+    record with the ``clock_anchor`` governing its position — the same
+    re-anchoring :func:`merge_traces` applies on a full scan."""
+
+    LANE_NAMES = ("ledger", "incident", "hardware", "decision")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.files = trace_paths(path)
+        self.lanes: Dict[str, List[Tuple[int, int]]] = \
+            {n: [] for n in self.LANE_NAMES}
+        self.by_job: Dict[str, List[Tuple[int, int]]] = {}
+        self.by_incident: Dict[str, List[Tuple[int, int]]] = {}
+        self.restart_offsets: List[Tuple[int, int]] = []
+        self._anchors: List[Tuple[Tuple[int, int], float, float]] = []
+        self.records_total = 0
+        self._build()
+
+    def _build(self) -> None:
+        for fi, p in enumerate(self.files):
+            try:
+                f = open(p, "rb")
+            except FileNotFoundError:
+                continue
+            with f:
+                off = 0
+                for raw in f:
+                    loc = (fi, off)
+                    off += len(raw)
+                    try:
+                        rec = json.loads(raw.decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        continue
+                    self.records_total += 1
+                    self._classify(rec, loc)
+
+    def _classify(self, rec: dict, loc: Tuple[int, int]) -> None:
+        name = rec.get("name", "")
+        attrs = rec.get("attrs") or {}
+        if name == "clock_anchor" and rec.get("m0") is not None:
+            self._anchors.append(
+                (loc, float(rec["t0"]), float(rec["m0"])))
+            return
+        if name == "operator_restart":
+            self.restart_offsets.append(loc)
+        if name in ("ledger_segment", "ledger_charge"):
+            self.lanes["ledger"].append(loc)
+        if name.startswith("incident") or name == "ledger_episode" \
+                or name in INCEPTION_EVENTS or name == "operator_restart" \
+                or "incident" in attrs:
+            self.lanes["incident"].append(loc)
+        if name in ("hardware_block", "mfu_sample", "mfu_collapse"):
+            self.lanes["hardware"].append(loc)
+        if name == "sched_feedback":
+            self.lanes["decision"].append(loc)
+        jkey = _job_of_trace(rec)
+        if jkey:
+            self.by_job.setdefault(jkey, []).append(loc)
+        inc = attrs.get("incident")
+        if inc:
+            self.by_incident.setdefault(str(inc), []).append(loc)
+
+    def read(self, locs: List[Tuple[int, int]]) -> List[dict]:
+        """Re-parse exactly these locations, in emission order, with
+        clock_anchor re-timing applied (records before the first anchor
+        keep raw ``t0``, as in :func:`merge_traces`)."""
+        out: List[dict] = []
+        anchor_locs = [a[0] for a in self._anchors]
+        by_file: Dict[int, List[Tuple[int, int]]] = {}
+        for loc in sorted(set(locs)):
+            by_file.setdefault(loc[0], []).append(loc)
+        for fi in sorted(by_file):
+            try:
+                f = open(self.files[fi], "rb")
+            except (FileNotFoundError, IndexError):
+                continue
+            with f:
+                for loc in by_file[fi]:
+                    f.seek(loc[1])
+                    try:
+                        rec = json.loads(f.readline().decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        continue
+                    m0 = rec.get("m0")
+                    i = bisect.bisect_right(anchor_locs, loc) - 1
+                    if i >= 0 and m0 is not None:
+                        _loc, wall, mono = self._anchors[i]
+                        rec["t0"] = round(wall + (float(m0) - mono), 6)
+                    out.append(rec)
+        return out
+
+    def lane(self, name: str,
+             after: Optional[Tuple[int, int]] = None) -> List[dict]:
+        """All records in one lane (optionally only past ``after``)."""
+        locs = self.lanes[name]
+        if after is not None:
+            locs = [loc for loc in locs if loc > after]
+        return self.read(locs)
+
+    def eras(self, locs: List[Tuple[int, int]]
+             ) -> List[List[Tuple[int, int]]]:
+        """Split locations into operator eras at the restart markers:
+        ``eras[0]`` precedes the first ``operator_restart``; one extra
+        era per marker. With no marker, one era holds everything."""
+        bounds = self.restart_offsets
+        out: List[List[Tuple[int, int]]] = \
+            [[] for _ in range(len(bounds) + 1)]
+        for loc in locs:
+            out[bisect.bisect_right(bounds, loc)].append(loc)
+        return out
+
+    def job_offsets(self, wanted: str) -> List[Tuple[int, int]]:
+        """Locations for one job, bare-name keys included (the same
+        matching rule the full-scan filter applies)."""
+        locs: List[Tuple[int, int]] = []
+        for jkey, jlocs in self.by_job.items():
+            if _matches(jkey, wanted):
+                locs.extend(jlocs)
+        return sorted(set(locs))
+
+
+#: built index per trace path, keyed on every segment's (mtime, size) —
+#: "built once per file": within one process, repeated lane reads over
+#: an unchanged trace never re-scan it
+_INDEX_CACHE: Dict[str, Tuple[tuple, TraceIndex]] = {}
+
+
+def trace_index(path: str) -> TraceIndex:
+    key = tuple(
+        (p, os.path.getmtime(p), os.path.getsize(p))
+        for p in trace_paths(path) if os.path.exists(p))
+    cached = _INDEX_CACHE.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    idx = TraceIndex(path)
+    _INDEX_CACHE[path] = (key, idx)
+    return idx
+
+
+# ---------------------------------------------------------------------------
 # chaos mode
 # ---------------------------------------------------------------------------
 
@@ -925,6 +1125,107 @@ def run_chaos(scenario: str, seed: int, verbose: bool,
             if inc_rc != 0:
                 return inc_rc
         return 0
+    if scenario == "fleet_week":
+        # the week-reconstruction lane (ISSUE 18): run the compressed
+        # fleet week, then rebuild ALL of it from the trace alone —
+        # waterfall per operator era, incident chains, hardware — and
+        # require the final era's rebuilt fleet sums to agree with the
+        # aggregation tier's own final counters (rollup_*_s extras)
+        from paddle_operator_tpu.chaos import run_scenario
+
+        fd, trace_path = tempfile.mkstemp(prefix="obs-trace-",
+                                          suffix=".jsonl")
+        os.close(fd)
+        prev = trace_mod._global
+        trace_mod._global = trace_mod.Tracer(path=trace_path)
+        try:
+            try:
+                report = run_scenario(scenario, seed, quick=True)
+            finally:
+                trace_mod.tracer().close()
+                trace_mod._global = prev
+            print(report.summary_line())
+            print()
+            if report.violations:
+                # the run's own per-tick audits (conservation, MTTR ==
+                # episode, rollup == per-job truth) gate the lane: a
+                # green reconstruction over a broken run would be a lie
+                print("CHAOS INVARIANT VIOLATIONS:")
+                for v in report.violations:
+                    print("  " + v)
+                return 1
+            idx = trace_index(trace_path)
+            ledger_eras = idx.eras(idx.lanes["ledger"])
+            print("week trace: %d record(s), %d operator era(s), "
+                  "%d ledger event(s)"
+                  % (idx.records_total, len(ledger_eras),
+                     len(idx.lanes["ledger"])))
+            # per-era conservation: the ledger's running totals restart
+            # at the crash, so the whole-week check runs WITHIN eras
+            era_buckets: List[Dict[str, Dict[str, float]]] = []
+            for i, era_locs in enumerate(ledger_eras):
+                buckets, totals = ledger_waterfall(idx.read(era_locs))
+                era_buckets.append(buckets)
+                errs = waterfall_violations(buckets, totals)
+                if errs:
+                    print("WATERFALL CONSERVATION VIOLATIONS "
+                          "(era %d):" % i)
+                    for e in errs:
+                        print("  " + e)
+                    return 1
+                print("era %d waterfall conservation: ok (%d job(s))"
+                      % (i, len(buckets)))
+            # final era vs the aggregation tier: fold the rebuilt
+            # per-job buckets into per-cause fleet sums and compare
+            # against the tier's own final counters from the report
+            rebuilt: Dict[str, float] = {}
+            for buckets in era_buckets[-1:]:
+                for jkey in buckets:
+                    for cause, s in buckets[jkey].items():
+                        rebuilt[cause] = rebuilt.get(cause, 0.0) + s
+            want = {k[len("rollup_"):-len("_s")]: float(v)
+                    for k, v in (report.extra or {}).items()
+                    if k.startswith("rollup_") and k.endswith("_s")}
+            errs = []
+            for cause in sorted(set(rebuilt) | set(want)):
+                got, exp = rebuilt.get(cause, 0.0), want.get(cause, 0.0)
+                if abs(got - exp) > ROLLUP_TOL_S:
+                    errs.append(
+                        "%s: trace rebuild %.6fs != aggregation tier "
+                        "%.6fs" % (cause, got, exp))
+            if errs:
+                print("ROLLUP-VS-TRACE VIOLATIONS (final era):")
+                for e in errs:
+                    print("  " + e)
+                return 1
+            print("final-era fleet sums == aggregation tier counters: "
+                  "ok (%s)"
+                  % ", ".join("%s=%.3fs" % (c, s)
+                              for c, s in sorted(want.items())))
+            # incident chains + hardware picture over the WHOLE week
+            print()
+            inc_rc, text = incidents_lane(idx.lane("incident"))
+            print(text)
+            if inc_rc == 2:
+                print("(expected incidents in a fleet_week run)")
+            if inc_rc != 0:
+                return inc_rc
+            print()
+            hw_rc, text = hardware_lane(idx.lane("hardware"))
+            print(text)
+            if hw_rc == 2:
+                print("(expected hardware telemetry in a fleet_week "
+                      "run)")
+            if hw_rc != 0:
+                return hw_rc
+            print()
+            print("fleet week reconstructed from trace alone: ok")
+            return 0
+        finally:
+            for p in trace_paths(trace_path):
+                if os.path.exists(p):
+                    os.unlink(p)
+            _INDEX_CACHE.pop(trace_path, None)
     if scenario not in CONTROL_SCENARIOS:
         print("scenario %r is not a control-plane scenario (one of %s)"
               % (scenario, ", ".join(sorted(CONTROL_SCENARIOS))))
@@ -1046,7 +1347,18 @@ def main(argv=None) -> int:
                          incidents=args.incidents)
     if not args.trace and not args.events:
         ap.error("need --trace and/or --events (or --chaos)")
-    records = merge_traces(args.trace) if args.trace else []
+    # single trace file: read through the byte-offset index — the job
+    # timeline and each lane re-parse only their own records instead of
+    # scanning the whole file once per lane
+    idx: Optional[TraceIndex] = None
+    if args.trace and len(args.trace) == 1:
+        idx = trace_index(args.trace[0])
+    if idx is not None and args.job:
+        records = idx.read(idx.job_offsets(args.job))
+    elif args.trace:
+        records = merge_traces(args.trace)
+    else:
+        records = []
     events: List[dict] = []
     if args.events:
         with open(args.events) as f:
@@ -1061,7 +1373,9 @@ def main(argv=None) -> int:
                               verbose=args.verbose)
     print(render_report(timeline, metrics_text=metrics, job=args.job))
     if args.decisions:
-        entries = decision_entries(records, job=args.job)
+        entries = decision_entries(
+            idx.lane("decision") if idx is not None else records,
+            job=args.job)
         print()
         print(render_decisions(entries))
         errs = decision_violations(entries)
@@ -1071,7 +1385,9 @@ def main(argv=None) -> int:
                 print("  " + e)
             return 1
     if args.waterfall:
-        buckets, totals = ledger_waterfall(records, job=args.job)
+        buckets, totals = ledger_waterfall(
+            idx.lane("ledger") if idx is not None else records,
+            job=args.job)
         for jkey in sorted(buckets):
             print()
             print(render_waterfall(jkey, buckets[jkey]))
@@ -1083,13 +1399,17 @@ def main(argv=None) -> int:
             return 1
     if args.hardware:
         print()
-        hw_rc, text = hardware_lane(records, job=args.job)
+        hw_rc, text = hardware_lane(
+            idx.lane("hardware") if idx is not None else records,
+            job=args.job)
         print(text)
         if hw_rc == 1:
             return 1
     if args.incidents:
         print()
-        inc_rc, text = incidents_lane(records, job=args.job)
+        inc_rc, text = incidents_lane(
+            idx.lane("incident") if idx is not None else records,
+            job=args.job)
         print(text)
         if inc_rc == 1:
             return 1

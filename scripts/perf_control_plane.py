@@ -32,6 +32,15 @@ concurrently, and the churn leg additionally proves no key was lost by
 checking every drifted job's status was actually repaired. The parallel
 leg also asserts global concurrency really exceeded 1 (the speedup is
 parallelism, not noise).
+
+A fourth measurement rides its own size axis (``--scrape-sizes``,
+default 1k/10k/100k): the **scrape** curve — N synthetic jobs fed
+through the real JobMetrics/ledger/aggregation-tier hook chain, then
+one full ``Manager.metrics_text()`` timed in detail mode (every job
+keeps its ``{job=...}`` series) vs aggregated mode (bounded rollup
+families + top-K exemplars, obs.aggregate). Aggregated-mode wall at
+the largest size is asserted <= ``--assert-scrape-s`` (default 1.0) —
+the ISSUE 18 acceptance gate for the 100k-job scrape.
 """
 
 from __future__ import annotations
@@ -285,6 +294,88 @@ def churn_leg(h, mw, tracker, k, workers, rtt_s, baseline):
     return st
 
 
+def build_scrape_fleet(n, badput_every=10, tenants=16):
+    """N synthetic jobs fed through the REAL JobMetrics hook chain
+    (phase machine -> incidents -> ledger -> aggregation tier) on a
+    manual clock — no pods or reconciles: at 100k jobs a real bring-up
+    would dominate the bench, and the scrape path being measured does
+    not care how the series got there. Every ``badput_every``-th job
+    carries a closed drain incident, so the ledger has badput to
+    attribute and the aggregation tier has exemplars to rank."""
+    clock = [0.0]
+    h = OperatorHarness(init_image="", metrics_clock=lambda: clock[0])
+    jm = h.job_metrics
+    t0 = time.perf_counter()
+    for i in range(n):
+        name = "scrape-%06d" % i
+        jm.set_tenant("default", name, "team-%02d" % (i % tenants))
+        jm.observe_phase("default", name, "Pending")
+        clock[0] += 0.25
+        jm.observe_phase("default", name, "Running")
+        if i % badput_every == 0:
+            # a graceful drain round-trip: incident opened, badput
+            # attributed, incident closed at the Running re-entry —
+            # exercises the MTTR rollups and the top-K ranking
+            jm.observe_drain("default", name)
+            jm.observe_phase("default", name, "Pending")
+            clock[0] += 0.5
+            jm.observe_phase("default", name, "Running")
+    feed_s = time.perf_counter() - t0
+    clock[0] += 1.0
+    # the resident fleet must not bill cyclic-GC pauses to the scrape
+    # being measured (same lesson as the reconcile legs above)
+    gc.collect()
+    gc.freeze()
+    return h, feed_s
+
+
+def _time_scrape(h, detail_limit, repeat=3):
+    """Best-of-``repeat`` wall for one full ``Manager.metrics_text()``
+    scrape with the aggregation threshold forced to ``detail_limit``
+    (0 = detail mode). Returns (seconds, lines, chars)."""
+    jm = h.job_metrics
+    prev = jm._detail_limit
+    jm._detail_limit = detail_limit
+    try:
+        best, text = None, ""
+        for _ in range(repeat):
+            t0 = time.perf_counter()
+            text = h.manager.metrics_text()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best, text.count("\n") + 1, len(text)
+    finally:
+        jm._detail_limit = prev
+
+
+def scrape_size(n, args):
+    """One point of the scrape curve: detail mode (every job keeps its
+    {job=...} series) vs aggregated mode (rollups + top-K exemplars)."""
+    print("== scrape fleet %d ==" % n)
+    h, feed_s = build_scrape_fleet(n)
+    try:
+        detail_s, detail_lines, detail_chars = _time_scrape(
+            h, 0, repeat=1 if n >= 100000 else 2)
+        agg_s, agg_lines, agg_chars = _time_scrape(h, 1)
+        point = {
+            "jobs": n,
+            "feed_s": round(feed_s, 2),
+            "detail": {"seconds": round(detail_s, 4),
+                       "lines": detail_lines, "chars": detail_chars},
+            "aggregated": {"seconds": round(agg_s, 4),
+                           "lines": agg_lines, "chars": agg_chars},
+        }
+        print("  feed    : %d jobs in %.1fs" % (n, feed_s))
+        print("  detail  : %.3fs (%d lines)" % (detail_s, detail_lines))
+        print("  aggreg. : %.3fs (%d lines, %.0fx fewer)"
+              % (agg_s, agg_lines, detail_lines / max(1, agg_lines)))
+        return point
+    finally:
+        h.close()
+        gc.unfreeze()
+        gc.collect()
+
+
 def measure_size(n, args):
     print("== fleet size %d ==" % n)
     h, mw, tracker, setup_s = build_fleet(n)
@@ -348,6 +439,13 @@ def main(argv=None) -> int:
     ap.add_argument("--assert-speedup", type=float, default=None,
                     help="required parallel/baseline churn speedup at the "
                          "largest size (default: 4.0, quick: 2.0)")
+    ap.add_argument("--scrape-sizes", default="1000,10000,100000",
+                    help="comma-separated fleet sizes for the scrape "
+                         "curve (synthetic series through the real "
+                         "JobMetrics chain; quick: 1000)")
+    ap.add_argument("--assert-scrape-s", type=float, default=1.0,
+                    help="required aggregated-mode metrics_text wall at "
+                         "the largest scrape size (seconds)")
     ap.add_argument("--out", default=None,
                     help="JSON path (default: BENCH_CONTROL_PLANE.json at "
                          "the repo root; quick mode writes only if given)")
@@ -356,14 +454,20 @@ def main(argv=None) -> int:
     logging.disable(logging.WARNING)
     if args.quick:
         args.sizes = "1000"
+        args.scrape_sizes = "1000"
         args.churn_window = min(args.churn_window, 600)
     sizes = [int(s) for s in args.sizes.split(",") if s]
+    scrape_sizes = [int(s) for s in args.scrape_sizes.split(",") if s]
     floor = args.assert_speedup
     if floor is None:
         floor = 2.0 if args.quick else 4.0
 
     t0 = time.perf_counter()
     curve = [measure_size(n, args) for n in sizes]
+    scrape_curve = [scrape_size(n, args) for n in scrape_sizes]
+    scrape_top = scrape_curve[-1]
+    scrape_ok = (scrape_top["aggregated"]["seconds"]
+                 <= args.assert_scrape_s)
     top = curve[-1]
     result = {
         "bench": "control_plane",
@@ -371,12 +475,17 @@ def main(argv=None) -> int:
         "workers": args.workers,
         "rtt_ms": args.rtt_ms,
         "curve": curve,
+        "scrape_sizes": scrape_sizes,
+        "scrape_curve": scrape_curve,
         "asserts": {
             "per_key_ordering": all(
                 p["ordering"]["max_same_key_concurrency"] <= 1
                 for p in curve),
             "speedup_floor": floor,
             "speedup_at_top": top["churn"]["speedup_vs_baseline"],
+            "scrape_wall_floor_s": args.assert_scrape_s,
+            "scrape_aggregated_s_at_top":
+                scrape_top["aggregated"]["seconds"],
         },
         "wall_s": round(time.perf_counter() - t0, 1),
     }
@@ -391,12 +500,16 @@ def main(argv=None) -> int:
         print("wrote %s" % out)
 
     ok = (result["asserts"]["per_key_ordering"]
-          and top["churn"]["speedup_vs_baseline"] >= floor)
+          and top["churn"]["speedup_vs_baseline"] >= floor
+          and scrape_ok)
     print("%s: %.2fx parallel-vs-baseline at %d jobs (floor %.1fx), "
-          "per-key ordering preserved=%s, %.0fs total"
+          "per-key ordering preserved=%s, aggregated scrape %.3fs at "
+          "%d jobs (floor %.1fs), %.0fs total"
           % ("PASS" if ok else "FAIL",
              top["churn"]["speedup_vs_baseline"], top["jobs"], floor,
-             result["asserts"]["per_key_ordering"], result["wall_s"]))
+             result["asserts"]["per_key_ordering"],
+             scrape_top["aggregated"]["seconds"], scrape_top["jobs"],
+             args.assert_scrape_s, result["wall_s"]))
     return 0 if ok else 1
 
 

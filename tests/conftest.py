@@ -84,6 +84,9 @@ _SLOW_TESTS = {
     # async-pipeline equivalence: compiles the single-step, fused-window
     # AND tail programs back to back
     "test_runner_windowed_prefetch_matches_inline",
+    # the compressed-week chaos soak (multi-thousand-tick harness run);
+    # `make fleetweek` / `make chaos` cover the fast lanes
+    "test_fleet_week_quick_soak_clean",
 }
 
 

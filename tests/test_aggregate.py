@@ -1,0 +1,580 @@
+"""The fleet aggregation tier (obs/aggregate.py), aggregated-mode
+exposition, size-capped snapshot APIs, the out-of-lock render contract,
+and the obs_report TraceIndex (ISSUE 18).
+
+The property the tier lives or dies on: every rollup family must equal
+the fold of the per-job truth it aggregates — across phase transitions,
+restarts, charges, and forget churn, in both detail and aggregated
+modes. These tests script deterministic lifecycles on a fake clock and
+assert that equality at every step, the same invariant the fleet_week
+chaos soak audits per tick.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from paddle_operator_tpu.obs import JobMetrics, parse_exposition
+from paddle_operator_tpu.obs import ledger as ledger_mod
+from paddle_operator_tpu.obs import metrics as metrics_mod
+from paddle_operator_tpu.obs.incidents import IncidentRegistry
+from paddle_operator_tpu.obs.ledger import GOODPUT, GoodputLedger
+
+sys.path.insert(0, "scripts")  # tests/conftest.py puts repo root first
+from obs_report import (  # noqa: E402
+    _INDEX_CACHE, TraceIndex, trace_index,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _fold_jobs(jm, jobs):
+    """The per-job truth: every live job's ledger snapshot summed into
+    bucket -> seconds (open segments folded at the ledger's own clock,
+    which the fake clock holds still during assertions)."""
+    totals = {}
+    for ns, name in jobs:
+        snap = jm.ledger.snapshot(ns, name)
+        totals[GOODPUT] = totals.get(GOODPUT, 0.0) + snap["goodput"]
+        for cause, s in snap["badput"].items():
+            totals[cause] = totals.get(cause, 0.0) + s
+    return totals
+
+
+def _assert_rollup_equals_fold(jm, jobs, retired):
+    fleet = jm.aggregate.fleet_totals(now=jm.ledger._clock())
+    expect = _fold_jobs(jm, jobs)
+    for bucket, s in retired.items():
+        expect[bucket] = expect.get(bucket, 0.0) + s
+    for bucket in set(fleet) | set(expect):
+        assert abs(fleet.get(bucket, 0.0) - expect.get(bucket, 0.0)) \
+            < 1e-6, (bucket, fleet, expect)
+
+
+# ---------------------------------------------------------------------------
+# rollup == fold(per-job truth), across the whole lifecycle vocabulary
+# ---------------------------------------------------------------------------
+
+class TestRollupEquivalence:
+    def test_fleet_rollup_tracks_per_job_fold(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        jobs = [("d", "j%d" % i) for i in range(6)]
+        for i, (ns, name) in enumerate(jobs):
+            jm.set_tenant(ns, name, "team-%d" % (i % 3))
+            jm.observe_phase(ns, name, "Pending")
+        _assert_rollup_equals_fold(jm, jobs, {})
+        clock.advance(2)
+        for ns, name in jobs[:4]:
+            jm.observe_phase(ns, name, "Running")
+        clock.advance(5)
+        _assert_rollup_equals_fold(jm, jobs, {})
+        # a drain cycle, a restart, a worker-attributed charge
+        jm.observe_drain("d", "j0")
+        jm.observe_phase("d", "j0", "Pending")
+        clock.advance(3)
+        jm.observe_phase("d", "j0", "Running")
+        jm.observe_restart("d", "j1", "preemption")
+        clock.advance(1)
+        jm.observe_phase("d", "j1", "Running")
+        jm.ledger.charge("d", "j2", "data_stall", 1.5)
+        _assert_rollup_equals_fold(jm, jobs, {})
+        # terminal + still-open jobs mixed
+        clock.advance(4)
+        jm.observe_phase("d", "j3", "Completed")
+        clock.advance(2)
+        _assert_rollup_equals_fold(jm, jobs, {})
+
+    def test_tenant_rollup_equals_fold_by_tenant(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        jobs = [("d", "j%d" % i) for i in range(4)]
+        for i, (ns, name) in enumerate(jobs):
+            jm.set_tenant(ns, name, "team-%d" % (i % 2))
+            jm.observe_phase(ns, name, "Pending")
+            clock.advance(1)
+            jm.observe_phase(ns, name, "Running")
+        jm.observe_drain("d", "j1")
+        jm.observe_phase("d", "j1", "Pending")
+        clock.advance(3)
+        jm.observe_phase("d", "j1", "Running")
+        clock.advance(2)
+        by_tenant = jm.aggregate.tenant_totals(now=clock.t)
+        for tenant, members in (("team-0", [("d", "j0"), ("d", "j2")]),
+                                ("team-1", [("d", "j1"), ("d", "j3")])):
+            expect = _fold_jobs(jm, members)
+            got = by_tenant[tenant]
+            for bucket in set(got) | set(expect):
+                assert abs(got.get(bucket, 0.0)
+                           - expect.get(bucket, 0.0)) < 1e-6, \
+                    (tenant, bucket, got, expect)
+
+    def test_set_tenant_migrates_banked_and_open_contributions(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        jm.observe_phase("d", "j0", "Pending")
+        clock.advance(2)
+        jm.observe_phase("d", "j0", "Running")
+        clock.advance(3)
+        # re-attributed mid-flight: the namespace-default tenant's label
+        # must vanish, and the new tenant must carry the WHOLE history
+        jm.set_tenant("d", "j0", "team-x")
+        clock.advance(1)
+        by_tenant = jm.aggregate.tenant_totals(now=clock.t)
+        assert "d" not in by_tenant
+        expect = _fold_jobs(jm, [("d", "j0")])
+        for bucket in set(expect):
+            assert abs(by_tenant["team-x"].get(bucket, 0.0)
+                       - expect[bucket]) < 1e-6
+
+    def test_phase_population_matches_state_set(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        for i in range(5):
+            jm.observe_phase("d", "j%d" % i, "Pending")
+        for i in range(3):
+            jm.observe_phase("d", "j%d" % i, "Running")
+        jm.observe_phase("d", "j0", "Completed")
+        assert jm.aggregate.phase_population() == {
+            "Pending": 2, "Running": 2, "Completed": 1}
+        jm.forget_job("d", "j4")
+        assert jm.aggregate.phase_population() == {
+            "Pending": 1, "Running": 2, "Completed": 1}
+
+    def test_mttr_rollup_matches_closed_incident_fold(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        for i, cause in enumerate(("drain", "drain", "preemption")):
+            jm.observe_phase("d", "r%d" % i, "Running")
+            jm.incidents.open("d", "r%d" % i, cause)
+            clock.advance(2 + i)
+            jm.incidents.close("d", "r%d" % i, resolved=(i != 1))
+        expect = {}
+        for rec in jm.incidents.closed_incidents():
+            s, n = expect.get(rec["cause"], (0.0, 0))
+            expect[rec["cause"]] = (s + rec["total_s"], n + 1)
+        got = jm.aggregate.mttr_totals()
+        assert set(got) == set(expect)
+        for cause, (s, n) in expect.items():
+            assert got[cause][1] == n
+            assert abs(got[cause][0] - s) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# forget churn: fleet counters retain, tenant labels drop
+# ---------------------------------------------------------------------------
+
+class TestForgetChurn:
+    def test_forget_retains_fleet_and_drops_tenant(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        for name in ("a", "b"):
+            jm.set_tenant("d", name, "solo-team")
+            jm.observe_phase("d", name, "Running")
+        clock.advance(5)
+        jm.observe_phase("d", "a", "Completed")
+        before = jm.aggregate.fleet_totals(now=clock.t)
+        jm.forget_job("d", "a")
+        after = jm.aggregate.fleet_totals(now=clock.t)
+        for bucket in set(before) | set(after):
+            assert abs(before.get(bucket, 0.0)
+                       - after.get(bucket, 0.0)) < 1e-6
+        assert jm.aggregate.tenant_count() == 1
+        jm.observe_phase("d", "b", "Completed")
+        jm.forget_job("d", "b")
+        # the last job gone: the tenant label itself must vanish, but
+        # the fleet's lifetime counters keep the whole history
+        assert jm.aggregate.tenant_count() == 0
+        assert jm.aggregate.job_count() == 0
+        final = jm.aggregate.fleet_totals(now=clock.t)
+        assert final.get(GOODPUT, 0.0) == pytest.approx(10.0)
+        text = jm.aggregate.metrics_block(now=clock.t)
+        assert "tpujob_tenant_jobs" not in text
+        assert "tpujob_tenant_goodput_ratio" not in text
+
+    def test_25_job_churn_conserves_rollups(self):
+        """Satellite: waves of 25 jobs created, run, completed, and
+        forgotten — the fleet counters must equal the accumulated truth
+        at every wave boundary and no stale tenant label may survive."""
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        retired = {}
+        for wave in range(5):
+            jobs = [("d", "w%dj%d" % (wave, i)) for i in range(5)]
+            tenant = "wave-%d" % wave
+            for ns, name in jobs:
+                jm.set_tenant(ns, name, tenant)
+                jm.observe_phase(ns, name, "Pending")
+            clock.advance(1 + wave)
+            for ns, name in jobs:
+                jm.observe_phase(ns, name, "Running")
+            if wave % 2 == 0:
+                jm.observe_drain(*jobs[0])
+                jm.observe_phase(jobs[0][0], jobs[0][1], "Pending")
+                clock.advance(2)
+                jm.observe_phase(jobs[0][0], jobs[0][1], "Running")
+            clock.advance(3)
+            _assert_rollup_equals_fold(jm, jobs, retired)
+            for ns, name in jobs:
+                jm.observe_phase(ns, name, "Completed")
+                snap = jm.ledger.snapshot(ns, name)
+                retired[GOODPUT] = retired.get(GOODPUT, 0.0) \
+                    + snap["goodput"]
+                for cause, s in snap["badput"].items():
+                    retired[cause] = retired.get(cause, 0.0) + s
+                jm.forget_job(ns, name)
+            _assert_rollup_equals_fold(jm, [], retired)
+            live_tenants = set()  # everything forgotten each wave
+            block = jm.aggregate.metrics_block(now=clock.t)
+            for line in block.splitlines():
+                if line.startswith("tpujob_tenant_jobs{"):
+                    live_tenants.add(line)
+            assert not live_tenants, live_tenants
+        assert jm.aggregate.job_count() == 0
+        assert jm.aggregate.tenant_count() == 0
+        # 25 jobs retired: the lifetime counters ARE the history
+        fleet = jm.aggregate.fleet_totals(now=clock.t)
+        for bucket in set(fleet) | set(retired):
+            assert abs(fleet.get(bucket, 0.0)
+                       - retired.get(bucket, 0.0)) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# the detail -> aggregated mode switch and the top-K exemplar set
+# ---------------------------------------------------------------------------
+
+class TestAggregatedMode:
+    def _feed(self, jm, clock, n, badput=()):
+        for i in range(n):
+            name = "m%02d" % i
+            jm.set_tenant("d", name, "team-%d" % (i % 2))
+            jm.observe_phase("d", name, "Pending")
+            clock.advance(0.5)
+            jm.observe_phase("d", name, "Running")
+        for name in badput:
+            jm.observe_drain("d", name)
+            jm.observe_phase("d", name, "Pending")
+            clock.advance(1)
+            jm.observe_phase("d", name, "Running")
+        clock.advance(2)
+
+    def test_below_threshold_stays_fully_detailed(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock, detail_jobs=5, top_k=2)
+        self._feed(jm, clock, 4)
+        text = jm.metrics_block() + "\n"
+        assert parse_exposition(text) == []
+        for i in range(4):
+            assert 'job="d/m%02d"' % i in text
+        # the ledger (not the aggregator) carries the fleet ratio, once
+        samples = [ln for ln in text.splitlines()
+                   if ln.startswith("tpujob_fleet_goodput_ratio ")]
+        assert len(samples) == 1
+        # the rollup families render in BOTH modes
+        assert "# TYPE tpujob_fleet_goodput_seconds_total" in text
+        assert "# TYPE tpujob_tenant_goodput_ratio" in text
+
+    def test_above_threshold_keeps_only_topk_exemplars(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock, detail_jobs=5, top_k=2)
+        self._feed(jm, clock, 8, badput=("m06", "m07"))
+        text = jm.metrics_block() + "\n"
+        assert parse_exposition(text) == []
+        present = {("d/m%02d" % i) for i in range(8)
+                   if 'job="d/m%02d"' % i in text}
+        assert present == {"d/m06", "d/m07"}, present
+        samples = [ln for ln in text.splitlines()
+                   if ln.startswith("tpujob_fleet_goodput_ratio ")]
+        assert len(samples) == 1
+        for fam in ("tpujob_fleet_goodput_seconds_total",
+                    "tpujob_fleet_badput_seconds_total",
+                    "tpujob_tenant_jobs",
+                    "tpujob_tenant_goodput_ratio",
+                    "tpujob_job_phase_population"):
+            assert "# TYPE %s" % fam in text, fam
+
+    def test_slo_source_collapses_to_one_fleet_sample(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock, detail_jobs=5, top_k=2)
+        self._feed(jm, clock, 8, badput=("m00",))
+        samples = jm.slo_goodput_samples()
+        assert len(samples) == 1
+        totals = jm.aggregate.fleet_totals(now=clock.t)
+        wall = sum(totals.values())
+        assert samples[0] == pytest.approx(
+            totals.get(GOODPUT, 0.0) / wall)
+        # back under the threshold (churn) -> per-job samples again
+        for i in range(4):
+            jm.forget_job("d", "m%02d" % i)
+        assert len(jm.slo_goodput_samples()) == 4
+
+    def test_top_badput_matches_full_rescan_semantics(self):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock)
+        jobs = [("d", "t%d" % i) for i in range(10)]
+        for ns, name in jobs:
+            jm.observe_phase(ns, name, "Running")
+        # distinct badput weights on four jobs (t3 < t5 < t7 < t8)
+        for dur, (ns, name) in zip((1, 2, 3, 4),
+                                   [jobs[3], jobs[5], jobs[7], jobs[8]]):
+            jm.observe_drain(ns, name)
+            jm.observe_phase(ns, name, "Pending")
+            clock.advance(dur)
+            jm.observe_phase(ns, name, "Running")
+        clock.advance(1)
+        # reference: the full per-job rescan the incremental score
+        # replaced — banked + open badput from each job's own snapshot
+        scored = {}
+        for ns, name in jobs:
+            bad = sum(jm.ledger.snapshot(ns, name)["badput"].values())
+            if bad > 0:
+                scored["%s/%s" % (ns, name)] = bad
+        top = sorted(scored, key=lambda k: (scored[k], k), reverse=True)
+        assert jm.aggregate.top_badput_jobs(2, now=clock.t) == set(top[:2])
+        assert jm.aggregate.top_badput_jobs(4, now=clock.t) == set(top)
+        # more slots than badput-bearing jobs: deterministic fill with
+        # the largest remaining keys (the old zero-score tie-break)
+        rest = sorted((("%s/%s" % (ns, name)) for ns, name in jobs
+                       if "%s/%s" % (ns, name) not in scored),
+                      reverse=True)
+        assert jm.aggregate.top_badput_jobs(6, now=clock.t) \
+            == set(top) | set(rest[:2])
+        # an OPEN badput stretch scores too (t0 pending right now)
+        jm.observe_drain("d", "t0")
+        jm.observe_phase("d", "t0", "Pending")
+        clock.advance(50)
+        assert "d/t0" in jm.aggregate.top_badput_jobs(1, now=clock.t)
+
+
+# ---------------------------------------------------------------------------
+# exposition cost contracts: render OUTSIDE the lock, O(1) clock reads
+# ---------------------------------------------------------------------------
+
+class TestExpositionContracts:
+    def _fleet(self, n, detail_jobs=0):
+        clock = FakeClock()
+        jm = JobMetrics(clock=clock, detail_jobs=detail_jobs, top_k=2)
+        for i in range(n):
+            jm.observe_phase("d", "x%03d" % i, "Pending")
+            clock.advance(0.25)
+            jm.observe_phase("d", "x%03d" % i, "Running")
+        clock.advance(1)
+        return jm, clock
+
+    def test_labels_escape_outside_every_metrics_lock(self, monkeypatch):
+        """The snapshot-then-render contract: label escaping happens
+        per output line, so if any escape call ever runs with a
+        collector's lock held, rendering moved back under the lock."""
+        jm, _clock = self._fleet(40)
+        held = []
+        for mod in (metrics_mod, ledger_mod):
+            real = mod.escape_label_value
+
+            def probe(v, _real=real):
+                held.append(jm._lock.locked()
+                            or jm.ledger._lock.locked()
+                            or jm.aggregate._lock.locked())
+                return _real(v)
+
+            monkeypatch.setattr(mod, "escape_label_value", probe)
+        text = jm.metrics_block()
+        assert held, "no labels rendered — fleet not fed?"
+        assert not any(held), \
+            "%d label escapes ran under a metrics lock" % sum(held)
+        assert parse_exposition(text + "\n") == []
+
+    def test_scrape_clock_reads_constant_in_fleet_size(self):
+        """The lock-hold regression guard: a scrape's clock reads (each
+        one taken under a lock in the pre-snapshot design) must not
+        scale with the fleet."""
+        reads = []
+        for n in (10, 100):
+            jm, clock = self._fleet(n, detail_jobs=5)
+            before = clock.calls
+            jm.metrics_block()
+            reads.append(clock.calls - before)
+        assert reads[0] == reads[1], reads
+        assert reads[0] <= 8, reads
+
+
+# ---------------------------------------------------------------------------
+# size-capped snapshot APIs
+# ---------------------------------------------------------------------------
+
+class TestSnapshotCaps:
+    def test_episode_log_limit(self):
+        clock = FakeClock()
+        led = GoodputLedger(clock=clock)
+        led.observe_phase("d", "j", "Running")
+        for i in range(4):
+            clock.advance(1)
+            led.note_incident("d", "j", "drain")
+            clock.advance(1)
+            led.observe_phase("d", "j", "Running")
+        full = led.episode_log()
+        assert len(full) == 4
+        assert led.episode_log(limit=2) == full[-2:]
+        assert led.episode_log(limit=0) == []
+        assert led.episode_log(limit=99) == full
+
+    def test_closed_incidents_limit(self):
+        clock = FakeClock()
+        reg = IncidentRegistry(clock=clock)
+        for i in range(3):
+            reg.open("d", "j%d" % i, "drain")
+            clock.advance(1)
+            reg.close("d", "j%d" % i)
+        full = reg.closed_incidents()
+        assert len(full) == 3
+        assert reg.closed_incidents(limit=1) == full[-1:]
+        assert reg.closed_incidents(limit=0) == []
+
+    def test_decision_entries_limit(self):
+        from paddle_operator_tpu.sched import FleetArbiter
+        arb = FleetArbiter(client=None)
+        for i in range(3):
+            arb.decision_log.append({"kind": "preempt", "seq": i})
+        full = arb.decision_entries()
+        assert [e["seq"] for e in full] == [0, 1, 2]
+        assert arb.decision_entries(limit=2) == full[-2:]
+        assert arb.decision_entries(limit=0) == []
+        # copies, never the live ring
+        arb.decision_entries()[0]["seq"] = 99
+        assert arb.decision_entries()[0]["seq"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the obs_report trace index
+# ---------------------------------------------------------------------------
+
+def _write_trace(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+class TestTraceIndex:
+    def _sample(self, tmp_path):
+        """A two-segment rotated trace spanning an operator restart."""
+        base = str(tmp_path / "trace.jsonl")
+        era0 = [
+            {"name": "clock_anchor", "t0": 1000.0, "m0": 50.0},
+            {"name": "ledger_segment", "t0": 0.0, "m0": 51.0,
+             "attrs": {"job": "d/j1", "cause": "goodput", "dur_s": 1.0,
+                       "total_s": 1.0}},
+            {"name": "mfu_sample", "t0": 0.0, "m0": 52.0,
+             "attrs": {"job": "d/j1", "mfu": 0.4}},
+            {"name": "incident_open", "t0": 0.0, "m0": 53.0,
+             "attrs": {"job": "d/j1", "incident": "i1",
+                       "cause": "drain"}},
+            {"name": "sched_feedback", "t0": 0.0, "m0": 54.0,
+             "attrs": {"job": "d/j2", "action": "victim"}},
+        ]
+        era1 = [
+            {"name": "operator_restart", "t0": 0.0, "m0": 60.0,
+             "attrs": {"tick": 7}},
+            {"name": "ledger_charge", "t0": 0.0, "m0": 61.0,
+             "attrs": {"job": "d/j2", "cause": "data_stall", "s": 0.5,
+                       "total_s": 0.5}},
+            {"name": "ledger_episode", "t0": 0.0, "m0": 62.0,
+             "attrs": {"job": "d/j1", "incident": "i1",
+                       "cause": "drain", "badput_s": 2.0}},
+            {"name": "hardware_block", "t0": 0.0, "m0": 63.0,
+             "attrs": {"job": "d/j1", "steps": 4}},
+            # span-style bare job name (no namespace in attrs)
+            {"name": "coordination", "t0": 0.0, "m0": 64.0,
+             "attrs": {"job": "j2"}},
+        ]
+        # oldest rotated segment holds era 0; the live file era 1
+        _write_trace(base + ".1", era0)
+        _write_trace(base, era1)
+        with open(base, "a") as f:
+            f.write("{ truncated mid-crash\n")
+        return base
+
+    def test_lanes_and_maps(self, tmp_path):
+        base = self._sample(tmp_path)
+        idx = TraceIndex(base)
+        # 5 era-0 + 5 era-1 records; the truncated mid-crash line skipped
+        assert idx.records_total == 10
+        lanes = {n: [r["name"] for r in idx.lane(n)]
+                 for n in TraceIndex.LANE_NAMES}
+        assert lanes["ledger"] == ["ledger_segment", "ledger_charge"]
+        assert lanes["incident"] == ["incident_open", "operator_restart",
+                                     "ledger_episode"]
+        assert lanes["hardware"] == ["mfu_sample", "hardware_block"]
+        assert lanes["decision"] == ["sched_feedback"]
+        assert set(idx.by_job) == {"d/j1", "d/j2", "j2"}
+        assert [r["name"] for r in idx.read(idx.by_incident["i1"])] \
+            == ["incident_open", "ledger_episode"]
+
+    def test_read_applies_clock_anchor(self, tmp_path):
+        idx = TraceIndex(self._sample(tmp_path))
+        seg = idx.lane("ledger")[0]
+        # anchor: wall 1000.0 at mono 50.0; the segment's m0 is 51.0
+        assert seg["t0"] == pytest.approx(1001.0)
+
+    def test_eras_split_at_restart_marker(self, tmp_path):
+        idx = TraceIndex(self._sample(tmp_path))
+        eras = idx.eras(idx.lanes["ledger"])
+        assert len(eras) == 2
+        assert [r["name"] for r in idx.read(eras[0])] == ["ledger_segment"]
+        assert [r["name"] for r in idx.read(eras[1])] == ["ledger_charge"]
+
+    def test_job_offsets_match_by_job(self, tmp_path):
+        idx = TraceIndex(self._sample(tmp_path))
+        names = [r["name"] for r in idx.read(idx.job_offsets("d/j1"))]
+        assert names == ["ledger_segment", "mfu_sample", "incident_open",
+                         "ledger_episode", "hardware_block"]
+        # bare trace keys (span attrs with no namespace) match a
+        # namespaced wanted by name — the full-scan --job filter's rule
+        names = [r["name"] for r in idx.read(idx.job_offsets("d/j2"))]
+        assert names == ["sched_feedback", "ledger_charge",
+                         "coordination"]
+
+    def test_index_cache_keys_on_segment_sizes(self, tmp_path):
+        base = self._sample(tmp_path)
+        try:
+            first = trace_index(base)
+            assert trace_index(base) is first  # unchanged -> cache hit
+            with open(base, "a") as f:
+                f.write(json.dumps({"name": "mfu_sample", "t0": 0.0,
+                                    "m0": 70.0,
+                                    "attrs": {"job": "d/j3"}}) + "\n")
+            rebuilt = trace_index(base)
+            assert rebuilt is not first
+            assert "d/j3" in rebuilt.by_job
+        finally:
+            _INDEX_CACHE.pop(base, None)
+
+
+# ---------------------------------------------------------------------------
+# the fleet_week soak (quick, one seed) — the tier's end-to-end proof
+# ---------------------------------------------------------------------------
+
+def test_fleet_week_quick_soak_clean():
+    """One compressed week on the harness clock: conservation, MTTR-
+    equals-episode, and rollup-vs-truth audited at every tick (the
+    multi-seed sweep is `make chaos`; the trace reconstruction lane is
+    `make fleetweek`)."""
+    from paddle_operator_tpu.chaos import run_scenario
+
+    report = run_scenario("fleet_week", 0, quick=True)
+    assert report.violations == []
+    assert report.extra.get("rollup_audits", 0) > 0
+    assert report.extra.get("gc_deleted", 0) > 0
+    assert any(k.startswith("rollup_") and k.endswith("_s")
+               for k in report.extra)
