@@ -68,6 +68,7 @@ race:
 	  tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
 	  tests/test_observability.py tests/test_ops9xx.py \
+	  tests/test_ops10xx.py \
 	  tests/test_reconciler.py \
 	  tests/test_recovery.py tests/test_runtime_edge.py \
 	  tests/test_scale_stress.py tests/test_sched.py \
@@ -188,8 +189,8 @@ artifacts:
 #   `python scripts/perf_serving.py` with no flags
 serve:
 	$(PY) -m pytest tests/test_serving.py -x -q -m "not slow"
-	$(PY) scripts/chaos_stress.py --scenario serving_brownout --seeds 1 \
-	  --quick
+	env TPUJOB_LEAK_TRACK=1 $(PY) scripts/chaos_stress.py \
+	  --scenario serving_brownout --seeds 1 --quick
 	$(PY) scripts/perf_serving.py --quick
 
 bench:

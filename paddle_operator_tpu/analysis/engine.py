@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from . import dataflow, ops6xx, ops7xx, ops8xx, ops9xx, opslint
+from . import dataflow, ops6xx, ops7xx, ops8xx, ops9xx, ops10xx, opslint
 from .opslint import Finding
 
 # the complete rule catalog across every family (docs/static-analysis.md)
@@ -32,18 +32,21 @@ ALL_RULES.update(ops6xx.RULES)
 ALL_RULES.update(ops7xx.RULES)
 ALL_RULES.update(ops8xx.RULES)
 ALL_RULES.update(ops9xx.RULES)
+ALL_RULES.update(ops10xx.RULES)
 
 # rule id -> family label for the machine-readable report
 def family_of(rule: str) -> str:
     if rule in ops6xx.RULES or rule in ops7xx.RULES \
-            or rule in ops8xx.RULES or rule in ops9xx.RULES:
+            or rule in ops8xx.RULES or rule in ops9xx.RULES \
+            or rule in ops10xx.RULES:
         return "dataflow"
     return "opslint"
 
 
 def dataflow_passes() -> List[dataflow.DataflowPass]:
     return (ops6xx.make_passes() + ops7xx.make_passes()
-            + ops8xx.make_passes() + ops9xx.make_passes())
+            + ops8xx.make_passes() + ops9xx.make_passes()
+            + ops10xx.make_passes())
 
 
 def run_all(paths: Sequence[str], root: Optional[str] = None,

@@ -1,0 +1,356 @@
+"""Runtime resource-leak tracking: the dynamic half of OPS10xx.
+
+:func:`install` wraps the acquire/release pairs that
+:mod:`.resources` declares ``runtime=True`` — compile leases, KV block
+reservations, queue slots, file handles, thread lifecycles — recording
+a **creation site** per live resource (racedetect pattern: the first
+project frame above the wrapper, so fingerprints are stable
+``path:line`` labels, directly comparable to the static OPS1001
+finding for the same acquire). At report time anything still held is a
+leak: the conftest session hook (``TPUJOB_LEAK_TRACK=1``, wired into
+``make race``'s sibling lanes) fails the run, and the
+``serving_brownout`` chaos lane joins the census into its
+deterministic fingerprint so a drain/rejoin cycle that starts leaking
+flips the invariant hash.
+
+Tracking semantics per spec:
+
+* ``compile_lease`` — tracked iff ``lease.granted``; ``release()``
+  untracks (idempotent, like the release itself).
+* ``kv_blocks`` — keyed ``(allocator id, seq_id)``; ``free_sequence``
+  untracks (idempotent free is a documented no-op). Tracked only when
+  the acquire comes from a package frame: the conservation contract
+  binds the serving plane, not a test body holding the allocator
+  directly (racedetect's created-from-project-frames scoping, one
+  notch tighter).
+* ``queue_slot`` — keyed by ``request_id`` at ``RequestQueue.pop``,
+  package frames only (same rationale); retired by ``requeue_front``,
+  a terminal ``ServeMetrics.observe_request``, or — probe-wise — the
+  request making progress (tokens generated / ``t_done`` stamped): a
+  metrics-less batcher completing a request consumed its slot. The
+  leak class this keeps is precisely the lost slot: popped, then
+  neither stepped, requeued, nor counted.
+* ``file_handle`` — builtin ``open`` from project frames only, held by
+  weakref; leaked iff still alive AND not ``closed`` at report.
+* ``thread_lifecycle`` — ``Thread.start`` from project frames; leaked
+  iff still ``is_alive()`` and not a daemon at report (fire-and-forget
+  daemons are idiomatic; abandoned foreground threads are the PR 17
+  drain-path class).
+
+An import-time cross-check asserts every ``runtime=True`` spec has a
+tracker here — extending the table without extending the checker fails
+loudly in-suite, the OPS001 self-audit posture at runtime.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+import sys
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .racedetect import _is_project_frame, _creation_site, _site_label
+from .resources import runtime_specs
+
+#: spec name -> tracker note; the import-time cross-check below asserts
+#: this covers every runtime=True spec in resources.SPECS.
+_TRACKERS: Dict[str, str] = {
+    "compile_lease": "ArtifactStore.acquire_compile_lease / "
+                     "CompileLease.release",
+    "file_handle": "builtins.open (project frames, weakref)",
+    "kv_blocks": "KvBlockAllocator.alloc_sequence / free_sequence",
+    "queue_slot": "RequestQueue.pop / requeue_front / "
+                  "ServeMetrics.observe_request",
+    "thread_lifecycle": "threading.Thread.start / join",
+}
+
+_missing = [s.name for s in runtime_specs() if s.name not in _TRACKERS]
+if _missing:  # pragma: no cover - tripped only by a stale table
+    raise RuntimeError(
+        "resources.SPECS declares runtime=True for %s but leaktrack has "
+        "no tracker — extend _TRACKERS and the patch set together"
+        % ", ".join(_missing))
+
+
+@dataclass
+class _Live:
+    spec: str
+    key: Tuple[Any, ...]
+    site: Tuple[str, int]
+    #: optional liveness probe: returns False once the resource is no
+    #: longer actually held (closed file, finished thread) even though
+    #: nothing untracked it explicitly.
+    probe: Optional[Callable[[], bool]] = None
+
+    @property
+    def label(self) -> str:
+        return _site_label(self.site)
+
+
+class Registry:
+    """Live-resource table. One module-level instance backs the test
+    session; chaos lanes install a private one so their census stays
+    per-scenario."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._live: Dict[Tuple[str, Tuple[Any, ...]], _Live] = {}
+        self._acquired: Dict[str, int] = {}
+
+    def track(self, spec: str, key: Tuple[Any, ...],
+              site: Tuple[str, int],
+              probe: Optional[Callable[[], bool]] = None) -> None:
+        with self._mu:
+            self._acquired[spec] = self._acquired.get(spec, 0) + 1
+            self._live[(spec, key)] = _Live(spec, key, site, probe)
+
+    def untrack(self, spec: str, key: Tuple[Any, ...]) -> None:
+        with self._mu:
+            self._live.pop((spec, key), None)  # idempotent by design
+
+    def live(self) -> List[_Live]:
+        with self._mu:
+            records = list(self._live.values())
+        out = []
+        for rec in records:
+            if rec.probe is not None and not rec.probe():
+                continue
+            out.append(rec)
+        return out
+
+    def census(self) -> Dict[str, Dict[str, int]]:
+        """Deterministic counts per spec: total acquires + still-live.
+        Joins the chaos fingerprint, so keys/order must be stable."""
+        live_counts: Dict[str, int] = {}
+        for rec in self.live():
+            live_counts[rec.spec] = live_counts.get(rec.spec, 0) + 1
+        with self._mu:
+            acquired = dict(self._acquired)
+        return {
+            spec: {"acquired": acquired.get(spec, 0),
+                   "live": live_counts.get(spec, 0)}
+            for spec in sorted(set(acquired) | set(live_counts))
+        }
+
+
+_registry = Registry()
+_installed = False
+_saved: List[Tuple[Any, str, Any]] = []
+
+
+def _site_above_leaktrack() -> Tuple[str, int]:
+    """First frame outside this module (and the patched callable's own
+    module): the project acquire site whose label must match the static
+    finding's ``path:line``."""
+    here = __file__
+    depth = 2
+    while True:
+        try:
+            frame = sys._getframe(depth)
+        except ValueError:
+            return _creation_site(2)
+        if frame.f_code.co_filename != here:
+            return (frame.f_code.co_filename, frame.f_lineno)
+        depth += 1
+
+
+def _package_site(site: Tuple[str, int]) -> bool:
+    """True when the acquire happened inside the package itself —
+    the serving/compile planes whose conservation contracts the
+    kv_blocks/queue_slot trackers enforce."""
+    return (os.sep + "paddle_operator_tpu" + os.sep) in site[0]
+
+
+def _patch(obj: Any, name: str, wrapper_factory: Callable[[Any], Any]
+           ) -> None:
+    original = getattr(obj, name)
+    _saved.append((obj, name, original))
+    setattr(obj, name, wrapper_factory(original))
+
+
+def install(registry: Optional[Registry] = None) -> Registry:
+    """Instrument every runtime=True spec'd pair. Idempotent; returns
+    the active registry. Call before the package under test creates
+    resources (conftest installs at import, like racedetect)."""
+    global _registry, _installed
+    if registry is not None:
+        _registry = registry
+    if _installed:
+        return _registry
+    _installed = True
+    reg = lambda: _registry  # late-bound: chaos lanes can swap it
+
+    # -- compile leases --------------------------------------------------
+    from ..artifacts import store as _store
+
+    def _wrap_acquire_lease(fn: Any) -> Any:
+        def acquire_compile_lease(self: Any, fingerprint: str) -> Any:
+            site = _site_above_leaktrack()
+            lease = fn(self, fingerprint)
+            if getattr(lease, "granted", False):
+                reg().track("compile_lease", (id(lease),), site)
+            return lease
+        return acquire_compile_lease
+
+    def _wrap_lease_release(fn: Any) -> Any:
+        def release(self: Any) -> None:
+            reg().untrack("compile_lease", (id(self),))
+            return fn(self)
+        return release
+
+    _patch(_store.ArtifactStore, "acquire_compile_lease",
+           _wrap_acquire_lease)
+    _patch(_store.CompileLease, "release", _wrap_lease_release)
+
+    # -- KV block reservations -------------------------------------------
+    from ..serving import kv_cache as _kv
+
+    def _wrap_alloc(fn: Any) -> Any:
+        def alloc_sequence(self: Any, seq_id: str, *args: Any,
+                           **kwargs: Any) -> Any:
+            site = _site_above_leaktrack()
+            out = fn(self, seq_id, *args, **kwargs)
+            if _package_site(site):
+                reg().track("kv_blocks", (id(self), seq_id), site)
+            return out
+        return alloc_sequence
+
+    def _wrap_free(fn: Any) -> Any:
+        def free_sequence(self: Any, seq_id: str) -> Any:
+            reg().untrack("kv_blocks", (id(self), seq_id))
+            return fn(self, seq_id)
+        return free_sequence
+
+    _patch(_kv.KvBlockAllocator, "alloc_sequence", _wrap_alloc)
+    _patch(_kv.KvBlockAllocator, "free_sequence", _wrap_free)
+
+    # -- queue slots -----------------------------------------------------
+    from ..serving import batching as _batching
+    from ..serving import metrics as _metrics
+
+    def _wrap_pop(fn: Any) -> Any:
+        def pop(self: Any) -> Any:
+            site = _site_above_leaktrack()
+            req = fn(self)
+            if req is not None and _package_site(site):
+
+                def unstepped(r: Any = req) -> bool:
+                    # progress consumes the slot: a completed (or even
+                    # partially decoded) request is in the batcher's
+                    # hands, not lost — the leak class is the popped
+                    # request that never went anywhere
+                    return r.t_done == 0.0 and not r.generated
+
+                reg().track("queue_slot", (req.request_id,), site,
+                            probe=unstepped)
+            return req
+        return pop
+
+    def _wrap_requeue(fn: Any) -> Any:
+        def requeue_front(self: Any, reqs: Any) -> Any:
+            for req in reqs:
+                reg().untrack("queue_slot", (req.request_id,))
+            return fn(self, reqs)
+        return requeue_front
+
+    def _wrap_observe(fn: Any) -> Any:
+        def observe_request(self: Any, req: Any, outcome: str = "ok"
+                            ) -> None:
+            reg().untrack("queue_slot", (req.request_id,))
+            return fn(self, req, outcome=outcome)
+        return observe_request
+
+    _patch(_batching.RequestQueue, "pop", _wrap_pop)
+    _patch(_batching.RequestQueue, "requeue_front", _wrap_requeue)
+    _patch(_metrics.ServeMetrics, "observe_request", _wrap_observe)
+
+    # -- file handles ----------------------------------------------------
+    _real_open = builtins.open
+
+    def _tracking_open(*args: Any, **kwargs: Any) -> Any:
+        fh = _real_open(*args, **kwargs)
+        if _is_project_frame(2):
+            site = _site_above_leaktrack()
+            ref = weakref.ref(fh)
+
+            def still_open() -> bool:
+                obj = ref()
+                return obj is not None and not obj.closed
+
+            reg().track("file_handle", (id(fh),), site, probe=still_open)
+        return fh
+
+    _saved.append((builtins, "open", _real_open))
+    builtins.open = _tracking_open
+
+    # -- thread lifecycles -----------------------------------------------
+    def _wrap_start(fn: Any) -> Any:
+        def start(self: Any) -> None:
+            if _is_project_frame(2):
+                site = _site_above_leaktrack()
+                ref = weakref.ref(self)
+
+                def abandoned() -> bool:
+                    t = ref()
+                    return (t is not None and t.is_alive()
+                            and not t.daemon)
+
+                reg().track("thread_lifecycle", (id(self),), site,
+                            probe=abandoned)
+            return fn(self)
+        return start
+
+    def _wrap_join(fn: Any) -> Any:
+        def join(self: Any, timeout: Optional[float] = None) -> None:
+            fn(self, timeout)
+            if not self.is_alive():
+                reg().untrack("thread_lifecycle", (id(self),))
+        return join
+
+    _patch(threading.Thread, "start", _wrap_start)
+    _patch(threading.Thread, "join", _wrap_join)
+
+    return _registry
+
+
+def uninstall() -> None:
+    global _installed
+    while _saved:
+        obj, name, original = _saved.pop()
+        setattr(obj, name, original)
+    _installed = False
+
+
+class LeakReport:
+    def __init__(self, live: List[_Live],
+                 census: Dict[str, Dict[str, int]]):
+        self.live = sorted(live, key=lambda r: (r.spec, r.label))
+        self.census = census
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.live)
+
+    def render(self) -> str:
+        lines = []
+        if not self.live:
+            lines.append("leak tracker: no unreleased resources")
+        else:
+            lines.append("leak tracker: %d unreleased resource(s):"
+                         % len(self.live))
+            for rec in self.live:
+                lines.append("  LEAK %-16s acquired at %s"
+                             % (rec.spec, rec.label))
+        for spec in sorted(self.census):
+            c = self.census[spec]
+            lines.append("  census %-16s acquired=%d live=%d"
+                         % (spec, c["acquired"], c["live"]))
+        return "\n".join(lines)
+
+
+def leak_report(registry: Optional[Registry] = None) -> LeakReport:
+    reg = registry if registry is not None else _registry
+    return LeakReport(reg.live(), reg.census())

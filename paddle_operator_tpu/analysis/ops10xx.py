@@ -1,0 +1,1573 @@
+"""OPS10xx — interprocedural resource-lifecycle & exception-path analysis.
+
+The bug class that kept escaping to human review is the resource leak
+on an exception path: the PR 15 compile-lease leak (an exception
+escaping ``jit/lower`` after the grant left every peer waiting out the
+TTL) was caught only in review hardening, and the serving plane added
+three fresh leak surfaces (KV blocks, queue slots, drain-path threads)
+with zero static coverage. These passes make the class statically
+visible: every acquire/release pair is declared once in
+:mod:`.resources` (the guards.py pattern — the same table drives the
+runtime :mod:`.leaktrack`), and a per-function forward flow tracks the
+abstract resource through held/released/escaped with ``with`` /
+``try-finally`` scoping and exception-edge simulation — every call
+that may raise is a path that must still reach a release, a consuming
+handler, or an ownership escape. Interprocedural summaries recognize
+ownership transfer (resource returned — including a tuple element, the
+``_fleet_rung`` shape — or stored on ``self``) and helpers that
+release a parameter on every path discharge the obligation at call
+sites.
+
+Rules:
+
+* **OPS1001 leak-on-exception-path** — a held resource reaches a
+  may-raise site (or a normal exit, for ``leak_on_exit`` specs) with
+  no enclosing ``finally``/``with``/releasing-handler discharging it:
+  the PR 15 lease bug, statically.
+* **OPS1002 double-release** — a second release of the same resource
+  on one path; specs with a documented idempotent release
+  (``free_sequence``, ``CompileLease.release``) are exempt by flag.
+* **OPS1003 ownership-escape-while-held** — one path both escapes the
+  resource (returned / stored) and releases it: whoever received the
+  handle got a dead one (the classic store-then-``finally``-release).
+* **OPS1004 declared-never-raise-can-propagate** — a surface declared
+  "degrade, never raise" (:data:`.resources.NEVER_RAISE`: ledger
+  costing, compile-cache fallbacks) whose raise/call closure is not
+  empty — some raiser inside is not contained by a matching handler.
+
+Posture: conservative against false positives — unresolved receivers,
+merged branch states, and dynamically-typed handles contribute
+silence, never findings. Containers and pure builtins are assumed
+total (the raise closure targets I/O, parsing, and project-call
+propagation, not ``KeyError`` pedantry). Both declaration tables are
+staleness-audited into the OPS001 family exactly like guard specs and
+suppression pragmas.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from . import opslint, resources
+from .dataflow import (
+    _EXEMPT_LOCK_FUNCS, DataflowPass, FunctionInfo, ModuleInfo, Project,
+    _dotted,
+)
+from .opslint import Finding
+from .resources import NEVER_RAISE, SPECS, ResourceSpec
+
+RULES: Dict[str, Tuple[str, str]] = {
+    "OPS1001": (
+        "leak-on-exception-path",
+        "an acquired resource (declared in analysis/resources.py) can "
+        "escape its owner without release: an exception edge, dropped "
+        "handle, or normal exit reaches the function boundary while "
+        "the resource is held and no finally/with/releasing-handler "
+        "discharges it",
+    ),
+    "OPS1002": (
+        "double-release",
+        "the same resource is released twice on one path; specs whose "
+        "release is a documented no-op when repeated (idempotent flag) "
+        "are exempt",
+    ),
+    "OPS1003": (
+        "ownership-escape-while-held",
+        "one path both transfers the resource out (returned / stored "
+        "on self / container) and releases it — the receiver holds a "
+        "dead handle",
+    ),
+    "OPS1004": (
+        "declared-never-raise-can-propagate",
+        "a surface declared 'degrade, never raise' (resources."
+        "NEVER_RAISE) has a non-empty raise/call closure: some raiser "
+        "inside is not contained by a matching handler",
+    ),
+}
+opslint.RULES.update(RULES)  # findings render through the shared catalog
+
+# resource states
+_HELD, _RELEASED, _ESCAPED, _VACUOUS, _UNKNOWN = range(5)
+
+#: trailing call names assumed total. Containers, string ops, math,
+#: logging, clocks: the closure hunts I/O and project propagation, not
+#: KeyError pedantry (documented posture).
+_SAFE_TRAILING: FrozenSet[str] = frozenset((
+    "len", "isinstance", "issubclass", "str", "repr", "int", "float",
+    "bool", "bytes", "min", "max", "abs", "sum", "any", "all", "sorted",
+    "reversed", "list", "dict", "set", "tuple", "frozenset", "enumerate",
+    "zip", "range", "map", "filter", "id", "hash", "type", "getattr",
+    "hasattr", "setattr", "vars", "callable", "format", "divmod",
+    "round", "ord", "chr", "next", "iter", "print", "super",
+    # container / string methods
+    "get", "items", "keys", "values", "append", "extend", "insert",
+    "add", "discard", "remove", "pop", "popleft", "popitem",
+    "setdefault", "update", "clear", "copy", "count", "index", "sort",
+    "reverse", "join", "split", "rsplit", "partition", "strip",
+    "rstrip", "lstrip", "startswith", "endswith", "replace", "lower",
+    "upper", "title", "encode", "decode", "splitlines", "zfill",
+    "ljust", "rjust", "find", "rfind", "fromkeys", "union",
+    "intersection", "difference", "isdigit", "isalpha", "group",
+    "groups", "match", "search", "fullmatch", "sub", "compile",
+    "finditer", "findall", "escape",
+    # clocks / threading factories / identity
+    "time", "monotonic", "perf_counter", "process_time", "sleep",
+    "clock", "_clock",  # stored clock callables (clock or time.monotonic)
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "Barrier",
+    "local", "current_thread", "get_ident", "gethostname", "getpid",
+    "getppid", "cpu_count", "getenv", "uname", "node",
+    # logging
+    "debug", "info", "warning", "error", "exception", "critical",
+    "log", "getLogger", "isEnabledFor",
+    # os.path predicates / pure path algebra (exists() swallows OSError)
+    "exists", "isfile", "isdir", "islink", "basename", "dirname",
+    "abspath", "realpath", "normpath", "splitext", "relpath",
+    "expanduser", "sep",
+    # misc total helpers
+    "getuid", "geteuid", "getcwd",
+    "hexdigest", "digest", "sha1", "sha256", "md5", "uuid4",
+    "deepcopy", "namedtuple", "field", "fields", "asdict", "total",
+    "is_alive", "daemon", "locked", "degrees", "radians", "sqrt",
+    "floor", "ceil", "exp", "log2", "log10", "isnan", "isinf",
+))
+
+#: trailing call names with a KNOWN exception surface. "*" = anything.
+_RAISER_TRAILING: Dict[str, Tuple[str, ...]] = {
+    "open": ("OSError",),
+    "read": ("OSError",), "readline": ("OSError",),
+    "readlines": ("OSError",), "write": ("OSError",),
+    "writelines": ("OSError",), "flush": ("OSError",),
+    "fsync": ("OSError",), "truncate": ("OSError",),
+    "seek": ("OSError",), "tell": ("OSError",), "fileno": ("OSError",),
+    "close": ("OSError",),
+    "unlink": ("OSError",), "rename": ("OSError",),
+    "replace": ("OSError",), "link": ("OSError",),
+    "symlink": ("OSError",), "mkdir": ("OSError",),
+    "makedirs": ("OSError",), "rmdir": ("OSError",),
+    "removedirs": ("OSError",), "rmtree": ("OSError",),
+    "stat": ("OSError",), "fstat": ("OSError",), "lstat": ("OSError",),
+    "listdir": ("OSError",), "scandir": ("OSError",),
+    "chmod": ("OSError",), "utime": ("OSError",),
+    "getsize": ("OSError",), "getmtime": ("OSError",),
+    "readlink": ("OSError",),
+    "connect": ("OSError",), "bind": ("OSError",),
+    "listen": ("OSError",), "accept": ("OSError",),
+    "send": ("OSError",), "sendall": ("OSError",), "recv": ("OSError",),
+    "loads": ("ValueError",),
+    "dumps": ("TypeError", "ValueError"),
+    "urlopen": ("*",),
+}
+# os.remove collides with list.remove / set.remove; resolve by dotted
+# prefix below, so bare .remove stays in the safe set.
+_RAISER_DOTTED: Dict[str, Tuple[str, ...]] = {
+    "os.remove": ("OSError",),
+    "json.load": ("ValueError", "OSError"),
+    "json.dump": ("TypeError", "ValueError", "OSError"),
+    "pickle.load": ("*",), "pickle.loads": ("*",),
+    "pickle.dump": ("*",), "pickle.dumps": ("*",),
+}
+# spec-declared acquire raisers (alloc_sequence -> KvCacheFull, ...)
+for _s in SPECS:
+    if _s.raises != ("*",):
+        for _a in _s.acquire:
+            _RAISER_TRAILING.setdefault(_a, _s.raises)
+
+#: container-store sinks: passing the handle here is an ownership
+#: escape for every spec (it outlives the function through the store).
+_STORE_TRAILING: FrozenSet[str] = frozenset(
+    ("append", "add", "insert", "put", "put_nowait", "appendleft"))
+
+_OSERROR_FAMILY: FrozenSet[str] = frozenset(
+    ("OSError", "IOError", "EnvironmentError", "FileNotFoundError",
+     "FileExistsError", "PermissionError", "InterruptedError"))
+
+_EXEMPT_FUNCS: FrozenSet[str] = frozenset(_EXEMPT_LOCK_FUNCS) | frozenset(
+    t for s in SPECS for t in s.acquire + s.release)
+
+_RELEASE_TRAILS: FrozenSet[str] = frozenset(
+    t for s in SPECS for t in s.release)
+
+
+def _trail(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Tuple[str, ...]:
+    """("<bare>",) for a bare except; trailing type names otherwise."""
+    t = handler.type
+    if t is None:
+        return ("<bare>",)
+    if isinstance(t, ast.Tuple):
+        return tuple(_trail(_dotted(e)) or "?" for e in t.elts)
+    return (_trail(_dotted(t)) or "?",)
+
+
+def _names_catch(names: Tuple[str, ...], exc: str) -> bool:
+    if "<bare>" in names or "Exception" in names or "BaseException" in names:
+        return True
+    if exc == "*":
+        return False
+    if exc in names:
+        return True
+    if exc in _OSERROR_FAMILY:
+        return bool(_OSERROR_FAMILY & set(names))
+    return False
+
+
+def _has_bare_reraise(body: Sequence[ast.stmt],
+                      exc_var: Optional[str]) -> bool:
+    for node in ast.walk(_Block(body)):
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            if exc_var and isinstance(node.exc, ast.Name) \
+                    and node.exc.id == exc_var:
+                return True
+    return False
+
+
+class _Block(ast.Module):
+    """ast.walk over a statement list without re-wrapping by hand."""
+
+    def __init__(self, body: Sequence[ast.stmt]):
+        self.body = list(body)
+        self._fields = ("body",)
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def _calls_in(node: ast.AST) -> List[ast.Call]:
+    """Calls evaluated NOW: descent stops at lambda / nested-def
+    boundaries (their bodies run later, if ever)."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.Lambda, ast.FunctionDef,
+                            ast.AsyncFunctionDef)) and cur is not node:
+            continue
+        if isinstance(cur, ast.Call):
+            out.append(cur)
+        stack.extend(ast.iter_child_nodes(cur))
+    out.reverse()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# raise/call closure (OPS1004 + the may-raise oracle for OPS1001)
+# ---------------------------------------------------------------------------
+
+class _HandlerFrame:
+    """One enclosing try's handler list, as a raise filter."""
+
+    __slots__ = ("handlers",)
+
+    def __init__(self, node: ast.Try):
+        self.handlers: List[Tuple[Tuple[str, ...], bool]] = []
+        for h in node.handlers:
+            var = h.name
+            self.handlers.append(
+                (_handler_names(h), _has_bare_reraise(h.body, var)))
+
+    def filter(self, types: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        for t in types:
+            matched = False
+            for names, reraises in self.handlers:
+                if _names_catch(names, t):
+                    matched = True
+                    if reraises:
+                        out.add(t)
+                    break
+            if not matched:
+                out.add(t)
+        return out
+
+
+def _apply_filters(types: Set[str],
+                   filters: Tuple[_HandlerFrame, ...]) -> Set[str]:
+    for frame in filters:  # innermost first
+        if not types:
+            return types
+        types = frame.filter(types)
+    return types
+
+
+class _RaiseScan(ast.NodeVisitor):
+    """Per-function local raise facts: explicitly raised types that
+    survive their enclosing handlers, plus call dependencies with the
+    handler filters they would propagate through."""
+
+    def __init__(self, facts: "_ProjectFacts", fn: FunctionInfo):
+        self.facts = facts
+        self.fn = fn
+        self.local: Set[str] = set()
+        self.deps: List[Tuple[str, Tuple[_HandlerFrame, ...]]] = []
+        self.witness: Dict[str, str] = {}
+        self._frames: List[_HandlerFrame] = []
+        self._caught: List[Tuple[str, ...]] = []
+        self.localtypes: Dict[str, Tuple[str, str]] = {}
+        self._seed_param_types()
+
+    # -- local type inference (annotations + constructors) ---------------
+
+    def _seed_param_types(self) -> None:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            return
+        cls = self._own_class()
+        if cls:
+            self.localtypes["self"] = cls
+        for arg in node.args.posonlyargs + node.args.args \
+                + node.args.kwonlyargs:
+            t = self._ann_class(arg.annotation)
+            if t:
+                self.localtypes[arg.arg] = t
+
+    def _own_class(self) -> Optional[Tuple[str, str]]:
+        tail = self.fn.qualname.rsplit("::", 1)[-1]
+        if "." in tail:
+            return (self.fn.module.path, tail.split(".", 1)[0])
+        return None
+
+    def _ann_class(self, ann: Optional[ast.AST]) -> Optional[Tuple[str,
+                                                                   str]]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip().split("[")[-1].rstrip("]")
+            return self.facts.resolve_class(self.fn.module, _trail(name))
+        if isinstance(ann, ast.Subscript):  # Optional[X] / "X" | None
+            base = _dotted(ann.value)
+            if _trail(base) == "Optional":
+                return self._ann_class(ann.slice)
+            return None
+        d = _dotted(ann)
+        return self.facts.resolve_class(self.fn.module, _trail(d)) \
+            if d else None
+
+    # -- traversal -------------------------------------------------------
+
+    def scan(self) -> None:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            return
+        self._block(node.body)
+
+    def _block(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs summarize on their own
+        if isinstance(stmt, ast.Try):
+            frame = _HandlerFrame(stmt)
+            self._frames.append(frame)
+            self._block(stmt.body)
+            self._frames.pop()
+            for h in stmt.handlers:
+                self._caught.append(_handler_names(h))
+                self._block(h.body)
+                self._caught.pop()
+            self._block(stmt.orelse)
+            self._block(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Raise):
+            self._raise_types(stmt)
+            # fall through: the raise expr may contain calls
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            self._block(stmt.body)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            self._infer_assign(stmt)
+            return
+        for node in _calls_in(stmt):
+            self._call(node)
+
+    def _expr(self, expr: Optional[ast.AST]) -> None:
+        if expr is None:
+            return
+        for node in _calls_in(expr):
+            self._call(node)
+
+    def _infer_assign(self, stmt: ast.Assign) -> None:
+        if len(stmt.targets) != 1 or not isinstance(stmt.targets[0],
+                                                    ast.Name):
+            return
+        name = stmt.targets[0].id
+        if isinstance(stmt.value, ast.Call):
+            d = _dotted(stmt.value.func)
+            cls = self.facts.resolve_class(self.fn.module, _trail(d)) \
+                if d else None
+            if cls:
+                self.localtypes[name] = cls
+                return
+            callee = self.facts.resolve(self.fn.module, self, d)
+            if callee is not None and not isinstance(callee.node,
+                                                     ast.Lambda):
+                ret = self._ann_class(callee.node.returns)
+                if ret:
+                    self.localtypes[name] = ret
+
+    def _raise_types(self, node: ast.Raise) -> None:
+        filters = tuple(reversed(self._frames))
+        if node.exc is None or (isinstance(node.exc, ast.Name)
+                                and self._caught
+                                and node.exc.id):
+            # bare raise (or `raise e`): propagates what was caught
+            types = set(self._caught[-1]) if self._caught else {"*"}
+            types = {"*" if t == "<bare>" else t for t in types}
+        elif isinstance(node.exc, ast.Call):
+            types = {_trail(_dotted(node.exc.func)) or "*"}
+        else:
+            types = {_trail(_dotted(node.exc)) or "*"}
+        for t in _apply_filters(set(types), filters):
+            self.local.add(t)
+            self.witness.setdefault(
+                t, "raise at line %d" % node.lineno)
+
+    def _call(self, call: ast.Call) -> None:
+        d = _dotted(call.func)
+        types, dep = self.facts.classify_call(self.fn.module, self, call, d)
+        filters = tuple(reversed(self._frames))
+        if dep is not None:
+            self.deps.append((dep, filters))
+            return
+        if types:
+            for t in _apply_filters(set(types), filters):
+                self.local.add(t)
+                self.witness.setdefault(
+                    t, "call to %s at line %d"
+                    % (d or "<dynamic>", call.lineno))
+
+
+# ---------------------------------------------------------------------------
+# project facts: closures + ownership summaries
+# ---------------------------------------------------------------------------
+
+class _ProjectFacts:
+    """One pass over the parsed project: per-function raise closures
+    (fixpoint over the call graph) and resource ownership summaries
+    (returns-a-resource, releases-a-param-on-every-path)."""
+
+    ROUNDS = 20
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.classes: Dict[str, List[str]] = {}
+        for key in project.functions:
+            path, qual = key.split("::", 1)
+            if "." in qual:
+                cls = qual.split(".", 1)[0]
+                if path not in self.classes.setdefault(cls, []):
+                    self.classes[cls].append(path)
+        self.scans: Dict[str, _RaiseScan] = {}
+        for key in sorted(project.functions):
+            scan = _RaiseScan(self, project.functions[key])
+            scan.scan()
+            self.scans[key] = scan
+        self.raises: Dict[str, Set[str]] = {
+            key: set(scan.local) for key, scan in self.scans.items()}
+        self.witness: Dict[str, Dict[str, str]] = {
+            key: dict(scan.witness) for key, scan in self.scans.items()}
+        self._fixpoint()
+        # ownership summaries (need no fixpoint: one level of transfer
+        # covers the tree's helper idioms; deeper chains stay silent)
+        self.returns_resource: Dict[str, Dict[int, ResourceSpec]] = {}
+        self.releases_params: Dict[str, Dict[int, ResourceSpec]] = {}
+        for key in sorted(project.functions):
+            fn = project.functions[key]
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            rr = _scan_returns_resource(fn)
+            if rr:
+                self.returns_resource[key] = rr
+            rp = _scan_releases_params(fn)
+            if rp:
+                self.releases_params[key] = rp
+
+    # -- resolution ------------------------------------------------------
+
+    def resolve_class(self, mod: ModuleInfo,
+                      name: str) -> Optional[Tuple[str, str]]:
+        if not name or name not in self.classes:
+            return None
+        paths = self.classes[name]
+        if mod.path in paths:
+            return (mod.path, name)
+        if len(paths) == 1:
+            return (paths[0], name)
+        return None
+
+    def resolve(self, mod: ModuleInfo, scan: Optional[_RaiseScan],
+                dotted: str) -> Optional[FunctionInfo]:
+        """Project-function resolution with receiver typing: own-class
+        methods via ``self.``, annotated/constructed locals via the
+        scan's type map, then the engine's import-aware fallback."""
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 2 and scan is not None:
+            recv, meth = parts
+            cls = scan.localtypes.get(recv)
+            if cls is not None:
+                key = "%s::%s.%s" % (cls[0], cls[1], meth)
+                return self.project.functions.get(key)
+        return self.project.resolve_call(mod, dotted)
+
+    def classify_call(self, mod: ModuleInfo, scan: Optional[_RaiseScan],
+                      call: ast.Call, dotted: str
+                      ) -> Tuple[Tuple[str, ...], Optional[str]]:
+        """(known exception types, project dep key). Safe -> ((), None);
+        unknown -> (("*",), None)."""
+        if not dotted:
+            # chained call (`json.dumps(x).encode()`): classify by the
+            # trailing attribute; the inner call is its own node
+            if isinstance(call.func, ast.Attribute):
+                trail = call.func.attr
+                if trail in _RAISER_TRAILING:
+                    return (_RAISER_TRAILING[trail], None)
+                if trail in _SAFE_TRAILING or trail in _RELEASE_TRAILS:
+                    return ((), None)
+            return (("*",), None)
+        callee = self.resolve(mod, scan, dotted)
+        if callee is not None:
+            return ((), callee.qualname)
+        if dotted in _RAISER_DOTTED:
+            return (_RAISER_DOTTED[dotted], None)
+        trail = _trail(dotted)
+        if trail in _RAISER_TRAILING:
+            return (_RAISER_TRAILING[trail], None)
+        if trail in _SAFE_TRAILING or trail in _RELEASE_TRAILS:
+            return ((), None)
+        return (("*",), None)
+
+    def _fixpoint(self) -> None:
+        for _ in range(self.ROUNDS):
+            changed = False
+            for key, scan in self.scans.items():
+                cur = set(scan.local)
+                for dep, filters in scan.deps:
+                    dep_types = self.raises.get(dep)
+                    if dep_types is None:
+                        continue
+                    for t in _apply_filters(set(dep_types), filters):
+                        cur.add(t)
+                        self.witness[key].setdefault(
+                            t, "via %s"
+                            % dep.rsplit("::", 1)[-1])
+                if cur != self.raises[key]:
+                    self.raises[key] = cur
+                    changed = True
+            if not changed:
+                return
+
+    def may_raise(self, mod: ModuleInfo, scan: Optional[_RaiseScan],
+                  call: ast.Call) -> Tuple[str, ...]:
+        """The OPS1001 oracle: exception types this call may propagate
+        (empty tuple = proven safe)."""
+        d = _dotted(call.func)
+        types, dep = self.classify_call(mod, scan, call, d)
+        if dep is not None:
+            return tuple(sorted(self.raises.get(dep, {"*"})))
+        return types
+
+
+# -- ownership summary scans (syntactic, conservative) ----------------------
+
+def _acquire_spec(trail: str) -> List[ResourceSpec]:
+    return [s for s in SPECS if trail in s.acquire]
+
+
+def _scan_returns_resource(fn: FunctionInfo) -> Dict[int, ResourceSpec]:
+    """``v = <acquire>()`` later returned (bare or as a tuple element):
+    callers inherit the obligation at the call site (ownership
+    transfer — the ``_fleet_rung`` shape)."""
+    acquired: Dict[str, ResourceSpec] = {}
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            call = node.value
+            d = _dotted(call.func)
+            trail = _trail(d)
+            recv = d.rsplit(".", 1)[0] if "." in d else ""
+            for spec in _acquire_spec(trail):
+                if spec.binds != "result":
+                    continue
+                if spec.receiver_hint \
+                        and _trail(recv) not in spec.receiver_hint:
+                    continue
+                if spec.name == "queue_slot" \
+                        and (call.args or call.keywords):
+                    continue  # RequestQueue.pop is nullary by contract
+                acquired[node.targets[0].id] = spec
+    if not acquired:
+        return {}
+    out: Dict[int, ResourceSpec] = {}
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        if isinstance(node.value, ast.Name) \
+                and node.value.id in acquired:
+            out[-1] = acquired[node.value.id]
+        elif isinstance(node.value, ast.Tuple):
+            for i, elt in enumerate(node.value.elts):
+                if isinstance(elt, ast.Name) and elt.id in acquired:
+                    out[i] = acquired[elt.id]
+    return out
+
+
+def _scan_releases_params(fn: FunctionInfo) -> Dict[int, ResourceSpec]:
+    """Params the function releases UNCONDITIONALLY (a release call at
+    the function's top statement level, or under try/finally): call
+    sites discharge the argument's obligation (release-on-behalf)."""
+    if isinstance(fn.node, ast.Lambda) or not fn.params:
+        return {}
+    out: Dict[int, ResourceSpec] = {}
+
+    def releases_in(body: Sequence[ast.stmt], depth: int) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.Try) and depth < 2:
+                releases_in(stmt.finalbody, depth + 1)
+            if not isinstance(stmt, ast.Expr) \
+                    or not isinstance(stmt.value, ast.Call):
+                continue
+            call = stmt.value
+            trail = _trail(_dotted(call.func))
+            for spec in SPECS:
+                if trail not in spec.release:
+                    continue
+                operands: List[str] = []
+                if spec.binds in ("result", "receiver"):
+                    d = _dotted(call.func)
+                    if "." in d:
+                        operands.append(d.rsplit(".", 1)[0])
+                if call.args and isinstance(call.args[0], ast.Name):
+                    operands.append(call.args[0].id)
+                for op in operands:
+                    if op in fn.params:
+                        out[fn.params.index(op)] = spec
+
+    releases_in(fn.node.body, 0)
+    # only a release that happens on EVERY path counts: restrict to
+    # single-release functions with no conditional around it (the
+    # helper idiom); anything fancier stays unsummarized (silent).
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the per-function resource walker (OPS1001/1002/1003)
+# ---------------------------------------------------------------------------
+
+class _Ob:
+    __slots__ = ("oid", "spec", "line", "names", "key", "guard_var",
+                 "reported", "release_line")
+
+    def __init__(self, oid: int, spec: ResourceSpec, line: int,
+                 name: str = "", key: str = ""):
+        self.oid = oid
+        self.spec = spec
+        self.line = line
+        self.names: Set[str] = {name} if name else set()
+        self.key = key
+        self.guard_var: Optional[str] = None
+        self.reported = False
+        self.release_line = 0
+
+
+class _WithFrame:
+    __slots__ = ("oids",)
+
+    def __init__(self) -> None:
+        self.oids: Set[int] = set()
+
+
+class _TryFrame:
+    __slots__ = ("node", "entry_acc", "walker")
+
+    def __init__(self, node: ast.Try, walker: "_FnWalker"):
+        self.node = node
+        self.walker = walker
+        self.entry_acc: Optional[Dict[int, int]] = None
+
+    def accumulate(self, st: Dict[int, int]) -> None:
+        if self.entry_acc is None:
+            self.entry_acc = dict(st)
+            return
+        self.entry_acc = _join(self.entry_acc, st)
+
+    def finally_releases(self, ob: _Ob) -> bool:
+        return self.walker._body_releases(self.node.finalbody, ob)
+
+    def handlers_for(self, exc: str) -> Optional[ast.ExceptHandler]:
+        for h in self.node.handlers:
+            if _names_catch(_handler_names(h), exc):
+                return h
+        return None
+
+
+def _join(a: Dict[int, int], b: Dict[int, int]) -> Dict[int, int]:
+    out: Dict[int, int] = {}
+    for oid in set(a) | set(b):
+        sa, sb = a.get(oid), b.get(oid)
+        if sa is None or sb is None:
+            out[oid] = sb if sa is None else sa
+        elif sa == sb:
+            out[oid] = sa
+        elif {sa, sb} == {_HELD, _VACUOUS}:
+            out[oid] = _HELD  # may hold: keep checking exception edges
+        else:
+            out[oid] = _UNKNOWN  # merged paths disagree: silence
+    return out
+
+
+class _FnWalker:
+    """Forward flow over one function body: obligations through
+    held/released/escaped with with/try-finally scoping, exception-edge
+    checks against the enclosing containment frames, and per-path
+    double-release / escape-vs-release conflicts."""
+
+    def __init__(self, facts: _ProjectFacts, fn: FunctionInfo,
+                 findings: List[Finding], report: bool = True,
+                 seed: Optional[Tuple[str, ResourceSpec]] = None):
+        self.facts = facts
+        self.fn = fn
+        self.mod = fn.module
+        self.scan = facts.scans.get(fn.qualname)
+        self.findings = findings
+        self.report = report
+        self.obs: Dict[int, _Ob] = {}
+        self.env: Dict[str, int] = {}       # var name -> oid
+        self.keys: Dict[Tuple[str, str], int] = {}  # (spec, key) -> oid
+        self.frames: List[object] = []
+        self.tmpvars: Set[str] = set()
+        self.fresh_ctor: Dict[str, str] = {}  # var -> ctor trail
+        self.exit_states: List[Dict[int, int]] = []
+        self._next = 0
+        self._seed = seed
+
+    # -- entry -----------------------------------------------------------
+
+    def run(self) -> None:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            return
+        st: Dict[int, int] = {}
+        if self._seed is not None:
+            name, spec = self._seed
+            ob = self._new_ob(spec, node.lineno, name=name)
+            st[ob.oid] = _HELD
+        out = self._block(node.body, st)
+        if out is not None:
+            self._exit_check(out, node.body[-1].lineno if node.body
+                             else node.lineno)
+            self.exit_states.append(out)
+
+    def _new_ob(self, spec: ResourceSpec, line: int, name: str = "",
+                key: str = "") -> _Ob:
+        self._next += 1
+        ob = _Ob(self._next, spec, line, name=name, key=key)
+        self.obs[ob.oid] = ob
+        if name:
+            self.env[name] = ob.oid
+        if key:
+            self.keys[(spec.name, key)] = ob.oid
+        return ob
+
+    def _emit(self, rule: str, line: int, msg: str, spec: ResourceSpec
+              ) -> None:
+        if not self.report:
+            return
+        self.findings.append(Finding(
+            rule, self.mod.path, line, msg,
+            symbol="%s.%s" % (spec.name, self.fn.simple_name)))
+
+    # -- block / statement dispatch --------------------------------------
+
+    def _block(self, body: Sequence[ast.stmt],
+               st: Optional[Dict[int, int]]) -> Optional[Dict[int, int]]:
+        for stmt in body:
+            if st is None:
+                return None
+            st = self._stmt(stmt, st)
+        return st
+
+    def _stmt(self, stmt: ast.stmt,
+              st: Dict[int, int]) -> Optional[Dict[int, int]]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._escape_closure(stmt, st)
+            return st
+        if isinstance(stmt, ast.Assign):
+            return self._assign(stmt, st)
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            fake = ast.Assign(targets=[stmt.target], value=stmt.value)
+            ast.copy_location(fake, stmt)
+            return self._assign(fake, st)
+        if isinstance(stmt, ast.Expr):
+            if isinstance(stmt.value, ast.Call):
+                spec_hit = self._handle_call(stmt.value, st)
+                if spec_hit is not None and spec_hit.binds == "result":
+                    self._emit(
+                        "OPS1001", stmt.value.lineno,
+                        "%s acquire result is discarded — the resource "
+                        "can never be released" % spec_hit.kind,
+                        spec_hit)
+                return st
+            if isinstance(stmt.value, (ast.Yield, ast.YieldFrom)):
+                self._escape_expr(stmt.value, st, stmt.lineno)
+            self._scan_calls(stmt.value, st)
+            return st
+        if isinstance(stmt, ast.Return):
+            return self._return(stmt, st)
+        if isinstance(stmt, ast.Raise):
+            self._scan_calls(stmt, st)
+            types = self._raise_stmt_types(stmt)
+            self._on_may_raise(types, stmt.lineno, st)
+            return None
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, st)
+        if isinstance(stmt, (ast.While, ast.For)):
+            return self._loop(stmt, st)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, st)
+        if isinstance(stmt, ast.With):
+            return self._with(stmt, st)
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return None
+        if isinstance(stmt, (ast.Pass, ast.Import, ast.ImportFrom,
+                             ast.Global, ast.Nonlocal, ast.Assert,
+                             ast.Delete, ast.ClassDef)):
+            self._scan_calls(stmt, st)
+            return st
+        self._scan_calls(stmt, st)
+        return st
+
+    # -- assignment ------------------------------------------------------
+
+    def _assign(self, stmt: ast.Assign,
+                st: Dict[int, int]) -> Optional[Dict[int, int]]:
+        target = stmt.targets[0] if len(stmt.targets) == 1 else None
+        value = stmt.value
+        # tmp-path string binding (`tmp = "%s.tmp.%d" % ...`)
+        if isinstance(target, ast.Name) and not isinstance(value, ast.Call):
+            if any(".tmp" in s for s in _const_strs(value)):
+                self.tmpvars.add(target.id)
+        if isinstance(value, ast.Call):
+            spec_hit = self._handle_call(value, st)
+            if isinstance(target, ast.Name):
+                d = _dotted(value.func)
+                # a daemon thread is fire-and-forget by contract (the
+                # runtime tracker exempts it the same way): constructing
+                # with daemon=True opens no lifecycle duty
+                if any(kw.arg == "daemon"
+                       and isinstance(kw.value, ast.Constant)
+                       and kw.value.value is True
+                       for kw in value.keywords):
+                    self.fresh_ctor.pop(target.id, None)
+                else:
+                    self.fresh_ctor[target.id] = _trail(d)
+                if spec_hit is not None and spec_hit.binds == "result":
+                    if target.id in self.env \
+                            and st.get(self.env[target.id]) == _HELD:
+                        old = self.obs[self.env[target.id]]
+                        if not old.reported:
+                            old.reported = True
+                            self._emit(
+                                "OPS1001", old.line,
+                                "%s acquired here is rebound at line %d "
+                                "while still held — the first handle "
+                                "leaks" % (old.spec.kind, stmt.lineno),
+                                old.spec)
+                    ob = self._new_ob(spec_hit, value.lineno,
+                                      name=target.id)
+                    st[ob.oid] = _HELD
+                    return st
+                # interprocedural: callee returns a resource
+                callee = self.facts.resolve(self.mod, self.scan, d)
+                if callee is not None:
+                    rr = self.facts.returns_resource.get(callee.qualname)
+                    if rr and -1 in rr:
+                        ob = self._new_ob(rr[-1], value.lineno,
+                                          name=target.id)
+                        st[ob.oid] = _HELD
+                return st
+            if isinstance(target, ast.Tuple):
+                d = _dotted(value.func)
+                callee = self.facts.resolve(self.mod, self.scan, d)
+                if callee is not None:
+                    rr = self.facts.returns_resource.get(callee.qualname)
+                    for idx, spec in sorted((rr or {}).items()):
+                        if 0 <= idx < len(target.elts) \
+                                and isinstance(target.elts[idx], ast.Name):
+                            ob = self._new_ob(spec, value.lineno,
+                                              name=target.elts[idx].id)
+                            st[ob.oid] = _HELD
+                return st
+            if isinstance(target, (ast.Attribute, ast.Subscript)):
+                return st
+            return st
+        # alias / escape of a tracked name
+        if isinstance(value, ast.Name) and value.id in self.env:
+            oid = self.env[value.id]
+            if isinstance(target, ast.Name):
+                self.obs[oid].names.add(target.id)
+                self.env[target.id] = oid
+            elif isinstance(target, (ast.Attribute, ast.Subscript)):
+                self._escape(oid, st, stmt.lineno)
+            return st
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            self._escape_expr(value, st, stmt.lineno)
+        self._scan_calls(value, st)
+        return st
+
+    # -- calls -----------------------------------------------------------
+
+    def _receiver(self, call: ast.Call) -> str:
+        d = _dotted(call.func)
+        return d.rsplit(".", 1)[0] if "." in d else ""
+
+    def _handle_call(self, call: ast.Call,
+                     st: Dict[int, int]) -> Optional[ResourceSpec]:
+        """Release / escape / acquire bookkeeping for one call; returns
+        the acquired spec (result-bound) for the caller to bind."""
+        d = _dotted(call.func)
+        trail = _trail(d)
+        # nested calls in arguments first (z = f(open(p)) etc. stay
+        # conservative: the inner call is classified on its own)
+        for arg in call.args:
+            if isinstance(arg, ast.Call):
+                self._handle_call(arg, st)
+        # 1) release?
+        for spec in SPECS:
+            if trail not in spec.release:
+                continue
+            ob = self._release_target(spec, call)
+            if ob is None:
+                continue
+            self._transition_release(ob, st, call.lineno)
+            return None
+        # 2) release-on-behalf helper?
+        callee = self.facts.resolve(self.mod, self.scan, d)
+        if callee is not None:
+            rp = self.facts.releases_params.get(callee.qualname, {})
+            for pidx, spec in sorted(rp.items()):
+                if pidx < len(call.args) \
+                        and isinstance(call.args[pidx], ast.Name):
+                    oid = self.env.get(call.args[pidx].id)
+                    if oid is not None \
+                            and self.obs[oid].spec.name == spec.name:
+                        self._transition_release(self.obs[oid], st,
+                                                 call.lineno)
+        # 3) escapes through stores / unknown sinks
+        self._call_arg_escapes(call, trail, st)
+        # 4) may-raise (before any acquire: if the acquire itself
+        # raises, its obligation never existed)
+        types = self.facts.may_raise(self.mod, self.scan, call)
+        if types:
+            self._on_may_raise(types, call.lineno, st)
+        # 5) acquire? One call can open several duties (a write-open of
+        # a tmp path is a file_handle AND a tmp_file): create every
+        # non-result obligation in place, hand the result-bound spec
+        # back for the caller to bind.
+        result_spec: Optional[ResourceSpec] = None
+        for spec in SPECS:
+            if trail not in spec.acquire:
+                continue
+            if not self._acquire_applies(spec, call):
+                continue
+            if spec.binds == "result":
+                if result_spec is None:
+                    result_spec = spec
+            elif spec.binds == "receiver":
+                recv = self._receiver(call)
+                if recv:
+                    ob = self._new_ob(spec, call.lineno, name=recv)
+                    st[ob.oid] = _HELD
+            elif spec.binds == "arg0":
+                key = self._arg0_key(spec, call)
+                if key:
+                    ob = self._new_ob(spec, call.lineno, key=key)
+                    st[ob.oid] = _HELD
+        return result_spec
+
+    def _acquire_applies(self, spec: ResourceSpec, call: ast.Call) -> bool:
+        recv = self._receiver(call)
+        if spec.receiver_hint:
+            # the hint names the receiver variable shape (self.queue /
+            # queue); a local constructed from the anchored class also
+            # qualifies (q = RequestQueue(...); q.pop())
+            anchor_cls = spec.anchor[1].split(".", 1)[0]
+            ctor_ok = (recv in self.fresh_ctor
+                       and self.fresh_ctor[recv] == anchor_cls)
+            if _trail(recv) not in spec.receiver_hint and not ctor_ok:
+                return False
+        if spec.name == "queue_slot" and (call.args or call.keywords):
+            return False  # RequestQueue.pop() is nullary; WorkQueue
+            # pop(timeout=...) is a different protocol with its own
+            # done()/add() discipline
+        if spec.ctor_hint:
+            if "." in recv or recv not in self.fresh_ctor:
+                return False
+            if self.fresh_ctor[recv] not in spec.ctor_hint:
+                return False
+        if spec.name == "tmp_file":
+            # a write-open of a local whose value names a tmp path
+            if recv:  # bare open() only
+                return False
+            if not call.args or not isinstance(call.args[0], ast.Name) \
+                    or call.args[0].id not in self.tmpvars:
+                return False
+            if len(call.args) < 2 \
+                    or not isinstance(call.args[1], ast.Constant) \
+                    or not isinstance(call.args[1].value, str) \
+                    or not any(c in call.args[1].value for c in "wax"):
+                return False
+        elif spec.name == "file_handle" and recv:
+            return False  # only the builtin open, not methods named open
+        return True
+
+    def _arg0_key(self, spec: ResourceSpec, call: ast.Call) -> str:
+        if spec.name == "tmp_file":
+            return call.args[0].id if call.args else ""
+        if call.args:
+            return _dotted(call.args[0])
+        return ""
+
+    def _release_target(self, spec: ResourceSpec,
+                        call: ast.Call) -> Optional[_Ob]:
+        if spec.binds == "arg0" and spec.name != "tmp_file":
+            if call.args:
+                key = _dotted(call.args[0])
+                oid = self.keys.get((spec.name, key))
+                if oid is not None:
+                    return self.obs[oid]
+            return None
+        if spec.name == "tmp_file":
+            if call.args and isinstance(call.args[0], ast.Name):
+                oid = self.keys.get((spec.name, call.args[0].id))
+                if oid is not None:
+                    return self.obs[oid]
+            return None
+        # result / receiver bound: the receiver is the handle
+        recv = self._receiver(call)
+        if recv:
+            oid = self.env.get(recv)
+            if oid is not None and self.obs[oid].spec.name == spec.name:
+                return self.obs[oid]
+        # consuming sinks that take the handle as an argument
+        # (requeue_front([req]) / observe_request(req, ...))
+        for arg in call.args:
+            for name in self._names_in(arg):
+                oid = self.env.get(name)
+                if oid is not None \
+                        and self.obs[oid].spec.name == spec.name:
+                    return self.obs[oid]
+        return None
+
+    @staticmethod
+    def _names_in(node: ast.AST) -> List[str]:
+        if isinstance(node, ast.Name):
+            return [node.id]
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            return [e.id for e in node.elts if isinstance(e, ast.Name)]
+        return []
+
+    def _call_arg_escapes(self, call: ast.Call, trail: str,
+                          st: Dict[int, int]) -> None:
+        is_store = trail in _STORE_TRAILING
+        for arg in call.args:
+            for name in self._names_in(arg):
+                oid = self.env.get(name)
+                if oid is None:
+                    continue
+                ob = self.obs[oid]
+                if trail in ob.spec.release or trail in ob.spec.acquire:
+                    continue
+                if is_store or ob.spec.arg_pass_escapes:
+                    self._escape(oid, st, call.lineno)
+
+    # -- state transitions -----------------------------------------------
+
+    def _transition_release(self, ob: _Ob, st: Dict[int, int],
+                            line: int) -> None:
+        cur = st.get(ob.oid)
+        if cur == _HELD or cur is None:
+            st[ob.oid] = _RELEASED
+            ob.release_line = line
+            return
+        if cur == _RELEASED and not ob.spec.idempotent_release:
+            self._emit(
+                "OPS1002", line,
+                "second release of the %s acquired at line %d on the "
+                "same path (first released at line %d)"
+                % (ob.spec.kind, ob.line, ob.release_line), ob.spec)
+            return
+        if cur == _ESCAPED:
+            self._emit(
+                "OPS1003", line,
+                "%s acquired at line %d is released here after "
+                "ownership already escaped on this path — the receiver "
+                "holds a dead handle" % (ob.spec.kind, ob.line), ob.spec)
+        # vacuous / unknown: silence
+
+    def _escape(self, oid: int, st: Dict[int, int], line: int) -> None:
+        cur = st.get(oid)
+        ob = self.obs[oid]
+        if cur == _RELEASED:
+            self._emit(
+                "OPS1003", line,
+                "%s acquired at line %d escapes here after being "
+                "released on this same path — the receiver holds a "
+                "dead handle" % (ob.spec.kind, ob.line), ob.spec)
+            return
+        if cur == _HELD:
+            st[oid] = _ESCAPED
+
+    def _escape_expr(self, expr: ast.AST, st: Dict[int, int],
+                     line: int) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in self.env:
+                self._escape(self.env[node.id], st, line)
+
+    def _escape_closure(self, fndef: ast.stmt, st: Dict[int, int]) -> None:
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Name) and node.id in self.env:
+                oid = self.env[node.id]
+                if st.get(oid) == _HELD:
+                    st[oid] = _ESCAPED
+
+    # -- exception / exit machinery --------------------------------------
+
+    def _raise_stmt_types(self, stmt: ast.Raise) -> Tuple[str, ...]:
+        if stmt.exc is None:
+            return ("*",)
+        if isinstance(stmt.exc, ast.Call):
+            return (_trail(_dotted(stmt.exc.func)) or "*",)
+        return (_trail(_dotted(stmt.exc)) or "*",)
+
+    def _scan_calls(self, node: ast.AST, st: Dict[int, int]) -> None:
+        """Conservative sweep for calls embedded in expressions the
+        dispatcher has no special handling for."""
+        for sub in _calls_in(node):
+            types = self.facts.may_raise(self.mod, self.scan, sub)
+            if types:
+                self._on_may_raise(types, sub.lineno, st)
+
+    def _on_may_raise(self, types: Sequence[str], line: int,
+                      st: Dict[int, int]) -> None:
+        # handler entry snapshots: by the time an outer handler runs,
+        # every with-frame INSIDE that try has already released its
+        # managed resources on the unwind
+        adjusted = dict(st)
+        for frame in reversed(self.frames):
+            if isinstance(frame, _WithFrame):
+                for oid in frame.oids:
+                    if adjusted.get(oid) == _HELD:
+                        adjusted[oid] = _RELEASED
+            elif isinstance(frame, _TryFrame):
+                frame.accumulate(adjusted)
+        held = [oid for oid, s in st.items() if s == _HELD]
+        if not held:
+            return
+        for oid in held:
+            ob = self.obs[oid]
+            if ob.reported:
+                continue
+            escaping = self._escaping_types(ob, types)
+            if escaping:
+                ob.reported = True
+                self._emit(
+                    "OPS1001", ob.line,
+                    "%s acquired here leaks if line %d raises %s — no "
+                    "enclosing finally/with/handler on that path "
+                    "releases or escapes it (wrap in try/finally or "
+                    "consume it in the handler)"
+                    % (ob.spec.kind, line, "/".join(sorted(escaping))),
+                    ob.spec)
+
+    def _escaping_types(self, ob: _Ob,
+                        types: Sequence[str]) -> List[str]:
+        """Which of ``types``, raised now, would cross the function
+        boundary with ``ob`` still held."""
+        live = list(types)
+        for frame in reversed(self.frames):
+            if not live:
+                return []
+            if isinstance(frame, _WithFrame):
+                if ob.oid in frame.oids:
+                    return []
+                continue
+            assert isinstance(frame, _TryFrame)
+            if frame.finally_releases(ob):
+                return []
+            nxt: List[str] = []
+            for t in live:
+                h = frame.handlers_for(t)
+                if h is None:
+                    nxt.append(t)
+                    continue
+                if self._body_releases(h.body, ob):
+                    continue  # handler consumes the resource
+                if _has_bare_reraise(h.body, h.name):
+                    nxt.append(t)
+                    continue
+                # contained: execution resumes after the try with the
+                # resource still held — later code is responsible
+            live = nxt
+        return live
+
+    def _body_releases(self, body: Sequence[ast.stmt], ob: _Ob) -> bool:
+        """Syntactic: does this (finally / handler) body release OB?"""
+        for node in ast.walk(_Block(list(body))):
+            if not isinstance(node, ast.Call):
+                continue
+            trail = _trail(_dotted(node.func))
+            if trail not in ob.spec.release:
+                continue
+            recv = _dotted(node.func)
+            recv = recv.rsplit(".", 1)[0] if "." in recv else ""
+            if recv and (recv in ob.names or recv == ob.key):
+                return True
+            for arg in node.args:
+                for name in self._names_in(arg):
+                    if name in ob.names or name == ob.key \
+                            or _dotted(ast.Name(id=name)) == ob.key:
+                        return True
+                if _dotted(arg) and _dotted(arg) == ob.key:
+                    return True
+        return False
+
+    def _exit_check(self, st: Dict[int, int], line: int) -> None:
+        """Normal-path exit (return / fall off the end) with a held,
+        unescaped resource."""
+        for oid, s in st.items():
+            if s != _HELD:
+                continue
+            ob = self.obs[oid]
+            if not ob.spec.leak_on_exit or ob.reported:
+                continue
+            if any(isinstance(f, _TryFrame) and f.finally_releases(ob)
+                   for f in self.frames):
+                continue
+            ob.reported = True
+            self._emit(
+                "OPS1001", ob.line,
+                "%s acquired here is still held at the function exit "
+                "at line %d on a normal path — no release, return, "
+                "store, or consuming sink" % (ob.spec.kind, line),
+                ob.spec)
+
+    def _return(self, stmt: ast.Return,
+                st: Dict[int, int]) -> None:
+        self._scan_calls(stmt, st)
+        escaping: Set[int] = set()
+        if stmt.value is not None:
+            for node in ast.walk(stmt.value):
+                if isinstance(node, ast.Name) and node.id in self.env:
+                    escaping.add(self.env[node.id])
+        for oid in sorted(escaping):
+            ob = self.obs[oid]
+            # returning through a finally that releases: the caller
+            # receives a dead handle (same-path escape + release)
+            if st.get(oid) == _HELD and any(
+                    isinstance(f, _TryFrame) and f.finally_releases(ob)
+                    for f in self.frames):
+                self._emit(
+                    "OPS1003", stmt.lineno,
+                    "%s acquired at line %d is returned here but an "
+                    "enclosing finally releases it on this same path — "
+                    "the caller receives a dead handle"
+                    % (ob.spec.kind, ob.line), ob.spec)
+                continue
+            self._escape(oid, st, stmt.lineno)
+        self._exit_check(st, stmt.lineno)
+        self.exit_states.append(dict(st))
+        return None
+
+    # -- control flow ----------------------------------------------------
+
+    def _guard_oid(self, expr: ast.AST) -> Optional[Tuple[int, bool]]:
+        """(oid, sense): sense True = expr truthy means the resource IS
+        held; the other branch's duty is vacuous."""
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            inner = self._guard_oid(expr.operand)
+            return (inner[0], not inner[1]) if inner else None
+        if isinstance(expr, ast.Compare) and len(expr.ops) == 1 \
+                and isinstance(expr.comparators[0], ast.Constant) \
+                and expr.comparators[0].value is None:
+            inner = self._guard_oid(expr.left)
+            if inner is None:
+                return None
+            if isinstance(expr.ops[0], ast.Is):
+                return (inner[0], not inner[1])   # x is None -> absent
+            if isinstance(expr.ops[0], ast.IsNot):
+                return inner
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.env:
+                return (self.env[expr.id], True)
+            for ob in self.obs.values():
+                if ob.guard_var == expr.id:
+                    return (ob.oid, True)
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in self.env:
+                ob = self.obs[self.env[base.id]]
+                if expr.attr in ob.spec.guard_attrs:
+                    return (ob.oid, True)
+        return None
+
+    def _if(self, stmt: ast.If,
+            st: Dict[int, int]) -> Optional[Dict[int, int]]:
+        # an acquire in the test itself (`if not lock.acquire(0):`)
+        test = stmt.test
+        acq_guard: Optional[Tuple[int, bool]] = None
+        inner = test.operand if (isinstance(test, ast.UnaryOp)
+                                 and isinstance(test.op, ast.Not)) \
+            else test
+        if isinstance(inner, ast.Call):
+            spec_hit = self._handle_call(inner, st)
+            if spec_hit is None:
+                recv = self._receiver(inner)
+                oid = self.env.get(recv) if recv else None
+                if oid is not None \
+                        and self.obs[oid].line == inner.lineno:
+                    acq_guard = (oid, inner is test)
+        else:
+            self._scan_calls(test, st)
+        guard = acq_guard or self._guard_oid(test)
+        st_then = dict(st)
+        st_else = dict(st)
+        if guard is not None:
+            oid, sense = guard
+            if sense:
+                st_else[oid] = _VACUOUS
+            else:
+                st_then[oid] = _VACUOUS
+        elif isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            for clause in test.values:
+                g = self._guard_oid(clause)
+                if g is not None:
+                    oid, sense = g
+                    if sense:
+                        st_then[oid] = st_then.get(oid, _HELD)
+                        st_else[oid] = _UNKNOWN
+                    else:
+                        st_then[oid] = _UNKNOWN
+        out_then = self._block(stmt.body, st_then)
+        out_else = self._block(stmt.orelse, st_else)
+        if out_then is None:
+            return out_else
+        if out_else is None:
+            return out_then
+        return _join(out_then, out_else)
+
+    def _loop(self, stmt: ast.stmt,
+              st: Dict[int, int]) -> Optional[Dict[int, int]]:
+        if isinstance(stmt, ast.For):
+            self._scan_calls(stmt.iter, st)
+        else:
+            self._scan_calls(stmt.test, st)
+        body_out = self._block(stmt.body, dict(st))
+        self._block(stmt.orelse, dict(st))
+        infinite = (isinstance(stmt, ast.While)
+                    and isinstance(stmt.test, ast.Constant)
+                    and bool(stmt.test.value)
+                    and not any(isinstance(n, ast.Break)
+                                for n in ast.walk(_Block(stmt.body))))
+        if infinite:
+            return None
+        if body_out is None:
+            return st
+        return _join(st, body_out)
+
+    def _try(self, stmt: ast.Try,
+             st: Dict[int, int]) -> Optional[Dict[int, int]]:
+        frame = _TryFrame(stmt, self)
+        self.frames.append(frame)
+        st_body = self._block(stmt.body, st)
+        self.frames.pop()
+        # handlers run from the states captured at may-raise sites
+        handler_entry = frame.entry_acc
+        out = st_body
+        for h in stmt.handlers:
+            if handler_entry is None:
+                break
+            h_out = self._block(h.body, dict(handler_entry))
+            if h_out is not None:
+                out = h_out if out is None else _join(out, h_out)
+        if st_body is not None:
+            o = self._block(stmt.orelse, st_body)
+            if o is not None and out is not None:
+                out = _join(out, o) if o is not st_body else out
+            elif o is not None:
+                out = o
+        if out is None:
+            # every path out of the try terminated; the finally still
+            # runs, but its effects are unobservable here
+            self._block(stmt.finalbody, dict(st))
+            return None
+        return self._block(stmt.finalbody, out)
+
+    def _with(self, stmt: ast.With,
+              st: Dict[int, int]) -> Optional[Dict[int, int]]:
+        frame = _WithFrame()
+        managed: List[int] = []
+        for item in stmt.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call):
+                spec_hit = self._handle_call(ce, st)
+                if spec_hit is not None and spec_hit.binds == "result":
+                    name = ""
+                    if isinstance(item.optional_vars, ast.Name):
+                        name = item.optional_vars.id
+                    ob = self._new_ob(spec_hit, ce.lineno, name=name)
+                    st[ob.oid] = _HELD
+                    frame.oids.add(ob.oid)
+                    managed.append(ob.oid)
+            elif isinstance(ce, ast.Name) and ce.id in self.env:
+                # `f = open(p)` ... `with f:` — the manager releases an
+                # already-held obligation on every exit
+                oid = self.env[ce.id]
+                if st.get(oid) == _HELD:
+                    frame.oids.add(oid)
+                    managed.append(oid)
+        self.frames.append(frame)
+        out = self._block(stmt.body, st)
+        self.frames.pop()
+        if out is not None:
+            for oid in managed:
+                if out.get(oid) == _HELD:
+                    out[oid] = _RELEASED
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+class ResourcePass(DataflowPass):
+    """Whole-project sweep (the ops9xx shape): one :class:`_ProjectFacts`
+    per parse, findings handed out module by module."""
+
+    rule_ids = ("OPS1001", "OPS1002", "OPS1003", "OPS1004")
+
+    def __init__(self) -> None:
+        self._project: Optional[Project] = None
+        self._by_path: Dict[str, List[Finding]] = {}
+        self.facts: Optional[_ProjectFacts] = None
+
+    def sweep_module(self, project: Project,
+                     mod: ModuleInfo) -> List[Finding]:
+        if self._project is not project:
+            self._project = project
+            self._by_path = self._analyze(project)
+        return list(self._by_path.get(mod.path, ()))
+
+    def _analyze(self, project: Project) -> Dict[str, List[Finding]]:
+        facts = _ProjectFacts(project)
+        self.facts = facts
+        findings: List[Finding] = []
+        for key in sorted(project.functions):
+            fn = project.functions[key]
+            if fn.simple_name in _EXEMPT_FUNCS:
+                continue
+            _FnWalker(facts, fn, findings).run()
+        findings.extend(_contract_findings(project, facts))
+        findings.extend(_spec_audit(project))
+        out: Dict[str, List[Finding]] = {}
+        for f in sorted(findings,
+                        key=lambda f: (f.path, f.line, f.rule, f.message)):
+            out.setdefault(f.path, []).append(f)
+        return out
+
+
+def _contract_findings(project: Project,
+                       facts: _ProjectFacts) -> List[Finding]:
+    out: List[Finding] = []
+    paths = {m.path for m in project.modules}
+    for contract in NEVER_RAISE:
+        if contract.path not in paths:
+            continue
+        key = "%s::%s" % (contract.path, contract.func)
+        fn = project.functions.get(key)
+        if fn is None:
+            out.append(Finding(
+                "OPS001", contract.path, 1,
+                "never-raise contract names %s which this tree does not "
+                "define — update analysis/resources.py" % contract.func,
+                symbol="neverraise.%s" % contract.func))
+            continue
+        closure = facts.raises.get(key, set())
+        if closure:
+            wit = facts.witness.get(key, {})
+            detail = "; ".join(
+                "%s (%s)" % (t, wit.get(t, "?"))
+                for t in sorted(closure))
+            out.append(Finding(
+                "OPS1004", contract.path, fn.node.lineno,
+                "declared never-raise surface %s can propagate: %s — "
+                "contract: %s" % (contract.func, detail,
+                                  contract.rationale),
+                symbol="never_raise.%s" % contract.func))
+    return out
+
+
+def _spec_audit(project: Project) -> List[Finding]:
+    """Anchored resource specs must still name real symbols (the OPS001
+    self-audit family, like guard specs and suppression pragmas)."""
+    out: List[Finding] = []
+    paths = {m.path for m in project.modules}
+    for spec in SPECS:
+        path, symbol = spec.anchor
+        if not path or path not in paths:
+            continue
+        key = "%s::%s" % (path, symbol)
+        if key not in project.functions:
+            out.append(Finding(
+                "OPS001", path, 1,
+                "resource spec %r anchors to %s which this tree does "
+                "not define — update analysis/resources.py"
+                % (spec.name, symbol),
+                symbol="resourcespec.%s" % spec.name))
+    return out
+
+
+def prove_contracts(paths: Sequence[str],
+                    root: Optional[str] = None) -> Dict[str, List[str]]:
+    """Build a project over ``paths`` and return every declared
+    never-raise contract's residual closure (empty list = discharged).
+    The acceptance test asserts the set is non-empty AND discharged —
+    clean must not mean vacuous."""
+    project = Project(paths, root=root)
+    facts = _ProjectFacts(project)
+    out: Dict[str, List[str]] = {}
+    for contract in NEVER_RAISE:
+        key = "%s::%s" % (contract.path, contract.func)
+        if key in project.functions:
+            out[contract.func] = sorted(facts.raises.get(key, set()))
+    return out
+
+
+def make_passes() -> List[DataflowPass]:
+    return [ResourcePass()]

@@ -1,0 +1,203 @@
+"""The unified resource-lifecycle spec: one declaration, two checkers.
+
+The PR 12/guards.py move, applied to acquire/release pairs instead of
+lock/field ownership: every resource the project must not leak —
+compile leases, KV block reservations, queue slots, bare lock holds,
+file handles, thread lifecycles, tmp-file publishes — is declared ONCE
+in :data:`SPECS`, and both halves of the checker consume the same
+table:
+
+* **static** — the OPS10xx passes (:mod:`.ops10xx`) prove, per function
+  and across call summaries, that every acquired resource reaches a
+  release or an ownership escape on EVERY path, including the
+  exception edges chaos never happened to schedule (OPS1001), that no
+  path releases twice (OPS1002), and that no single path both escapes
+  and releases the same resource (OPS1003);
+* **runtime** — :mod:`.leaktrack` instruments the ``runtime=True``
+  pairs under ``TPUJOB_LEAK_TRACK=1`` (racedetect pattern: creation-
+  site identity, project frames only) and the conftest session hook
+  fails on anything still held at teardown.
+
+A planted leak is caught by both with the SAME creation-site
+fingerprint (``path:line`` of the acquire), cross-checked in-suite the
+way OPS902 and the race detector share lock fingerprints.
+
+:data:`NEVER_RAISE` is the sibling table for OPS1004: the "degrade,
+never raise" surfaces (ledger costing, compile-cache fallbacks,
+metrics providers) whose raise/call closure must be provably empty.
+
+Both tables are self-auditing the way suppressions are: an entry
+anchored to a symbol the analyzed tree no longer has is reported
+(OPS001 family), so the tables can only track reality.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class ResourceSpec:
+    """One acquire/release contract.
+
+    ``binds`` says where the abstract resource value lives:
+
+    * ``result`` — the acquire call's return value is the handle
+      (``lease = store.acquire_compile_lease(fp)``);
+    * ``arg0`` — the resource is keyed by the acquire call's first
+      argument (``alloc_sequence(seq_id, ...)`` /
+      ``free_sequence(seq_id)``); ownership outlives the function by
+      design, so only exception edges are checked;
+    * ``receiver`` — the call's receiver is the handle
+      (``self._lock.acquire()`` / ``t.start()``).
+    """
+
+    name: str                     # "compile_lease"
+    kind: str                     # human noun for messages
+    acquire: Tuple[str, ...]      # trailing call names creating the duty
+    release: Tuple[str, ...]      # trailing call names discharging it
+    binds: str                    # "result" | "arg0" | "receiver"
+    #: releasing an already-released handle is a documented no-op
+    #: (KvBlockAllocator.free_sequence, CompileLease.release) — OPS1002
+    #: stays quiet for these.
+    idempotent_release: bool = False
+    #: flag a normal-path exit (return / fall-off-end) that still holds
+    #: the resource. Off for arg0-keyed specs (ownership transfers to
+    #: the caller by contract) and thread starts (fire-and-forget
+    #: daemons are idiomatic; the runtime checker audits liveness).
+    leak_on_exit: bool = True
+    #: passing the handle to an unresolved call transfers ownership
+    #: (conservative silence). Off for queue slots: requests are passed
+    #: around for inspection constantly; only stores/returns/spec'd
+    #: sinks transfer a slot.
+    arg_pass_escapes: bool = True
+    #: attributes whose falsiness means "nothing was acquired"
+    #: (``if lease.granted:`` — the else-path duty is vacuous).
+    guard_attrs: Tuple[str, ...] = ()
+    #: the acquire receiver's LAST dotted component must be one of
+    #: these (keeps ``queue.pop`` from matching ``dict.pop``). Empty =
+    #: no constraint.
+    receiver_hint: Tuple[str, ...] = ()
+    #: receiver must be a fresh local assigned from one of these
+    #: constructors (``t = threading.Thread(...)``; ``srv.start()``
+    #: stays untracked). Empty = no constraint.
+    ctor_hint: Tuple[str, ...] = ()
+    #: exception names the ACQUIRE call itself may raise ("*" = any);
+    #: feeds the exception-edge simulation of sibling obligations.
+    raises: Tuple[str, ...] = ("*",)
+    #: instrumented by leaktrack under TPUJOB_LEAK_TRACK=1.
+    runtime: bool = False
+    #: ("<module path>", "Symbol.or.Class.method") the staleness audit
+    #: checks still exists; ("", "") for builtins.
+    anchor: Tuple[str, str] = ("", "")
+    rationale: str = ""
+
+
+#: Every declared resource contract. Keep entries sorted by name; the
+#: OPS10xx spec audit fails on anchors the tree no longer has.
+SPECS: Tuple[ResourceSpec, ...] = (
+    ResourceSpec(
+        "compile_lease", "compile lease",
+        acquire=("acquire_compile_lease",), release=("release",),
+        binds="result", guard_attrs=("granted",), runtime=True,
+        anchor=("paddle_operator_tpu/artifacts/store.py",
+                "ArtifactStore.acquire_compile_lease"),
+        idempotent_release=True,  # CompileLease.release: documented no-op
+        rationale="a leaked lease leaves every peer waiting out the TTL "
+                  "(the PR 15 bug class)"),
+    ResourceSpec(
+        "file_handle", "file handle",
+        acquire=("open",), release=("close",),
+        binds="result", runtime=True,
+        rationale="an unclosed handle pins an fd and, on write paths, "
+                  "buffered data"),
+    ResourceSpec(
+        "kv_blocks", "KV block reservation",
+        acquire=("alloc_sequence",), release=("free_sequence",),
+        binds="arg0", idempotent_release=True, leak_on_exit=False,
+        raises=("KvCacheFull",), runtime=True,
+        anchor=("paddle_operator_tpu/serving/kv_cache.py",
+                "KvBlockAllocator.alloc_sequence"),
+        rationale="leaked blocks shrink the pool until the replica "
+                  "sheds load it could have served"),
+    ResourceSpec(
+        "lock_hold", "lock hold",
+        acquire=("acquire",), release=("release",),
+        binds="receiver", runtime=False,  # racedetect owns lock runtime
+        rationale="a bare acquire() not released on every path wedges "
+                  "every later critical section"),
+    ResourceSpec(
+        "queue_slot", "admission queue slot",
+        acquire=("pop",),
+        release=("requeue_front", "observe_request"),
+        binds="result", arg_pass_escapes=False,
+        receiver_hint=("queue",), runtime=True,
+        anchor=("paddle_operator_tpu/serving/batching.py",
+                "RequestQueue.pop"),
+        rationale="a popped request that neither completes, requeues, "
+                  "nor is counted shed breaks request conservation"),
+    ResourceSpec(
+        "thread_lifecycle", "thread",
+        acquire=("start",), release=("join",),
+        binds="receiver", leak_on_exit=False,
+        ctor_hint=("Thread",), runtime=True,
+        rationale="a started local thread abandoned on an exception "
+                  "path outlives its owner (the PR 17 drain-path class)"),
+    ResourceSpec(
+        "tmp_file", "tmp file",
+        acquire=("open",),
+        release=("replace", "rename", "remove", "unlink"),
+        binds="arg0", runtime=False,
+        rationale="a tmp file neither published (os.replace) nor "
+                  "removed on failure accretes garbage next to the "
+                  "artifact it failed to write"),
+)
+
+
+@dataclass(frozen=True)
+class NeverRaiseContract:
+    """A declared "degrade, never raise" surface: OPS1004 verifies the
+    function's raise/call closure is empty (every raiser inside is
+    contained by a matching handler)."""
+
+    path: str        # repo-relative module path
+    func: str        # "fn" | "Class.method" (the dataflow qualname tail)
+    rationale: str
+
+
+#: The declared never-raise surfaces. Order matters only for docs; the
+#: audit reports entries whose function the tree no longer defines.
+NEVER_RAISE: Tuple[NeverRaiseContract, ...] = (
+    NeverRaiseContract(
+        "paddle_operator_tpu/compile_cache.py", "load_step_cost",
+        "cache degrade: a corrupt/missing cost snapshot must fall back "
+        "to an empty estimate, never fail the runner"),
+    NeverRaiseContract(
+        "paddle_operator_tpu/compile_cache.py", "save_step_cost",
+        "cache degrade: failing to persist the cost snapshot costs the "
+        "next run a cold estimate, not this run"),
+    NeverRaiseContract(
+        "paddle_operator_tpu/sched/feedback.py", "BadputPredictor.predict",
+        "ledger costing: any ledger failure falls back to the "
+        "staleness-only cost toward the arbiter"),
+    NeverRaiseContract(
+        "paddle_operator_tpu/sched/feedback.py",
+        "FeedbackController.evict_cost",
+        "ledger costing: the arbiter's victim scoring must survive a "
+        "broken ledger"),
+    NeverRaiseContract(
+        "paddle_operator_tpu/sched/feedback.py",
+        "FeedbackController.predict_info",
+        "ledger costing: decision-trace enrichment is best-effort"),
+)
+
+
+def specs_by_name() -> dict:
+    return {s.name: s for s in SPECS}
+
+
+def runtime_specs() -> Tuple[ResourceSpec, ...]:
+    """The subset leaktrack must instrument (cross-checked at import:
+    a runtime=True spec without a tracker fails loudly in-suite)."""
+    return tuple(s for s in SPECS if s.runtime)

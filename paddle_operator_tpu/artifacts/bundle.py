@@ -138,7 +138,9 @@ def merge_write(path: str, fingerprint: str,
         with open(tmp, "wb") as fh:
             fh.write(pack(fingerprint, merged))
         os.replace(tmp, path)
-    except OSError:
+    except BaseException:
+        # cleanup must cover every raiser, not just OSError: a pack()
+        # failure mid-write would otherwise strand the torn tmp
         try:
             os.remove(tmp)
         except OSError:

@@ -39,7 +39,7 @@ import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..obs.exposition import http_respond
 from . import bundle
@@ -101,9 +101,9 @@ class _ServerState:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    server_ref: "ArtifactServer" = None  # injected via type()
+    server_ref: Optional["ArtifactServer"] = None  # injected via type()
 
-    def log_message(self, fmt, *args):  # quiet
+    def log_message(self, fmt: str, *args: Any) -> None:  # quiet
         pass
 
     def _params(self) -> dict:
@@ -114,7 +114,7 @@ class _Handler(BaseHTTPRequestHandler):
         http_respond(self, code, json.dumps(body).encode(),
                      ctype="application/json")
 
-    def do_GET(self):  # noqa: N802 (http.server API)
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
         path = urllib.parse.urlparse(self.path).path
         srv = self.server_ref
         if path == "/healthz":
@@ -142,7 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
                                     "state": srv.state.lease_state(fp)})
         return self._json(404, {"error": "not found"})
 
-    def do_PUT(self):  # noqa: N802
+    def do_PUT(self) -> None:  # noqa: N802
         path = urllib.parse.urlparse(self.path).path
         srv = self.server_ref
         if path != "/v1/artifact":
@@ -171,7 +171,7 @@ class _Handler(BaseHTTPRequestHandler):
         srv.state.bump("publish")
         return self._json(200, {"fp": fp, "members": members})
 
-    def do_POST(self):  # noqa: N802
+    def do_POST(self) -> None:  # noqa: N802
         path = urllib.parse.urlparse(self.path).path
         srv = self.server_ref
         if path != "/v1/lease":
@@ -188,7 +188,7 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(200, {"granted": granted, "broke": broke,
                                 "fp": fp})
 
-    def do_DELETE(self):  # noqa: N802
+    def do_DELETE(self) -> None:  # noqa: N802
         path = urllib.parse.urlparse(self.path).path
         srv = self.server_ref
         if path != "/v1/lease":
@@ -204,7 +204,7 @@ class ArtifactServer:
     """Embeddable server over a local bundle directory; context-manager
     friendly like :class:`~..elastic.server.MembershipServer`."""
 
-    def __init__(self, bind: str = ":0", store_dir: str = ""):
+    def __init__(self, bind: str = ":0", store_dir: str = "") -> None:
         host, _, port = bind.rpartition(":")
         # ':8083' means all interfaces, like every other server bind in
         # this project — a loopback default would silently serve the
@@ -284,10 +284,10 @@ class ArtifactServer:
         if self._thread:
             self._thread.join(timeout=5)
 
-    def __enter__(self):
+    def __enter__(self) -> "ArtifactServer":
         return self.start()
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: Any) -> None:
         self.stop()
 
     # -- observability ---------------------------------------------------
@@ -307,7 +307,7 @@ class ArtifactServer:
         return "\n".join(lines) + "\n"
 
 
-def main(argv=None):
+def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(
         description="tpujob fleet compile-artifact store server")
     ap.add_argument("--port", type=int, default=8083)

@@ -52,7 +52,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from . import bundle
 from .bundle import PoisonedArtifactError
@@ -107,7 +107,7 @@ class CompileLease:
     :meth:`release` after publishing (or failing)."""
 
     def __init__(self, store: "ArtifactStore", fingerprint: str,
-                 granted: bool, token: str):
+                 granted: bool, token: str) -> None:
         self._store = store
         self.fingerprint = fingerprint
         self.granted = granted
@@ -129,7 +129,7 @@ class ArtifactStore:
                  lease_ttl_s: Optional[float] = None,
                  wait_s: Optional[float] = None,
                  poll_s: Optional[float] = None,
-                 http_timeout_s: Optional[float] = None):
+                 http_timeout_s: Optional[float] = None) -> None:
         self.local_dir = local_dir
         self.url = url.rstrip("/")
         self.lease_ttl_s = (lease_ttl_s if lease_ttl_s is not None else
@@ -183,7 +183,7 @@ class ArtifactStore:
         with self._lock:
             return dict(self._stats)
 
-    def _warn_once(self, key: str, msg: str, *args) -> None:
+    def _warn_once(self, key: str, msg: str, *args: Any) -> None:
         with self._lock:
             if key in self._warned:
                 return

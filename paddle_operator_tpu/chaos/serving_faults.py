@@ -137,6 +137,20 @@ def run_serving_scenario(plan, injector: FaultInjector
     violations: List[str] = []
     facts: Dict[str, object] = {}
 
+    # leak-audited lane (make serve): every acquire/release pair the
+    # resource specs declare runtime=True is tracked through the whole
+    # drain/rejoin cycle under a scenario-private registry; the census
+    # joins the deterministic facts, live resources become violations
+    import os as _os
+
+    leak_reg = prev_leak_reg = None
+    if _os.environ.get("TPUJOB_LEAK_TRACK"):
+        from ..analysis import leaktrack as _leaktrack
+
+        prev_leak_reg = _leaktrack._registry
+        leak_reg = _leaktrack.Registry()
+        _leaktrack.install(leak_reg)
+
     cfg = {"shed_policy": "reject_new", "queue_capacity": 12}
     for ev in plan.events:
         if ev.kind == "serve_config":
@@ -373,6 +387,18 @@ def run_serving_scenario(plan, injector: FaultInjector
             violations.append(
                 "%s error budget exhausted: slow-window burn %.2f > 1.0"
                 % (slo, burn))
+
+    if leak_reg is not None:
+        from ..analysis import leaktrack as _leaktrack
+
+        leak_rep = _leaktrack.leak_report(leak_reg)
+        _leaktrack._registry = prev_leak_reg
+        facts["leak_census"] = {
+            spec: counts["acquired"]
+            for spec, counts in leak_rep.census.items()}
+        for rec in leak_rep.live:
+            violations.append("resource leak: %s acquired at %s"
+                              % (rec.spec, rec.label))
 
     facts.update({
         "shed_policy": cfg["shed_policy"],

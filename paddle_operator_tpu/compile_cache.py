@@ -470,10 +470,17 @@ def load_step_cost(fingerprint: str) -> Optional[Dict[str, Any]]:
         return None
     if not os.path.exists(path):
         # the fleet store may carry the first prober's figures —
-        # member-scoped, so this never downloads the executable payload
-        members = _artifact_fetch_members(fingerprint, member="cost")
-        if members and isinstance(members.get("cost"), bytes):
-            _atomic_write(path, members["cost"])
+        # member-scoped, so this never downloads the executable payload.
+        # fetch can raise (a poisoned local bundle is a verifier
+        # reject); per this function's contract that is a miss, not a
+        # failure of the run
+        try:
+            members = _artifact_fetch_members(fingerprint, member="cost")
+            if members and isinstance(members.get("cost"), bytes):
+                _atomic_write(path, members["cost"])
+        except Exception as e:
+            log.warning("fleet step-cost fetch for %s failed (%s); "
+                        "treating as a miss", fingerprint[:12], e)
         if not os.path.exists(path):
             return None
     import json
@@ -525,9 +532,15 @@ def save_step_cost(fingerprint: str, cost: Dict[str, Any]) -> None:
         return
     from . import artifacts
 
-    store = artifacts.get_store()
-    if store is not None:
-        store.publish(fingerprint, {"cost": payload})
+    try:
+        store = artifacts.get_store()
+        if store is not None:
+            store.publish(fingerprint, {"cost": payload})
+    except Exception as e:
+        # publish is best-effort by contract: a broken store costs a
+        # peer one re-probe, never this run
+        log.warning("fleet step-cost publish for %s failed: %s",
+                    fingerprint[:12], e)
 
 
 def _atomic_write(path: str, payload: bytes) -> bool:
@@ -654,13 +667,19 @@ def _fleet_rung(store, fingerprint: str, aot_path: str, label: str):
             # we hold the lease a completed publish is visible) —
             # without this, a waiter that raced the release would
             # re-pay the compile the fleet just finished
-            members, tier = store.fetch(fingerprint)
-            if members is not None and _install_members(
-                    fingerprint, members, aot_path):
-                got = _try_load_aot(aot_path)
-                if got is not None:
-                    lease.release()
-                    return got, tier, None
+            try:
+                members, tier = store.fetch(fingerprint)
+                if members is not None and _install_members(
+                        fingerprint, members, aot_path):
+                    got = _try_load_aot(aot_path)
+                    if got is not None:
+                        lease.release()
+                        return got, tier, None
+            except BaseException:
+                # an exception between grant and handoff must not
+                # strand the fingerprint: peers would wait out the TTL
+                lease.release()
+                raise
             return None, None, lease
         log.info("compile lease for %s (%s) held by a peer; "
                  "waiting-then-fetching (bounded %.0fs)",
@@ -704,17 +723,21 @@ def _try_load_aot(path: str) -> Optional[Callable]:
 def _try_save_aot(path: str, compiled) -> bool:
     if not path:
         return False
+    tmp = "%s.tmp.%d" % (path, os.getpid())
     try:
         from jax.experimental.serialize_executable import serialize
 
         payload, in_tree, out_tree = serialize(compiled)
-        tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "wb") as fh:
             pickle.dump((payload, in_tree, out_tree), fh)
         os.replace(tmp, path)  # atomic publish: readers never see a torn file
         return True
     except Exception as e:
         log.info("AOT executable not serializable on this backend: %s", e)
+        try:
+            os.remove(tmp)  # a torn tmp must not accrete next to the cache
+        except OSError:
+            pass
         return False
 
 
@@ -860,7 +883,10 @@ def cached_jit(fn: Callable, example_args: Tuple,
             if store is not None:
                 loaded, fleet_tier, lease = _fleet_rung(
                     store, fp, path, label)
-        if loaded is not None:
+        # _fleet_rung returns lease=None whenever it hands back a loaded
+        # executable; spelling that in the guard keeps the invariant
+        # visible to readers and the resource-lifecycle analysis alike
+        if lease is None and loaded is not None:
             with _state._lock:
                 _state.stats["aot_hits"] += 1
                 if fleet_tier is not None:

@@ -29,6 +29,8 @@ ledger and the ``tpujob_serve_*`` metric family (:mod:`.metrics`); the
 drain / shed / warm-rejoin story deterministically.
 """
 
+from typing import Any
+
 from .autoscaler import ScaleDecision, ServingAutoscaler  # noqa: F401
 from .batching import (  # noqa: F401
     ContinuousBatcher, Request, RequestQueue, SHED_POLICIES,
@@ -49,7 +51,7 @@ __all__ = [
 ]
 
 
-def __getattr__(name):
+def __getattr__(name: str) -> Any:
     # ServingEngine pulls in jax at import time; loading it lazily keeps
     # the operator's import chain (reconciler -> serving.controller)
     # model-free, matching how controllers/ never import models/ directly

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 #: decision actions, in the order of how alarmed the operator should be
 ACTIONS = ("hold", "scale_down", "scale_up", "replace")
@@ -61,8 +61,8 @@ class ServingAutoscaler:
                  saturation_mfu: float = 0.30,
                  degraded_mfu: float = 0.10,
                  scale_down_patience: int = 3,
-                 evaluator=None,
-                 mfu_fn: Optional[Callable[[], Optional[float]]] = None):
+                 evaluator: Optional[Any] = None,
+                 mfu_fn: Optional[Callable[[], Optional[float]]] = None) -> None:
         if not 0 < min_replicas <= max_replicas:
             raise ValueError(
                 "need 0 < min_replicas <= max_replicas, got [%d, %d]"

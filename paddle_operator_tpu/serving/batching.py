@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 # the canonical vocabulary lives in the API layer so the webhook/CRD can
 # validate serving specs without importing the jax-backed data plane
@@ -72,7 +72,7 @@ class RequestQueue:
     """Bounded FIFO admission queue with a counted shed posture."""
 
     def __init__(self, capacity: int, shed_policy: str = "reject_new",
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None) -> None:
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         if shed_policy not in SHED_POLICIES:
@@ -151,9 +151,9 @@ class ContinuousBatcher:
 
     def __init__(self, queue: RequestQueue, max_batch: int,
                  clock: Optional[Callable[[], float]] = None,
-                 metrics=None,
+                 metrics: Optional[Any] = None,
                  on_admit: Optional[Callable[[Request], bool]] = None,
-                 on_retire: Optional[Callable[[Request], None]] = None):
+                 on_retire: Optional[Callable[[Request], None]] = None) -> None:
         if max_batch <= 0:
             raise ValueError("max_batch must be positive")
         import time
@@ -182,7 +182,19 @@ class ContinuousBatcher:
             req = self.queue.pop()
             if req is None:
                 return
-            if self.on_admit is not None and not self.on_admit(req):
+            try:
+                admitted = self.on_admit is None or self.on_admit(req)
+            except BaseException:
+                # the popped slot must not vanish with the exception:
+                # retire it as an engine error so request conservation
+                # holds, then surface the failure
+                if self.metrics is not None:
+                    self.metrics.observe_request(req, outcome="error")
+                with self._lock:
+                    self._counts["admit_error"] = (
+                        self._counts.get("admit_error", 0) + 1)
+                raise
+            if not admitted:
                 self.queue.requeue_front([req])
                 with self._lock:
                     self._counts["admit_deferred"] += 1
@@ -246,7 +258,10 @@ class ContinuousBatcher:
                 self.on_retire(req)
         return victims
 
-    def drain(self, engine_step, max_iterations: int = 10000) -> int:
+    def drain(self,
+              engine_step: Callable[[List[Request]],
+                                    List[Tuple[int, bool]]],
+              max_iterations: int = 10000) -> int:
         """Run to empty WITHOUT admitting new work (graceful shutdown):
         returns iterations used. Raises if the batch does not empty —
         a hung drain must fail loudly, not spin."""

@@ -33,7 +33,7 @@ exactly.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,8 @@ from .batching import Request
 from .kv_cache import KvCacheFull, PagedKvCache
 
 
-def _rope_rows(x, positions, base: float = 10000.0):
+def _rope_rows(x: jnp.ndarray, positions: jnp.ndarray,
+               base: float = 10000.0) -> jnp.ndarray:
     """Rotary embedding with PER-ROW positions: x [B, S, H, D],
     positions [B, S]. Training's shared ``arange`` (ops.nn.rope) does not
     apply to a mixed decode batch where every sequence sits at its own
@@ -57,17 +58,18 @@ def _rope_rows(x, positions, base: float = 10000.0):
                            axis=-1)
 
 
-def _qkv(layer, h):
+def _qkv(layer: Dict[str, Any], h: jnp.ndarray
+         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The mha projections with the head axis explicit (ops.nn.mha_init
     layout: kernels are [dim, heads, head_dim])."""
-    def proj(p):
+    def proj(p: Dict[str, Any]) -> jnp.ndarray:
         return jnp.einsum("bsd,dhk->bshk", h, p["kernel"]) + p["bias"]
 
     attn = layer["attn"]
     return proj(attn["q"]), proj(attn["k"]), proj(attn["v"])
 
 
-def _ffn(layer, x):
+def _ffn(layer: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
     from ..ops import nn
 
     z = nn.layernorm(layer["ln2"], x, dtype=jnp.float32)
@@ -86,10 +88,11 @@ class ServingEngine:
     cache and is out of scope for this engine.
     """
 
-    def __init__(self, params, config: Dict, max_batch: int = 8,
+    def __init__(self, params: Any, config: Dict, max_batch: int = 8,
                  prompt_pad: int = 32, num_blocks: int = 256,
                  block_size: int = 16, attn: str = "paged",
-                 eos_id: Optional[int] = None, label: str = "serve"):
+                 eos_id: Optional[int] = None, label: str = "serve"
+                 ) -> None:
         if attn not in ("paged", "reference"):
             raise ValueError("attn must be paged|reference, got %r" % attn)
         if config.get("moe_experts"):
@@ -124,6 +127,13 @@ class ServingEngine:
             raise ValueError(
                 "request %s needs %d tokens > max_seq %d"
                 % (req.request_id, need, self.config["max_seq"]))
+        # validate the prompt BEFORE reserving: _prefill rejecting an
+        # oversized/empty prompt after alloc_sequence succeeded would
+        # leak the reservation (the request never reaches retire)
+        if not 0 < len(req.prompt) <= self.prompt_pad:
+            raise ValueError(
+                "request %s prompt length %d outside (0, %d]"
+                % (req.request_id, len(req.prompt), self.prompt_pad))
         try:
             self.cache.allocator.alloc_sequence(
                 req.request_id, need, live_tokens=len(req.prompt))
@@ -137,12 +147,13 @@ class ServingEngine:
 
     # -- step builders ---------------------------------------------------
 
-    def _build_prefill(self):
+    def _build_prefill(self) -> Callable[..., Any]:
         from .. import compile_cache
 
         pad = self.prompt_pad
 
-        def prefill(params, ids, length):
+        def prefill(params: Any, ids: jnp.ndarray,
+                    length: jnp.ndarray) -> Any:
             """ids [1, pad] zero-padded, length [] int32 -> (first
             sampled token [] int32, [k per layer], [v per layer]) with
             k/v shaped [pad, H, Dh] (callers slice to the real length).
@@ -182,15 +193,17 @@ class ServingEngine:
             prefill, ex, config=dict(self.config, prompt_pad=pad),
             label="%s-prefill" % self.label)
 
-    def _build_decode(self):
+    def _build_decode(self) -> Callable[..., Any]:
         from .. import compile_cache
 
         attn = self.attn
         bs = self.cache.allocator.block_size
         dummy = self.cache.dummy_page
 
-        def decode(params, k_pages, v_pages, tokens, positions, tables,
-                   lens, live):
+        def decode(params: Any, k_pages: Any, v_pages: Any,
+                   tokens: jnp.ndarray, positions: jnp.ndarray,
+                   tables: jnp.ndarray, lens: jnp.ndarray,
+                   live: jnp.ndarray) -> Any:
             """One token for every row: tokens [B] int32 (each row's
             last sampled token), positions [B] (its 0-based index),
             tables [B, T], lens [B] (live cache tokens BEFORE this

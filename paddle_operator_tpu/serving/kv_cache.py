@@ -24,7 +24,7 @@ detector both enforce it).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 class KvCacheFull(Exception):
@@ -45,7 +45,7 @@ class KvBlockAllocator:
       ``waste == Σ (len(table) * block_size - seq_len)``.
     """
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int) -> None:
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
         self.num_blocks = num_blocks
@@ -223,7 +223,7 @@ class PagedKvCache:
     """
 
     def __init__(self, num_blocks: int, block_size: int, layers: int,
-                 heads: int, head_dim: int, dtype=None):
+                 heads: int, head_dim: int, dtype: Any = None) -> None:
         import jax.numpy as jnp
 
         self.allocator = KvBlockAllocator(num_blocks, block_size)
@@ -238,7 +238,8 @@ class PagedKvCache:
         self.k_pages = [jnp.zeros(shape, dtype) for _ in range(layers)]
         self.v_pages = [jnp.zeros(shape, dtype) for _ in range(layers)]
 
-    def write_prefill(self, seq_id: str, layer: int, k, v) -> None:
+    def write_prefill(self, seq_id: str, layer: int,
+                      k: Any, v: Any) -> None:
         """Store a prefill's K/V ([S, H, D]) into the sequence's pages."""
         bs = self.allocator.block_size
         table = self.allocator.block_table(seq_id)
@@ -253,7 +254,8 @@ class PagedKvCache:
             self.v_pages[layer] = self.v_pages[layer].at[
                 block, :n].set(v[lo:lo + n])
 
-    def write_token(self, seq_id: str, layer: int, k, v) -> None:
+    def write_token(self, seq_id: str, layer: int,
+                    k: Any, v: Any) -> None:
         """Store one decode step's K/V ([H, D]) at the sequence's current
         last slot (call AFTER allocator.append_token)."""
         bs = self.allocator.block_size

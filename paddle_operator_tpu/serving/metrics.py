@@ -24,7 +24,7 @@ Two integrations ride along:
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..k8s.runtime import escape_label_value
 from ..obs.exposition import format_float
@@ -37,7 +37,7 @@ LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0,
 
 #: every legal value of the ``outcome`` label on requests_total
 OUTCOMES = ("ok", "shed_reject_new", "shed_drop_oldest", "shed_overflow",
-            "preempted")
+            "preempted", "error")
 
 #: (family, help, type) registry for the latency histograms — literal
 #: tuples so the source-level OPS401-403 passes see the declarations
@@ -59,8 +59,9 @@ class ServeMetrics:
     badput against that job.
     """
 
-    def __init__(self, job: str = "default/serve", ledger=None,
-                 namespace: str = "", name: str = ""):
+    def __init__(self, job: str = "default/serve",
+                 ledger: Optional[Any] = None,
+                 namespace: str = "", name: str = "") -> None:
         self.job = job
         self._ledger = ledger
         self._ns = namespace

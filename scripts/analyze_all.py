@@ -213,6 +213,7 @@ def main(argv=None) -> int:
         rc |= _run_optional_tool("mypy", [
             "mypy", "paddle_operator_tpu/api", "paddle_operator_tpu/analysis",
             "paddle_operator_tpu/sched", "paddle_operator_tpu/obs",
+            "paddle_operator_tpu/serving", "paddle_operator_tpu/artifacts",
             "scripts", "bench.py",
         ], report["findings"]) and 1
         rc |= _run_optional_tool("ruff", [

@@ -309,9 +309,11 @@ def selftest_artifact_text():
                 fh.write(bytes(raw))
             store.fetch(fp)   # local poisoned reject -> remote hit
             lease = store.acquire_compile_lease(fp)
-            assert lease.granted
-            assert not store.acquire_compile_lease(fp).granted
-            lease.release()
+            try:
+                assert lease.granted
+                assert not store.acquire_compile_lease(fp).granted
+            finally:
+                lease.release()
             # a poisoned PUT must be rejected server-side
             code, _ = store._http("PUT", "/v1/artifact?fp=%s" % fp,
                                   body=b"garbage not a bundle")

@@ -39,19 +39,33 @@ if _RACE_MODE:
 
     _racedetect.install()
 
+# Runtime resource-leak tracking (the dynamic half of OPS10xx):
+# TPUJOB_LEAK_TRACK=1 wraps every acquire/release pair declared
+# runtime=True in analysis/resources.py BEFORE test modules import the
+# package, recording a creation site per live resource. The session
+# fails on anything still held at teardown (see docs/static-analysis.md).
+_LEAK_MODE = bool(os.environ.get("TPUJOB_LEAK_TRACK"))
+if _LEAK_MODE:
+    from paddle_operator_tpu.analysis import leaktrack as _leaktrack
+
+    _leaktrack.install()
+
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
-    if not _RACE_MODE:
-        return
-    rep = _racedetect.race_report()
-    terminalreporter.section("race detector (TPUJOB_RACE_DETECT)")
-    terminalreporter.write_line(rep.render())
+    if _RACE_MODE:
+        rep = _racedetect.race_report()
+        terminalreporter.section("race detector (TPUJOB_RACE_DETECT)")
+        terminalreporter.write_line(rep.render())
+    if _LEAK_MODE:
+        lrep = _leaktrack.leak_report()
+        terminalreporter.section("leak tracker (TPUJOB_LEAK_TRACK)")
+        terminalreporter.write_line(lrep.render())
 
 
 def pytest_sessionfinish(session, exitstatus):
-    if not _RACE_MODE:
-        return
-    if _racedetect.race_report().failed:
+    if _RACE_MODE and _racedetect.race_report().failed:
+        session.exitstatus = max(int(exitstatus) or 0, 1)
+    if _LEAK_MODE and _leaktrack.leak_report().failed:
         session.exitstatus = max(int(exitstatus) or 0, 1)
 
 

@@ -802,3 +802,17 @@ class TestArtifactPoisonScenario:
         b = run_scenario("artifact_poison", 1, quick=True)
         assert a.violations == [] and b.violations == []
         assert a.fingerprint() == b.fingerprint()
+
+
+def test_merge_write_cleans_tmp_on_non_oserror(tmp_path, monkeypatch):
+    """A pack() failure mid-write (not an OSError) must still remove
+    the torn tmp before propagating — the OPS10xx tmp_file contract."""
+
+    def exploding_pack(fingerprint, members):
+        raise RuntimeError("pack blew up mid-serialize")
+
+    monkeypatch.setattr(bundle, "pack", exploding_pack)
+    target = str(tmp_path / "tier" / ("x" + bundle.SUFFIX))
+    with pytest.raises(RuntimeError):
+        bundle.merge_write(target, FP, {"aot": b"exe"})
+    assert os.listdir(os.path.dirname(target)) == []

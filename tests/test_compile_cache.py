@@ -343,3 +343,86 @@ class TestTrainStepIntegration:
         # the memo'd step still trains
         state, m = step2(state, batch)
         assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle regressions: the OPS10xx-found leaks stay fixed
+# ---------------------------------------------------------------------------
+
+class TestFleetRungLeaseSafety:
+    def test_lease_released_when_under_lease_refetch_raises(self, tmp_path):
+        """An exception between lease grant and handoff must release the
+        lease — stranding the fingerprint makes every peer wait out the
+        TTL (the PR 15 bug class)."""
+
+        class Lease:
+            granted = True
+            released = False
+
+            def release(self):
+                self.released = True
+
+        class Store:
+            wait_s = 5.0
+
+            def __init__(self):
+                self.lease = Lease()
+                self.fetches = 0
+
+            def fetch(self, fp, member=None):
+                self.fetches += 1
+                if self.fetches == 1:
+                    return None, None  # pre-lease miss
+                raise RuntimeError("store exploded under the lease")
+
+            def acquire_compile_lease(self, fp):
+                return self.lease
+
+        store = Store()
+        with pytest.raises(RuntimeError):
+            compile_cache._fleet_rung(store, "cd" * 16,
+                                      str(tmp_path / "x.aotx"), "t")
+        assert store.lease.released
+
+    def test_try_save_aot_removes_torn_tmp_on_mid_write_failure(
+            self, tmp_path, monkeypatch):
+        import types
+
+        import jax.experimental.serialize_executable as se
+
+        monkeypatch.setattr(se, "serialize",
+                            lambda compiled: (b"payload", None, None))
+
+        def exploding_dump(obj, fh):
+            fh.write(b"torn")
+            raise RuntimeError("disk hiccup mid-pickle")
+
+        monkeypatch.setattr(
+            compile_cache, "pickle",
+            types.SimpleNamespace(dump=exploding_dump))
+        path = str(tmp_path / "step.aotx")
+        assert compile_cache._try_save_aot(path, object()) is False
+        assert os.listdir(str(tmp_path)) == []  # no torn tmp accreted
+
+    def test_step_cost_helpers_degrade_when_store_raises(
+            self, cache_dir, monkeypatch):
+        """load/save_step_cost are declared never-raise surfaces
+        (OPS1004): a poisoned/broken fleet store is a miss, not a
+        failure of the run."""
+        from paddle_operator_tpu import artifacts
+
+        class PoisonStore:
+            def fetch(self, fp, member=None):
+                raise RuntimeError("poisoned bundle rejected")
+
+            def publish(self, fp, members):
+                raise RuntimeError("endpoint refused the publish")
+
+        monkeypatch.setattr(artifacts, "get_store", lambda: PoisonStore())
+        fp = "ee" * 16
+        assert compile_cache.load_step_cost(fp) is None
+        compile_cache.save_step_cost(fp, {"flops": 1.0, "bytes": 2.0,
+                                          "source": "probe"})
+        # the local sidecar still landed; only the fleet half degraded
+        assert compile_cache.load_step_cost(fp) == {
+            "flops": 1.0, "bytes": 2.0, "source": "probe"}

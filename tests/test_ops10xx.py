@@ -1,0 +1,497 @@
+"""The OPS10xx resource-lifecycle family analyzed: every rule must
+catch its planted bug and stay quiet on the clean twin — purely by
+parsing (no fixture here imports jax), with the one deliberate
+exception at the bottom: the PR 15 lease-leak plant is ALSO executed
+against a real local-tier :class:`ArtifactStore` under a private
+leaktrack registry, and the dynamic report must carry the same
+``path:line`` creation-site label the static OPS1001 finding anchors
+to. Two checkers, one identity.
+
+Fixture modules are inline source strings, each pair differing only in
+the planted defect, mirroring tests/test_ops9xx.py.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from paddle_operator_tpu.analysis import (
+    dataflow, engine, leaktrack, opslint, ops10xx, resources)
+from paddle_operator_tpu.analysis.ops10xx import make_passes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def run10(src, path="fixture.py"):
+    return dataflow.analyze_source(src, make_passes(), path)
+
+
+def _write_tree(tmp_path, files):
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return [str(tmp_path / name) for name in files]
+
+
+def test_ops10xx_rules_are_registered():
+    for rule in ("OPS1001", "OPS1002", "OPS1003", "OPS1004"):
+        assert rule in opslint.RULES
+        assert rule in engine.ALL_RULES
+        assert engine.family_of(rule) == "dataflow"
+
+
+# ---------------------------------------------------------------------------
+# OPS1001 — the PR 15 lease-leak shape: exception between grant and
+# release strands the fingerprint until the TTL expires
+# ---------------------------------------------------------------------------
+
+OPS1001_LEASE_PLANT = '''\
+def compile_step(store, fp, lower):
+    lease = store.acquire_compile_lease(fp)
+    if lease.granted:
+        compiled = lower(fp)
+        store.publish(fp, compiled)
+        lease.release()
+        return compiled
+    return None
+'''
+
+OPS1001_LEASE_CLEAN = '''\
+def compile_step(store, fp, lower):
+    lease = store.acquire_compile_lease(fp)
+    if lease.granted:
+        try:
+            compiled = lower(fp)
+            store.publish(fp, compiled)
+        finally:
+            lease.release()
+        return compiled
+    return None
+'''
+
+
+def test_ops1001_lease_leak_on_exception_path():
+    findings = [f for f in run10(OPS1001_LEASE_PLANT)
+                if f.rule == "OPS1001"]
+    assert len(findings) == 1
+    # anchored at the ACQUIRE, not the raiser: the fix site and the
+    # runtime creation-site fingerprint are both the acquire line
+    assert findings[0].line == 2
+    assert "compile lease" in findings[0].message
+    assert findings[0].symbol == "compile_lease.compile_step"
+
+
+def test_ops1001_finallyd_twin_is_clean():
+    assert "OPS1001" not in rules_of(run10(OPS1001_LEASE_CLEAN))
+
+
+OPS1001_EXIT_PLANT = '''\
+def snapshot(path, payload):
+    fh = open(path, "w")
+    fh.write(payload)
+    return path
+'''
+
+OPS1001_EXIT_CLEAN = '''\
+def snapshot(path, payload):
+    with open(path, "w") as fh:
+        fh.write(payload)
+    return path
+'''
+
+
+def test_ops1001_unclosed_handle_vs_with_twin():
+    assert "OPS1001" in rules_of(run10(OPS1001_EXIT_PLANT))
+    assert "OPS1001" not in rules_of(run10(OPS1001_EXIT_CLEAN))
+
+
+OPS1001_THREAD_PLANT = '''\
+import threading
+
+
+def run_worker(fn, arg):
+    t = threading.Thread(target=fn, args=(arg,))
+    t.start()
+    fn(arg)
+    t.join(timeout=5)
+'''
+
+# daemon=True is fire-and-forget by contract: no lifecycle duty opens
+# (the runtime tracker applies the same exemption via its probe)
+OPS1001_THREAD_DAEMON_OK = OPS1001_THREAD_PLANT.replace(
+    "args=(arg,))", "args=(arg,), daemon=True)")
+
+
+def test_ops1001_foreground_thread_vs_daemon_exemption():
+    assert "OPS1001" in rules_of(run10(OPS1001_THREAD_PLANT))
+    assert "OPS1001" not in rules_of(run10(OPS1001_THREAD_DAEMON_OK))
+
+
+# ---------------------------------------------------------------------------
+# OPS1002 — double release on one path (and the idempotent exemption)
+# ---------------------------------------------------------------------------
+
+OPS1002_PLANT = '''\
+def drain_one(lock, jobs):
+    lock.acquire()
+    jobs.append(1)
+    lock.release()
+    lock.release()
+'''
+
+OPS1002_CLEAN = '''\
+def drain_one(lock, jobs):
+    lock.acquire()
+    jobs.append(1)
+    lock.release()
+'''
+
+# CompileLease.release is a documented no-op the second time:
+# idempotent_release on the spec keeps OPS1002 quiet here.
+OPS1002_IDEMPOTENT_OK = '''\
+def shutdown_lease(store, fp):
+    lease = store.acquire_compile_lease(fp)
+    lease.release()
+    lease.release()
+'''
+
+
+def test_ops1002_double_release_and_idempotent_exemption():
+    hits = [f for f in run10(OPS1002_PLANT) if f.rule == "OPS1002"]
+    assert len(hits) == 1 and hits[0].line == 5
+    assert "OPS1002" not in rules_of(run10(OPS1002_CLEAN))
+    assert "OPS1002" not in rules_of(run10(OPS1002_IDEMPOTENT_OK))
+
+
+# ---------------------------------------------------------------------------
+# OPS1003 — release after ownership escaped (dead handle for the owner)
+# ---------------------------------------------------------------------------
+
+OPS1003_PLANT = '''\
+def adopt(store, fp, registry):
+    lease = store.acquire_compile_lease(fp)
+    registry.append(lease)
+    lease.release()
+'''
+
+# storing WITHOUT the release is an ownership transfer — clean.
+OPS1003_CLEAN = '''\
+def handoff(store, fp, registry):
+    lease = store.acquire_compile_lease(fp)
+    registry.append(lease)
+'''
+
+OPS1003_RETURN_PLANT = '''\
+def lend(store, fp):
+    lease = store.acquire_compile_lease(fp)
+    try:
+        return lease
+    finally:
+        lease.release()
+'''
+
+
+def test_ops1003_escape_then_release():
+    hits = [f for f in run10(OPS1003_PLANT) if f.rule == "OPS1003"]
+    assert len(hits) == 1 and hits[0].line == 4
+    assert "dead handle" in hits[0].message
+    assert not rules_of(run10(OPS1003_CLEAN)) & {
+        "OPS1001", "OPS1002", "OPS1003"}
+
+
+def test_ops1003_return_through_releasing_finally():
+    assert "OPS1003" in rules_of(run10(OPS1003_RETURN_PLANT))
+
+
+# ---------------------------------------------------------------------------
+# OPS1004 — declared never-raise surface whose raise closure is not empty
+# ---------------------------------------------------------------------------
+
+OPS1004_PLANT_MOD = '''\
+import json
+
+
+def load_step_cost(fingerprint):
+    with open(fingerprint) as fh:
+        return json.load(fh)
+
+
+def save_step_cost(fingerprint, table):
+    try:
+        with open(fingerprint, "w") as fh:
+            json.dump(table, fh)
+    except (OSError, TypeError, ValueError):
+        pass
+'''
+
+OPS1004_CLEAN_MOD = OPS1004_PLANT_MOD.replace(
+    '''def load_step_cost(fingerprint):
+    with open(fingerprint) as fh:
+        return json.load(fh)''',
+    '''def load_step_cost(fingerprint):
+    try:
+        with open(fingerprint) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None''')
+
+
+def test_ops1004_contract_fires_on_propagating_surface(tmp_path):
+    # the contract table anchors to repo-relative paths, so the fixture
+    # tree impersonates the contracted module
+    paths = _write_tree(tmp_path, {
+        "paddle_operator_tpu/compile_cache.py": OPS1004_PLANT_MOD})
+    findings = engine.run_all(paths, root=str(tmp_path))
+    hits = [f for f in findings if f.rule == "OPS1004"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "never_raise.load_step_cost"
+    # the message carries the residual closure AND a witness raiser
+    assert "OSError" in hits[0].message
+    assert "cache degrade" in hits[0].message
+
+
+def test_ops1004_contained_surface_is_discharged(tmp_path):
+    paths = _write_tree(tmp_path, {
+        "paddle_operator_tpu/compile_cache.py": OPS1004_CLEAN_MOD})
+    findings = engine.run_all(paths, root=str(tmp_path))
+    assert "OPS1004" not in rules_of(findings)
+    # both contracted functions exist -> no staleness either
+    assert not [f for f in findings
+                if f.symbol.startswith("neverraise.")]
+
+
+def test_ops1004_stale_contract_is_ops001(tmp_path):
+    # save_step_cost deleted from the contracted module: the table must
+    # be flagged stale, not silently vacuous
+    only_load = OPS1004_CLEAN_MOD.split("def save_step_cost")[0]
+    paths = _write_tree(tmp_path, {
+        "paddle_operator_tpu/compile_cache.py": only_load})
+    findings = engine.run_all(paths, root=str(tmp_path))
+    stale = [f for f in findings if f.symbol == "neverraise.save_step_cost"]
+    assert len(stale) == 1 and stale[0].rule == "OPS001"
+
+
+def test_never_raise_contracts_discharged_nonvacuously_on_real_tree():
+    contracts = ops10xx.prove_contracts(
+        [os.path.join(REPO, "paddle_operator_tpu")], root=REPO)
+    # non-vacuous: the surfaces exist and include the ledger-costing and
+    # compile-cache-degrade contracts the issue names
+    assert {"load_step_cost", "save_step_cost",
+            "BadputPredictor.predict",
+            "FeedbackController.evict_cost"} <= set(contracts)
+    # discharged: every declared surface has an EMPTY residual closure
+    assert all(residual == [] for residual in contracts.values()), contracts
+
+
+# ---------------------------------------------------------------------------
+# spec self-audit: anchors must keep naming real symbols
+# ---------------------------------------------------------------------------
+
+def test_stale_resource_spec_anchor_is_ops001(tmp_path, monkeypatch):
+    ghost = resources.ResourceSpec(
+        "ghost_handle", "ghost handle",
+        acquire=("acquire_ghost",), release=("drop_ghost",),
+        binds="result", anchor=("mod.py", "Ghost.acquire_ghost"))
+    monkeypatch.setattr(resources, "SPECS", resources.SPECS + (ghost,))
+    monkeypatch.setattr(ops10xx, "SPECS", ops10xx.SPECS + (ghost,))
+    paths = _write_tree(tmp_path, {"mod.py": "VERSION = 1\n"})
+    findings = engine.run_all(paths, root=str(tmp_path))
+    stale = [f for f in findings if f.symbol == "resourcespec.ghost_handle"]
+    assert len(stale) == 1 and stale[0].rule == "OPS001"
+
+
+# ---------------------------------------------------------------------------
+# suppression: pragmas work for the new family, stale pragmas are OPS001
+# ---------------------------------------------------------------------------
+
+def test_ops10xx_pragma_suppresses_and_stale_pragma_is_ops001(tmp_path):
+    suppressed = OPS1001_LEASE_PLANT.replace(
+        "    lease = store.acquire_compile_lease(fp)",
+        "    lease = store.acquire_compile_lease(fp)"
+        "  # opslint: disable=OPS1001 (fixture: leak is the point)")
+    paths = _write_tree(tmp_path, {"mod.py": suppressed})
+    findings = engine.run_all(paths, root=str(tmp_path))
+    assert "OPS1001" not in rules_of(findings)
+
+    stale = OPS1001_LEASE_CLEAN.replace(
+        "            lease.release()",
+        "            lease.release()"
+        "  # opslint: disable=OPS1002 (nothing fires here)")
+    paths = _write_tree(tmp_path, {"stale.py": stale})
+    findings = engine.run_all(paths, root=str(tmp_path))
+    assert "OPS1002" not in rules_of(findings)
+    assert any(f.rule == "OPS001" and "OPS1002" in f.message
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# determinism + incremental mode for the new family
+# ---------------------------------------------------------------------------
+
+def test_ops10xx_reports_are_deterministic(tmp_path):
+    files = {"a_plant1001.py": OPS1001_LEASE_PLANT,
+             "b_plant1002.py": OPS1002_PLANT,
+             "c_plant1003.py": OPS1003_PLANT,
+             "d_clean.py": OPS1001_LEASE_CLEAN}
+    paths = _write_tree(tmp_path, files)
+    outs = []
+    for _ in range(2):
+        findings = engine.run_all(paths, root=str(tmp_path))
+        outs.append(json.dumps(
+            [[f.rule, f.path, f.line, f.symbol, f.fingerprint(),
+              f.message] for f in findings]))
+    assert outs[0] == outs[1]
+    assert {"OPS1001", "OPS1002", "OPS1003"} <= {
+        row[0] for row in json.loads(outs[0])}
+
+
+def test_incremental_equals_full_for_ops10xx(tmp_path):
+    files = {"plant1001.py": OPS1001_LEASE_PLANT,
+             "plant1003.py": OPS1003_PLANT,
+             "clean.py": OPS1001_LEASE_CLEAN}
+    paths = _write_tree(tmp_path, files)
+    full = engine.run_all(paths, root=str(tmp_path))
+    assert {"OPS1001", "OPS1003"} <= rules_of(full)
+    for changed in (["plant1001.py"], ["plant1003.py"],
+                    ["plant1001.py", "clean.py"]):
+        partial = engine.run_all(paths, root=str(tmp_path),
+                                 report_paths=set(changed))
+        want = [f for f in full if f.path in set(changed)]
+        assert [(f.rule, f.path, f.line, f.symbol, f.message)
+                for f in partial] == \
+            [(f.rule, f.path, f.line, f.symbol, f.message) for f in want]
+
+
+def test_analyze_changed_covers_serving_diff(tmp_path, monkeypatch):
+    import scripts.analyze_all as aa
+
+    # a diff touching serving/ runs the dataflow family (which now
+    # includes OPS10xx) over the real tree and stays clean
+    monkeypatch.setattr(
+        aa, "changed_files",
+        lambda repo=None, ref="HEAD": {
+            "paddle_operator_tpu/serving/batching.py"})
+    out = str(tmp_path / "report.json")
+    rc = aa.main(["--changed", "--skip-tools", "--no-baseline",
+                  "--out", out, "--budget-seconds", "0"])
+    assert rc == 0
+    with open(out) as fh:
+        payload = json.load(fh)
+    assert payload["findings"] == []
+    # and the no-op path: nothing changed -> instant clean exit
+    monkeypatch.setattr(aa, "changed_files",
+                        lambda repo=None, ref="HEAD": set())
+    assert aa.main(["--changed", "--skip-tools", "--no-baseline",
+                    "--budget-seconds", "0"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime leak tracker: census bookkeeping and liveness probes
+# ---------------------------------------------------------------------------
+
+def test_leaktrack_registry_census_and_probe():
+    reg = leaktrack.Registry()
+    reg.track("queue_slot", ("req-1",), ("tests/x.py", 10))
+    reg.track("file_handle", (1,), ("tests/x.py", 11),
+              probe=lambda: False)  # already closed: not a leak
+    rep = leaktrack.leak_report(reg)
+    assert rep.failed
+    assert [r.spec for r in rep.live] == ["queue_slot"]
+    assert rep.census == {
+        "file_handle": {"acquired": 1, "live": 0},
+        "queue_slot": {"acquired": 1, "live": 1},
+    }
+    reg.untrack("queue_slot", ("req-1",))
+    reg.untrack("queue_slot", ("req-1",))  # idempotent by design
+    assert not leaktrack.leak_report(reg).failed
+    assert "census" in rep.render()
+
+
+def test_leaktrack_covers_every_runtime_spec():
+    names = {s.name for s in resources.runtime_specs()}
+    assert names == set(leaktrack._TRACKERS)
+    assert "compile_lease" in names and "queue_slot" in names
+
+
+# ---------------------------------------------------------------------------
+# static <-> dynamic cross-check: the SAME PR 15 plant, one identity
+# ---------------------------------------------------------------------------
+
+def _swap_in_registry():
+    """Activate a private registry without disturbing a session-level
+    install (conftest under TPUJOB_LEAK_TRACK=1)."""
+    was_installed = leaktrack._installed
+    prev = leaktrack._registry
+    reg = leaktrack.Registry()
+    leaktrack.install(reg)
+    return reg, prev, was_installed
+
+
+def _restore_registry(prev, was_installed):
+    leaktrack._registry = prev
+    if not was_installed:
+        leaktrack.uninstall()
+
+
+def test_ops1001_fingerprint_matches_runtime_leaktrack(tmp_path):
+    from paddle_operator_tpu.artifacts.store import ArtifactStore
+
+    # the fixture lives under a "tests/" segment so the runtime
+    # creation-site label (marker-trimmed, racedetect-style) and the
+    # static repo-relative finding path are the same string
+    fdir = tmp_path / "tests"
+    fdir.mkdir()
+    fpath = fdir / "leak_fixture.py"
+    fpath.write_text(OPS1001_LEASE_PLANT)
+
+    findings = engine.run_all([str(fpath)], root=str(tmp_path))
+    leaks = [f for f in findings if f.rule == "OPS1001"]
+    assert len(leaks) == 1
+    static_site = "%s:%d" % (leaks[0].path, leaks[0].line)
+    assert re.fullmatch(r"tests/leak_fixture\.py:\d+", static_site)
+
+    reg, prev, was_installed = _swap_in_registry()
+    try:
+        ns = {}
+        exec(compile(OPS1001_LEASE_PLANT, str(fpath), "exec"), ns)
+
+        def exploding_lower(fp):
+            raise RuntimeError("lowering blew up mid-compile")
+
+        store = ArtifactStore(local_dir=str(tmp_path / "artifacts"))
+        with pytest.raises(RuntimeError):
+            ns["compile_step"](store, "f" * 64, exploding_lower)
+        rep = leaktrack.leak_report(reg)
+        assert rep.failed
+        runtime_sites = {r.label for r in rep.live
+                         if r.spec == "compile_lease"}
+        assert runtime_sites == {static_site}
+    finally:
+        _restore_registry(prev, was_installed)
+
+
+def test_finallyd_twin_is_clean_at_runtime_too(tmp_path):
+    from paddle_operator_tpu.artifacts.store import ArtifactStore
+
+    reg, prev, was_installed = _swap_in_registry()
+    try:
+        ns = {}
+        exec(compile(OPS1001_LEASE_CLEAN, "leak_fixture_clean.py",
+                     "exec"), ns)
+        store = ArtifactStore(local_dir=str(tmp_path / "artifacts"))
+        with pytest.raises(RuntimeError):
+            ns["compile_step"](store, "f" * 64,
+                               lambda fp: (_ for _ in ()).throw(
+                                   RuntimeError("boom")))
+        live = [r for r in leaktrack.leak_report(reg).live
+                if r.spec == "compile_lease"]
+        assert live == []
+    finally:
+        _restore_registry(prev, was_installed)

@@ -559,3 +559,54 @@ def test_serving_brownout_single_seed_and_deterministic():
     assert report.extra["cold_compiles"] == 1
     replay = run_scenario("serving_brownout", 3, quick=True)
     assert replay.fingerprint() == report.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# exception-path conservation: the OPS10xx-found leaks stay fixed
+# ---------------------------------------------------------------------------
+
+def test_batcher_admit_hook_raise_conserves_the_popped_request():
+    """A raising on_admit must not vanish the popped queue slot: the
+    request is retired as an engine error (conservation holds) and the
+    failure still surfaces."""
+    from paddle_operator_tpu.serving.metrics import ServeMetrics
+
+    m = ServeMetrics(job="t/conserve")
+
+    def exploding_admit(req):
+        raise RuntimeError("kv accounting broke mid-admit")
+
+    q, b, _ = _batcher(metrics=m, on_admit=exploding_admit)
+    q.submit(_req(0))
+    with pytest.raises(RuntimeError):
+        b.step(_step_n(1))
+    assert b.counts()["admit_error"] == 1
+    assert m.counts()["requests_error"] == 1
+    assert 'outcome="error"' in m.metrics_block()
+    # not half-admitted anywhere: neither active nor back in the queue
+    assert b.counts()["completed"] == 0 and q.depth() == 0
+
+
+def test_engine_admit_validates_prompt_before_reserving_kv():
+    """An invalid prompt must be rejected BEFORE alloc_sequence: a
+    post-alloc reject would leak the reservation (the request never
+    reaches retire)."""
+    import jax
+
+    from paddle_operator_tpu.models import gpt
+    from paddle_operator_tpu.serving.engine import ServingEngine
+
+    cfg = dict(gpt.TINY_CONFIG)
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, max_batch=2, prompt_pad=8,
+                        num_blocks=16, block_size=4, attn="reference",
+                        label="test-admit-validate")
+    for bad_prompt in ([], [1] * 9):
+        with pytest.raises(ValueError):
+            eng.admit(Request("bad", prompt=bad_prompt, max_new_tokens=2))
+    assert eng.cache.allocator.stats()["blocks_used"] == 0
+    ok = Request("ok", prompt=[1, 2, 3], max_new_tokens=2)
+    assert eng.admit(ok)
+    assert eng.cache.allocator.stats()["blocks_used"] > 0
+    eng.retire(ok)
+    assert eng.cache.allocator.stats()["blocks_used"] == 0
