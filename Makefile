@@ -4,7 +4,7 @@
 PY ?= python
 IMG ?= ghcr.io/tpujob/operator:v0.1.0
 
-.PHONY: all verify test test-fast analyze race chaos recovery sched obs metrics-lint loadtest startup artifacts serve fleetweek bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
+.PHONY: all verify test test-fast analyze race chaos recovery sched migrate obs metrics-lint loadtest startup artifacts serve fleetweek bench native manifests gen-deploy helm run install deploy docker-build clean notices notices-check
 
 all: native test
 
@@ -16,7 +16,8 @@ all: native test
 # + the serving-plane fast lane (unit tests, one brownout seed, the
 # quick continuous-batching/scale-out/bit-identity bench)
 # + one seed of the fleet_week soak reconstructed from trace alone
-verify: analyze test-fast race recovery sched loadtest startup artifacts serve fleetweek
+# + the live-migration fast lane (MOVE unit suite, one migration_wave seed)
+verify: analyze test-fast race recovery sched migrate loadtest startup artifacts serve fleetweek
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -67,6 +68,7 @@ race:
 	  tests/test_http_client.py tests/test_incidents.py \
 	  tests/test_informer.py \
 	  tests/test_launch_checkpoint.py tests/test_leader_election.py \
+	  tests/test_migration.py \
 	  tests/test_observability.py tests/test_ops9xx.py \
 	  tests/test_ops10xx.py \
 	  tests/test_reconciler.py \
@@ -100,6 +102,17 @@ sched:
 	$(PY) -m pytest tests/test_sched.py tests/test_feedback.py -x -q \
 	  -m "not slow"
 	$(PY) scripts/chaos_stress.py --scenario multi_tenant --seeds 1 --quick
+
+# live-migration fast lane (docs/design.md "Live migration"): the MOVE
+# unit suite (state bundles over the artifact tier, escape/defrag
+# decisions, budget-free execution, every abort path), then one seed of
+# the migration_wave scenario (rolling maintenance drained by MOVEs
+# under traffic + faults: bit-identical loss vs the no-migration replay,
+# bounded blackout fingerprinted as the migrate incident cause, goodput
+# strictly above the evict-and-requeue replay, no capacity leak)
+migrate:
+	$(PY) -m pytest tests/test_migration.py -x -q -m "not slow"
+	$(PY) scripts/chaos_stress.py --scenario migration_wave --seeds 1 --quick
 
 # observability lanes (see docs/observability.md):
 #   obs          — rebuild a failure timeline from a recorded chaos run

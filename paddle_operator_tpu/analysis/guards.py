@@ -108,11 +108,13 @@ SPECS: Tuple[GuardSpec, ...] = (
                "_counters", "_hbm")),
     GuardSpec("paddle_operator_tpu.sched.arbiter", "FleetArbiter", "_lock",
               ("_plan", "_plan_rv", "_plan_t", "_passes", "_preempts",
-               "_shrinks", "_written_np")),
+               "_shrinks", "_migrates", "_written_np")),
     GuardSpec("paddle_operator_tpu.sched.feedback", "FeedbackController",
               "_lock",
               ("_streaks", "_pending", "_remediated", "_boosted",
-               "_counts", "_commits")),
+               "_counts", "_commits", "_mig_pending", "_mig_streaks",
+               "_mig_counts", "_blackout_hist", "_blackout_sum",
+               "_blackout_count")),
     GuardSpec("paddle_operator_tpu.serving.autoscaler", "ServingAutoscaler",
               "_lock", ("_calm_streak", "_decisions")),
     GuardSpec("paddle_operator_tpu.serving.batching", "ContinuousBatcher",

@@ -1,0 +1,150 @@
+"""Checkpoint-state bundles: the artifact tier learns to MOVE a job.
+
+The store (:mod:`.store`) was built to ship *executables* — one
+compile, ten thousand warm starts. Live migration (docs/design.md
+"Live migration") needs the same machinery for *state*: the source's
+final drain checkpoint must reach the destination host through the
+artifact-store HTTP tier, CRC-pinned and verify-not-trust, with no
+shared-filesystem round-trip — while publish-ahead is warming the
+destination's compile in parallel.
+
+This module generalizes the ``.tpuart`` envelope (:mod:`.bundle`) from
+executable members to checkpoint step directories:
+
+* :func:`state_fingerprint` — the name shards stream under. It is a
+  KEY (job identity + step), not a content hash: source and
+  destination must agree on it before the destination has a single
+  byte. Content integrity rides the bundle envelope — per-member CRCs
+  plus the checkpoint's own manifest commit marker, so a poisoned or
+  torn transfer is rejected at the destination (counted with the
+  ordinary poisoned-artifact rejects) and the job falls back to its
+  last durable checkpoint; it can never restore wrong state.
+* :func:`publish_state` — pack one committed ``step_*`` directory
+  (``state.npz`` + ``manifest.json``, or the sharded layout) into
+  members keyed by filename, plus a :data:`MANIFEST_MEMBER` listing,
+  and publish through every configured tier.
+* :func:`fetch_state` — the destination side: a member-scoped GET for
+  the listing first, then each shard member individually (large state
+  streams shard-by-shard over HTTP — the transfer never materializes
+  the whole bundle in one buffer server-side), assembled into the
+  destination checkpoint dir with the same tmp + ``os.rename``
+  discipline ``save_checkpoint`` uses, so a restore never observes a
+  half-fetched step.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, Optional
+
+from .store import ArtifactStore
+
+#: the shard-listing member of a state bundle (leading underscore keeps
+#: it out of any filename namespace a checkpoint writer could produce)
+MANIFEST_MEMBER = "_state_manifest"
+
+#: mirror of utils.checkpoint's step-directory spelling (kept literal
+#: here so artifacts/ stays importable without the jax-adjacent
+#: checkpoint module)
+STEP_DIR_FMT = "step_%012d"
+
+def state_fingerprint(namespace: str, name: str, step: int) -> str:
+    """The store key one job's state-at-step streams under. Pure hex
+    (the server's path guard admits nothing else); the ``state:``
+    domain prefix inside the hash keeps state keys disjoint from
+    compile fingerprints in the shared content-addressed namespace."""
+    return hashlib.sha256(
+        ("state:%s/%s:%d" % (namespace, name, int(step))).encode()
+    ).hexdigest()[:40]
+
+
+def pack_state_dir(step_dir: str) -> Optional[Dict[str, bytes]]:
+    """Members for one committed checkpoint step directory: every
+    regular file keyed by its filename, plus the shard listing. None
+    when the directory is missing/empty (nothing to pre-stage)."""
+    try:
+        names = sorted(os.listdir(step_dir))
+    except OSError:
+        return None
+    members: Dict[str, bytes] = {}
+    for fname in names:
+        path = os.path.join(step_dir, fname)
+        if not os.path.isfile(path):
+            continue
+        with open(path, "rb") as fh:
+            members[fname] = fh.read()
+    if not members:
+        return None
+    listing = {"files": sorted(members),
+               "bytes": sum(len(v) for v in members.values())}
+    members[MANIFEST_MEMBER] = json.dumps(
+        listing, sort_keys=True).encode()
+    return members
+
+
+def publish_state(store: ArtifactStore, namespace: str, name: str,
+                  step: int, ckpt_dir: str) -> Optional[str]:
+    """Pre-stage one committed step: pack ``ckpt_dir/step_<step>`` and
+    publish it under the state fingerprint through every configured
+    tier. Returns the fingerprint, or None when the step directory is
+    not there to pack (the caller falls back to the ordinary
+    resume-from-durable-checkpoint path)."""
+    step_dir = os.path.join(ckpt_dir, STEP_DIR_FMT % int(step))
+    members = pack_state_dir(step_dir)
+    if members is None:
+        return None
+    fp = state_fingerprint(namespace, name, step)
+    store.publish(fp, members)
+    return fp
+
+
+def fetch_state(store: ArtifactStore, fingerprint: str, ckpt_dir: str,
+                step: int) -> Optional[str]:
+    """Destination-side assembly: stream the shard listing, then each
+    shard member, into ``ckpt_dir/step_<step>``. Every member fetch is
+    envelope-verified by the store (CRC-pinned, fingerprint-matched);
+    any miss or poisoned shard aborts the WHOLE assembly — the tmp dir
+    is discarded and None returned, so the restore path can only ever
+    see a complete, verified step (or nothing). Returns the final step
+    directory on success."""
+    got, _tier = store.fetch(fingerprint, member=MANIFEST_MEMBER)
+    if got is None:
+        return None
+    try:
+        listing = json.loads(got[MANIFEST_MEMBER].decode())
+        files = list(listing["files"])
+    except (ValueError, KeyError, TypeError):
+        return None
+    final = os.path.join(ckpt_dir, STEP_DIR_FMT % int(step))
+    if os.path.isdir(final):
+        return final  # already assembled (idempotent re-fetch)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=ckpt_dir, prefix=".prestage_")
+    try:
+        for fname in files:
+            if fname == MANIFEST_MEMBER or os.path.basename(
+                    fname) != fname:
+                return None  # listing names outside the step dir
+            shard, _tier = store.fetch(fingerprint, member=fname)
+            if shard is None:
+                return None  # miss/poison: never a partial restore
+            with open(os.path.join(tmp, fname), "wb") as fh:
+                fh.write(shard[fname])
+        os.rename(tmp, final)
+        tmp = None
+        return final
+    except OSError:
+        return None
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+__all__ = [
+    "MANIFEST_MEMBER", "STEP_DIR_FMT", "fetch_state", "pack_state_dir",
+    "publish_state", "state_fingerprint",
+]

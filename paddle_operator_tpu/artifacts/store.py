@@ -52,6 +52,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import zlib
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from . import bundle
@@ -73,6 +74,12 @@ DEFAULT_LEASE_TTL_S = 300.0
 DEFAULT_WAIT_S = 240.0
 DEFAULT_POLL_S = 0.2
 DEFAULT_HTTP_TIMEOUT_S = 5.0
+#: transient HTTP failures (connection reset, 5xx) get this many
+#: RETRIES on top of the first attempt — one dropped packet mid
+#:-migration must not abort a whole state pre-stage
+DEFAULT_HTTP_RETRIES = 2
+DEFAULT_RETRY_BACKOFF_S = 0.05
+DEFAULT_RETRY_BACKOFF_CAP_S = 1.0
 
 
 def enabled() -> bool:
@@ -129,7 +136,8 @@ class ArtifactStore:
                  lease_ttl_s: Optional[float] = None,
                  wait_s: Optional[float] = None,
                  poll_s: Optional[float] = None,
-                 http_timeout_s: Optional[float] = None) -> None:
+                 http_timeout_s: Optional[float] = None,
+                 http_retries: Optional[int] = None) -> None:
         self.local_dir = local_dir
         self.url = url.rstrip("/")
         self.lease_ttl_s = (lease_ttl_s if lease_ttl_s is not None else
@@ -144,6 +152,11 @@ class ArtifactStore:
         self.http_timeout_s = (http_timeout_s if http_timeout_s is not None
                                else _env_float("TPUJOB_ARTIFACT_HTTP_TIMEOUT",
                                                DEFAULT_HTTP_TIMEOUT_S))
+        self.http_retries = max(0, int(
+            http_retries if http_retries is not None else
+            _env_float("TPUJOB_ARTIFACT_HTTP_RETRIES",
+                       DEFAULT_HTTP_RETRIES)))
+        self.retry_backoff_s = DEFAULT_RETRY_BACKOFF_S
         # hostname:pid:nonce — the nonce distinguishes store instances
         # so a same-holder "refresh" can only come from THIS client
         # (pid reuse / two clients in one process must not alias)
@@ -158,7 +171,7 @@ class ArtifactStore:
         self._stats: Dict[str, float] = {}
         for tier in TIERS:
             for k in ("hits", "misses", "publishes", "poisoned",
-                      "fetch_seconds"):
+                      "fetch_seconds", "retries"):
                 self._stats["%s_%s" % (k, tier)] = 0
         for k in ("lease_granted", "lease_waited", "lease_timeout",
                   "lease_broken"):
@@ -326,18 +339,48 @@ class ArtifactStore:
 
     # -- remote tier -----------------------------------------------------
 
+    def _retry_backoff(self, path: str, attempt: int) -> float:
+        """Deterministic capped-exponential backoff: the jitter is
+        crc32(path#attempt)-derived (the reconciler's ``_backoff_for``
+        pattern) so chaos replays of a flaky-network migration sleep
+        identically, yet concurrent clients de-synchronize."""
+        base = min(self.retry_backoff_s * (2 ** (attempt - 1)),
+                   DEFAULT_RETRY_BACKOFF_CAP_S)
+        salt = zlib.crc32(("%s#%d" % (path, attempt)).encode())
+        return base * (0.5 + 0.5 * (salt % 1000) / 999.0)
+
     def _http(self, method: str, path: str,
               body: Optional[bytes] = None) -> Tuple[int, bytes]:
-        req = urllib.request.Request(self.url + path, data=body,
-                                     method=method)
-        if body is not None:
-            req.add_header("Content-Type", "application/octet-stream")
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=self.http_timeout_s) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            return e.code, e.read()
+        """One HTTP exchange with bounded transient-failure retries:
+        connection-level failures (reset, refused, timeout) and 5xx
+        responses re-try up to ``http_retries`` times with deterministic
+        capped backoff, counted per tier
+        (``tpujob_artifact_fetch_retries_total``); 4xx and other
+        definitive answers return immediately. The last failure
+        propagates exactly as the unretried call would have — callers'
+        degrade-to-miss postures are unchanged."""
+        attempts = self.http_retries + 1
+        for attempt in range(attempts):
+            if attempt:
+                self._bump("retries_remote")
+                time.sleep(self._retry_backoff(path, attempt))
+            req = urllib.request.Request(self.url + path, data=body,
+                                         method=method)
+            if body is not None:
+                req.add_header("Content-Type",
+                               "application/octet-stream")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.http_timeout_s) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                data = e.read()
+                if e.code < 500 or attempt == attempts - 1:
+                    return e.code, data
+            except (urllib.error.URLError, OSError):
+                if attempt == attempts - 1:
+                    raise
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def _remote_fetch(self, fingerprint: str, member: Optional[str] = None
                       ) -> Optional[Dict[str, bytes]]:
@@ -647,6 +690,14 @@ def metrics_text() -> str:
     ]
     lines += ['tpujob_artifact_fetch_seconds{tier="%s"} %.3f'
               % (t, v("fetch_seconds_%s" % t)) for t in TIERS]
+    lines += [
+        "# HELP tpujob_artifact_fetch_retries_total transient HTTP "
+        "failures (connection reset, 5xx) retried with deterministic "
+        "capped backoff, by tier",
+        "# TYPE tpujob_artifact_fetch_retries_total counter",
+    ]
+    lines += ['tpujob_artifact_fetch_retries_total{tier="%s"} %d'
+              % (t, v("retries_%s" % t)) for t in TIERS]
     lines += [
         "# HELP tpujob_artifact_lease_total compile-lease outcomes "
         "(granted = this process compiles; waited = a peer holds the "

@@ -20,6 +20,7 @@ from .artifact_faults import run_artifact_scenario
 from .data_faults import ChaosSourceError, FaultySource, run_loader_scenario
 from .fleetweek import FleetWeekRun, run_fleet_week_scenario
 from .harness import ChaosHarness, ChaosReport, run_scenario
+from .migration import MigrationFleetRun, run_migration_scenario
 from .plan import CONTROL_SCENARIOS, SCENARIOS, ChaosPlan, FaultEvent, \
     build_plan
 from .pod_faults import PodChaos
@@ -30,10 +31,10 @@ from .tenants import TenantFleetRun, run_tenant_scenario
 __all__ = [
     "ChaosHarness", "ChaosKubeClient", "ChaosPlan", "ChaosReport",
     "ChaosSourceError", "CONTROL_SCENARIOS", "FaultEvent", "FaultInjector",
-    "FaultySource", "FleetWeekRun", "PodChaos", "SCENARIOS",
-    "TenantFleetRun",
+    "FaultySource", "FleetWeekRun", "MigrationFleetRun", "PodChaos",
+    "SCENARIOS", "TenantFleetRun",
     "build_plan", "run_artifact_scenario", "run_fleet_week_scenario",
-    "run_loader_scenario",
+    "run_loader_scenario", "run_migration_scenario",
     "run_recovery_scenario", "run_scenario", "run_serving_scenario",
     "run_tenant_scenario",
 ]

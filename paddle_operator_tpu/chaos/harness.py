@@ -796,6 +796,15 @@ def run_scenario(scenario: str, seed: int, quick: bool = True) -> ChaosReport:
         from .fleetweek import run_fleet_week_scenario
 
         return run_fleet_week_scenario(plan)
+    if scenario == "migration_wave":
+        # transparent live migration (chaos.migration): rolling pool
+        # maintenance under traffic/faults handled by MOVEs — escape +
+        # defrag commits audited, blackouts bounded, goodput vs an
+        # evict-and-requeue replay, loss bit-identical to an unmigrated
+        # replay through the artifact-store HTTP tier
+        from .migration import run_migration_scenario
+
+        return run_migration_scenario(plan)
     if scenario == "loader_faults":
         t0 = time.perf_counter()
         injector = FaultInjector()

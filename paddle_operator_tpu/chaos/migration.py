@@ -1,0 +1,847 @@
+"""``migration_wave`` chaos: rolling maintenance becomes a MOVE, proven.
+
+One run = the fleet-scheduler harness (chaos.tenants shape: 2 pools x 4
+hosts x 8 chips on a deterministic tick clock) with the transparent
+live-migration loop wired end to end — FeedbackController escape/defrag
+decisions (sched/feedback.py), the arbiter's :data:`MIGRATE` stamp, the
+reconciler's budget-free MOVE drain, and the ``migrate`` incident cause
+whose MTTR stages must reconcile exactly with its ledger badput episode.
+
+The seeded plan is a **migration wave**: rolling maintenance drains each
+pool in turn under live traffic and faults (a hard preemption between
+the waves, apiserver errors throughout), then a degraded host forces a
+single-job **escape**, and finally a whale needing one *contiguous* pool
+arrives while scavengers sit spread across both — only a **defrag**
+MOVE can admit it. Placement is harness bookkeeping (the control plane
+has no bin-packing model); what is REAL is every decision, annotation,
+drain, budget booking, incident and ledger second.
+
+The same plan replays in ``evict`` mode — the pre-migration operator:
+the identical maintenance/degrade/defrag pressure handled by ordinary
+evict-and-requeue (graceful drain, budget-spending restart, cold
+destination paying a compile charge and warm-up ticks). Invariants on
+the migrated run:
+
+* **bit-identity** — a REAL runner migrated mid-run through the
+  artifact tier (publish_state at the source drain, fetch_state at the
+  destination) finishes with loss bit-identical to an unmigrated
+  replay of the same seed (:func:`run_migration_recovery`);
+* **bounded blackout** — every MOVE's blackout (source down ->
+  destination fully running) is measured, recorded into the feedback
+  histogram, bounded by :data:`BLACKOUT_BOUND` ticks, and part of the
+  deterministic fingerprint;
+* **goodput** — the migrated fleet's ledger goodput ratio strictly
+  beats the evict-and-requeue replay of the same seed;
+* **no capacity leak** — live worker chips never exceed the fleet, and
+  no pool ever holds more hosts than it has, at every tick, in both
+  modes; each pool is vacated by the time its maintenance starts;
+* **conservation** — every ``migrate`` incident closed, and each closed
+  incident's stage sum equals its ledger episode badput exactly;
+* **budget semantics** — scavengers that only ever MOVEd finish with
+  ``preemptionRestarts == 0`` and ``schedPreemptions >= 1`` (the MOVE
+  is budget-free); no lost steps without a hard kill.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as api
+from ..controllers import helper
+from ..k8s.errors import NotFoundError
+from ..k8s.objects import get_controller_of
+from ..sched import ANNOT_ARRIVAL, ANNOT_TENANT_WEIGHT, \
+    FeedbackController, FleetArbiter, make_tpu_node
+from ..testing import OperatorHarness
+from .api_faults import ChaosKubeClient, FaultInjector
+from .harness import ChaosReport, _TickClock
+from .plan import ChaosPlan, MIGRATION_MAINT as MAINT_TICKS, \
+    MIGRATION_NOTICE as MAINT_NOTICE
+from .pod_faults import PodChaos
+
+FLEET_POOLS = 2
+NODES_PER_POOL = 4
+CHIPS_PER_NODE = 8
+FLEET_CHIPS = FLEET_POOLS * NODES_PER_POOL * CHIPS_PER_NODE
+CKPT_EVERY = 4
+DRAIN_GRACE = 2
+#: staleness fed to the price gate: 10 modeled seconds of lost work per
+#: evict-and-requeue, comfortably above MIGRATE_COST_S — the gate is
+#: open whenever there is real signal, exactly like a maintenance drain
+PRICE_STALENESS = 10
+#: the evict-mode destination is COLD: one compile charge plus warm-up
+#: ticks of no progress — the seconds publish-ahead + state pre-staging
+#: delete in migrate mode (the contrast the goodput invariant measures)
+COLD_COMPILE_S = 3.0
+COLD_WARM_TICKS = 2
+#: consecutive unhealthy ticks before the evict-mode replay reacts —
+#: the same hysteresis the escape path uses, so the comparison is fair
+EVICT_WINDOWS = 2
+#: hard bound on any MOVE's blackout, in ticks (drain grace + recreate
+#: + gang-up, with slack for injected apiserver errors)
+BLACKOUT_BOUND = 8
+#: progress divisor while a job sits on its degraded host
+DEGRADED_DIVISOR = 2
+
+
+class MigrationFleetRun:
+    """One mode of one seeded migration_wave run: ``migrate`` (the MOVE
+    loop wired and audited) or ``evict`` (the same pressure handled by
+    ordinary evict-and-requeue — the replay baseline)."""
+
+    def __init__(self, plan: ChaosPlan, mode: str = "migrate"):
+        assert mode in ("migrate", "evict")
+        self.plan = plan
+        self.mode = mode
+        self.injector = FaultInjector()
+        self.clock = _TickClock()
+        self.h = OperatorHarness(
+            client_middleware=lambda c: ChaosKubeClient(c, self.injector),
+            arbiter_factory=self._arbiter_factory,
+            metrics_clock=self.clock)
+        self.h.manager.add_metrics_provider(self.injector.metrics_block)
+        for pool in range(FLEET_POOLS):
+            for node in range(NODES_PER_POOL):
+                self.h.client.create(make_tpu_node(
+                    "tpu-%d-%d" % (pool, node), "pool-%d" % pool,
+                    CHIPS_PER_NODE))
+        self.pod_chaos = PodChaos(self.h.sim, self.h.client, self.injector)
+        self._rng = random.Random("migration-run:%s:%d:%s"
+                                  % (plan.scenario, plan.seed, mode))
+        self.jobs: Dict[str, dict] = {}
+        self._arrival_seq = 0
+        #: active maintenance windows: {"pool", "notice_start",
+        #: "maint_start", "end"}
+        self.waves: List[dict] = []
+        self.cap_violations: List[str] = []
+        self.vacate_violations: List[str] = []
+        #: measured blackouts, in ticks, in completion order (the
+        #: deterministic fingerprint carries them)
+        self.blackouts: List[int] = []
+        self.max_allocated = 0
+        self.cold_charged = 0
+
+    # -- wiring ----------------------------------------------------------
+
+    def _arbiter_factory(self, client, job_metrics):
+        feedback = None
+        if self.mode == "migrate":
+            feedback = FeedbackController(ledger=job_metrics.ledger,
+                                          migrate_enabled=True)
+        return FleetArbiter(
+            client, evictor=self._evict, job_metrics=job_metrics,
+            mode="fair", drain_grace=DRAIN_GRACE,
+            ckpt_info=self._ckpt_info, feedback=feedback)
+
+    def _ckpt_info(self, job: api.TpuJob) -> Optional[dict]:
+        st = self.jobs.get(job.name)
+        if st is None:
+            return None
+        return {"step": st["ckpt"], "progress": st["progress"]}
+
+    def _evict(self, pod: dict, grace: int) -> None:
+        """The graceful-drain channel (arbiter evictions AND the
+        reconciler's MOVE drain ride it): eviction with a grace window,
+        and the runner-side final checkpoint modeled as "everything done
+        so far is kept"."""
+        self.h.sim.preempt(pod["metadata"]["name"], reason="Preempted",
+                           grace_seconds=grace)
+        ref = get_controller_of(pod)
+        st = self.jobs.get(ref["name"] if ref else "")
+        if st is not None:
+            st["ckpt"] = st["progress"]
+            st["drained"] += 1
+
+    @property
+    def feedback(self) -> Optional[FeedbackController]:
+        return self.h.arbiter.feedback if self.h.arbiter else None
+
+    # -- plan events -----------------------------------------------------
+
+    def _submit(self, tick: int, p: dict, whale: bool = False) -> None:
+        hosts = int(p["hosts"])
+        worker = {
+            "replicas": hosts,
+            "requests": hosts,  # min_hosts == hosts: nobody shrinks
+            "template": {"spec": {
+                "containers": [{"name": "main", "image": "img"}],
+                "priorityClassName": "tpu-high" if whale
+                else "tpu-standard",
+            }},
+        }
+        job = api.new_tpujob(p["name"], spec={
+            "device": "tpu",
+            "tpu": {"accelerator": "v5e"},
+            "worker": worker,
+            "elastic": 1,
+        })
+        self._arrival_seq += 1
+        job["metadata"]["annotations"] = {
+            ANNOT_ARRIVAL: str(self._arrival_seq),
+            ANNOT_TENANT_WEIGHT: "1.0",
+        }
+        self.h.create_job(job)
+        self.jobs[p["name"]] = {
+            "hosts": hosts,
+            "chips": hosts * CHIPS_PER_NODE,
+            "duration": int(p["duration"]),
+            "submitted": tick,
+            # placement bookkeeping: the whale arrives unplaced (it
+            # needs one CONTIGUOUS pool); everyone else first-fits
+            "pool": None if whale else self._first_fit(hosts),
+            "whale": whale,
+            "progress": 0, "ckpt": 0, "lost": 0,
+            "drained": 0, "hard_kills": 0,
+            "first_progress": None, "completed": None, "terminal": False,
+            # MOVE state machine: moving -> (gang down: down_tick set)
+            # -> gang fully up at move_dest -> blackout recorded
+            "moving": False, "move_dest": None, "down_tick": None,
+            "commit_base": 0,
+            # degraded-host model (escape target) + evict-mode hysteresis
+            "degraded": False, "deg_host": "", "streak": 0,
+            # evict-mode cold destination: warm-up ticks of no progress
+            "cold": 0, "rate_tick": 0,
+        }
+
+    def _first_fit(self, hosts: int) -> int:
+        for pool in range(FLEET_POOLS):
+            if self._occupied(pool) + hosts <= NODES_PER_POOL:
+                return pool
+        return FLEET_POOLS - 1  # over-subscribed: the audit will say so
+
+    def _occupied(self, pool: int, skip: str = "") -> int:
+        """Hosts a pool is committed to: live jobs placed there plus
+        movers BOUND there (a MOVE in flight must reserve its
+        destination, or the whale grabs a pool mid-handover)."""
+        total = 0
+        for name, st in self.jobs.items():
+            if st["terminal"] or name == skip:
+                continue
+            where = st["move_dest"] if st["moving"] else st["pool"]
+            if where == pool:
+                total += st["hosts"]
+        return total
+
+    def _fire(self, tick: int, ev) -> None:
+        p = ev.params
+        if ev.kind == "job_submit":
+            self._submit(tick, p)
+        elif ev.kind == "whale_submit":
+            self._submit(tick, p, whale=True)
+        elif ev.kind == "pool_maint":
+            pool = int(p["pool"])
+            self.waves.append({
+                "pool": pool, "notice_start": tick,
+                "maint_start": tick + MAINT_NOTICE,
+                "end": tick + MAINT_NOTICE + MAINT_TICKS,
+                "vacate_checked": False,
+            })
+            self.injector.record("pool_maint")
+        elif ev.kind == "host_degrade":
+            st = self.jobs.get(p["job"])
+            if st is not None:
+                st["degraded"] = True
+                st["deg_host"] = "badhost-%s" % p["job"]
+            self.injector.record("host_degrade")
+        elif ev.kind == "pod_preempt":
+            pods = [pod for pod in self._job_pods(p["job"])
+                    if (pod.get("status") or {}).get("phase")
+                    not in ("Failed", "Succeeded")
+                    and not pod["metadata"].get("deletionTimestamp")]
+            if not pods:
+                return
+            pod = pods[self._rng.randrange(len(pods))]
+            self.pod_chaos.preempt(pod)
+            st = self.jobs.get(p["job"])
+            if st is not None:
+                st["hard_kills"] += 1
+                st["lost"] += st["progress"] - st["ckpt"]
+                st["progress"] = st["ckpt"]
+        elif ev.kind == "api_error":
+            self.injector.arm_error(p["code"], count=p.get("count", 1))
+        else:
+            raise ValueError("unknown migration_wave fault %r" % ev.kind)
+
+    def _job_pods(self, name: str) -> List[dict]:
+        try:
+            obj = self.h.client.get(api.KIND, "default", name)
+        except NotFoundError:
+            return []
+        pods = [p for p in self.h.client.list_owned("Pod", obj)
+                if (p["metadata"].get("annotations") or {})
+                .get(api.ANNOT_RESOURCE) == api.RES_WORKER]
+        return sorted(pods, key=lambda p: p["metadata"]["name"])
+
+    # -- the MOVE model ---------------------------------------------------
+
+    def _spare_pool(self, st: dict, avoid: int) -> int:
+        """Where a vacating job lands: the pool that is not ``avoid``
+        when it fits, else wherever fits (the plans are sized so the
+        preferred pool always does)."""
+        prefer = 1 - avoid
+        if self._occupied(prefer) + st["hosts"] <= NODES_PER_POOL:
+            return prefer
+        return avoid
+
+    def _start_move(self, name: str, st: dict, dest: int,
+                    live: List[dict]) -> None:
+        """Evict-mode remedy: the ordinary graceful drain (budget-
+        spending preemption, cold resume). The migrate-mode equivalent
+        is the reconciler's _feedback_migration — here the harness
+        stands in for the loop the baseline does not have."""
+        st["moving"] = True
+        st["move_dest"] = dest
+        st["commit_base"] = -1  # harness-driven, no feedback commit
+        for pod in live:
+            self.h.sim.preempt(pod["metadata"]["name"],
+                               reason="Preempted",
+                               grace_seconds=DRAIN_GRACE)
+        st["ckpt"] = st["progress"]  # graceful: final checkpoint keeps all
+        st["drained"] += 1
+
+    def _feed_signals(self, tick: int, name: str, st: dict,
+                      live: List[dict], gang_up: bool) -> None:
+        """Per-tick decision inputs: maintenance drain notices and the
+        degraded host, fed as unhealthy-host windows (migrate mode) or
+        counted into the same hysteresis window (evict mode)."""
+        if st["moving"] or st["terminal"]:
+            return
+        unhealthy_host = ""
+        in_wave = None
+        for w in self.waves:
+            if w["notice_start"] <= tick < w["end"] \
+                    and st["pool"] == w["pool"]:
+                unhealthy_host = "pool-%d" % w["pool"]
+                in_wave = w
+                break
+        if not unhealthy_host and st["degraded"]:
+            unhealthy_host = st["deg_host"]
+        if not unhealthy_host or not gang_up:
+            return
+        if self.mode == "migrate":
+            fb = self.feedback
+            fb.observe_host_health("default", name, unhealthy_host,
+                                   True, staleness=PRICE_STALENESS)
+        else:
+            st["streak"] += 1
+            if st["streak"] >= EVICT_WINDOWS:
+                st["streak"] = 0
+                avoid = in_wave["pool"] if in_wave is not None \
+                    else st["pool"]
+                self._start_move(name, st, self._spare_pool(st, avoid),
+                                 live)
+
+    def _drive_defrag(self, tick: int) -> None:
+        """The queued whale needs one contiguous pool. When no pool is
+        free, consolidate: pick the pool committed to the fewest hosts
+        and MOVE its scavengers to the other (feedback defrag decisions
+        in migrate mode, ordinary drains in the evict replay)."""
+        whale = next((st for st in self.jobs.values()
+                      if st["whale"] and not st["terminal"]
+                      and st["pool"] is None), None)
+        if whale is None:
+            return
+        occ = [self._occupied(p) for p in range(FLEET_POOLS)]
+        free = [p for p in range(FLEET_POOLS)
+                if occ[p] == 0]
+        if free:
+            whale["pool"] = free[0]
+            return
+        victim_pool = min(range(FLEET_POOLS), key=lambda p: occ[p])
+        dest = 1 - victim_pool
+        for name, st in sorted(self.jobs.items()):
+            if st["terminal"] or st["whale"] or st["moving"] \
+                    or st["pool"] != victim_pool:
+                continue
+            if self._occupied(dest) + st["hosts"] > NODES_PER_POOL:
+                continue  # this one cannot consolidate yet
+            if self.mode == "migrate":
+                self.feedback.suggest_defrag(
+                    "default", name, "pool-%d" % dest, "whale",
+                    staleness=PRICE_STALENESS)
+            else:
+                live = self._live_pods(name)
+                if live:
+                    self._start_move(name, st, dest, live)
+
+    def _live_pods(self, name: str) -> List[dict]:
+        return [p for p in self._job_pods(name)
+                if (p.get("status") or {}).get("phase")
+                in ("Pending", "Running")
+                and not p["metadata"].get("deletionTimestamp")]
+
+    def _track_move(self, tick: int, name: str, st: dict,
+                    live: List[dict], gang_up: bool) -> None:
+        """The MOVE state machine: a feedback commit (migrate mode)
+        binds the job to its destination; the gang going fully down
+        starts the blackout clock; the gang fully up at the destination
+        ends it."""
+        if self.mode == "migrate" and not st["moving"]:
+            fb = self.feedback
+            commits = fb.commits("default", name).get("migrate", 0) \
+                if fb is not None else 0
+            if commits > st["commit_base"] and commits > 0 \
+                    and st["commit_base"] >= 0:
+                # the reconciler stamped + drained: bind the destination
+                # (escape intents carry none — the spare pool; defrag
+                # intents were suggested with an explicit dest)
+                st["moving"] = True
+                avoid = st["pool"] if st["pool"] is not None else 0
+                in_wave = next(
+                    (w for w in self.waves
+                     if w["notice_start"] <= tick < w["end"]
+                     and st["pool"] == w["pool"]), None)
+                if in_wave is not None:
+                    avoid = in_wave["pool"]
+                st["move_dest"] = self._spare_pool(st, avoid)
+                st["commit_base"] = commits
+        if not st["moving"]:
+            return
+        if not live:
+            if st["down_tick"] is None:
+                st["down_tick"] = tick
+            return
+        if gang_up and st["down_tick"] is not None:
+            blackout = tick - st["down_tick"]
+            self.blackouts.append(blackout)
+            if self.mode == "migrate" and self.feedback is not None:
+                self.feedback.record_blackout(float(blackout))
+            st["pool"] = st["move_dest"]
+            st["moving"] = False
+            st["move_dest"] = None
+            st["down_tick"] = None
+            st["degraded"] = False  # the MOVE left the bad host behind
+            if self.mode == "evict":
+                # cold destination: requeue pays the compile + warm-up
+                # the migrate path pre-staged away
+                moved = self.h.job_metrics.ledger.charge(
+                    "default", name, "compile", COLD_COMPILE_S)
+                if moved > 0:
+                    self.cold_charged += 1
+                st["cold"] = COLD_WARM_TICKS
+            if self.mode == "migrate" and st["commit_base"] >= 0:
+                fb = self.feedback
+                st["commit_base"] = fb.commits(
+                    "default", name).get("migrate", 0) \
+                    if fb is not None else 0
+
+    # -- per-tick accounting ----------------------------------------------
+
+    def _account(self, tick: int) -> None:
+        allocated = 0
+        for name, st in self.jobs.items():
+            try:
+                job = self.h.get_job(name)
+            except NotFoundError:
+                continue
+            pods = self._job_pods(name)
+            live = [p for p in pods
+                    if (p.get("status") or {}).get("phase")
+                    in ("Pending", "Running")]
+            allocated += len(live) * CHIPS_PER_NODE
+            if st["terminal"]:
+                continue
+            if job.phase == api.Phase.COMPLETED:
+                st["completed"] = tick
+                st["terminal"] = True
+                continue
+            if job.phase == api.Phase.FAILED:
+                st["terminal"] = True
+                continue
+            replicas = int((job.spec.get(api.RES_WORKER) or {})
+                           .get("replicas") or 0)
+            gang_up = (replicas > 0 and len(live) == replicas and all(
+                helper.is_pod_real_running(p)
+                and not p["metadata"].get("deletionTimestamp")
+                for p in live))
+            self._feed_signals(tick, name, st, live, gang_up)
+            self._track_move(tick, name, st, live, gang_up)
+            if not gang_up or st["moving"]:
+                continue
+            if st["whale"] and st["pool"] is None:
+                continue  # fragmented: pods up, no contiguous slice yet
+            if st["progress"] >= st["duration"]:
+                for pod in pods:
+                    self.h.sim.finish(pod["metadata"]["name"],
+                                      succeeded=True)
+                continue
+            if st["cold"] > 0:
+                st["cold"] -= 1
+                continue  # evict-mode destination still compiling
+            st["rate_tick"] += 1
+            divisor = DEGRADED_DIVISOR if st["degraded"] else 1
+            if st["rate_tick"] % divisor != 0:
+                continue
+            st["progress"] += 1
+            if st["first_progress"] is None:
+                st["first_progress"] = tick
+            if st["progress"] % CKPT_EVERY == 0:
+                st["ckpt"] = st["progress"]
+            if st["progress"] >= st["duration"]:
+                for pod in pods:
+                    self.h.sim.finish(pod["metadata"]["name"],
+                                      succeeded=True)
+        self.max_allocated = max(self.max_allocated, allocated)
+        if allocated > FLEET_CHIPS:
+            self.cap_violations.append(
+                "tick %d: %d live worker chips exceed the %d-chip fleet"
+                % (tick, allocated, FLEET_CHIPS))
+        for pool in range(FLEET_POOLS):
+            occ = self._occupied(pool)
+            if occ > NODES_PER_POOL:
+                self.cap_violations.append(
+                    "tick %d: pool-%d committed to %d hosts (> %d)"
+                    % (tick, pool, occ, NODES_PER_POOL))
+        for w in self.waves:
+            if w["vacate_checked"] or tick < w["maint_start"]:
+                continue
+            w["vacate_checked"] = True
+            for name, st in sorted(self.jobs.items()):
+                if st["terminal"] or st["pool"] != w["pool"]:
+                    continue
+                if st["moving"] or not self._live_pods(name):
+                    continue  # mid-handover: the source is already down
+                self.vacate_violations.append(
+                    "job %s still live on pool-%d when its maintenance "
+                    "started (tick %d)" % (name, w["pool"], tick))
+        self._drive_defrag(tick)
+
+    def run(self) -> int:
+        events = deque(self.plan.events)
+        stable = 0
+        ticks = 0
+        for tick in range(self.plan.horizon):
+            ticks = tick + 1
+            fired = False
+            while events and events[0].tick <= tick:
+                self._fire(tick, events.popleft())
+                fired = True
+            rv_before = self.h.client.resource_version
+            self.h.manager.drain()
+            sim_changed = self.h.sim.step()
+            self.pod_chaos.tick()
+            self._account(tick)
+            self.clock.advance(1.0)
+            queues_empty = all(
+                len(c.queue) == 0 and c.queue.pending_deferred == 0
+                for c in self.h.manager.controllers)
+            all_done = all(st["terminal"] for st in self.jobs.values())
+            if (not fired and not events and all_done
+                    and rv_before == self.h.client.resource_version
+                    and not sim_changed and queues_empty
+                    and self.pod_chaos.pending == 0):
+                stable += 1
+                if stable >= 2:
+                    break
+            else:
+                stable = 0
+        return ticks
+
+    # -- results ---------------------------------------------------------
+
+    def fleet_ratio(self) -> float:
+        return float(self.h.job_metrics.ledger.fleet_snapshot()["ratio"])
+
+    def job_states(self) -> Dict[str, dict]:
+        out = {}
+        for name, st in sorted(self.jobs.items()):
+            try:
+                job = self.h.get_job(name)
+                phase = job.phase
+                pr = int(job.status.get("preemptionRestarts") or 0)
+                ar = int(job.status.get("appFailureRestarts") or 0)
+                sp = int(job.status.get("schedPreemptions") or 0)
+            except NotFoundError:
+                phase, pr, ar, sp = "<deleted>", 0, 0, 0
+            out[name] = {
+                "phase": phase,
+                "preemptionRestarts": pr,
+                "appFailureRestarts": ar,
+                "schedPreemptions": sp,
+                "progress": st["progress"],
+                "completed": st["completed"],
+                "drained": st["drained"],
+                "lost": st["lost"],
+            }
+        return out
+
+    def check_invariants(self) -> List[str]:
+        v = list(self.cap_violations)
+        v.extend(self.vacate_violations)
+        for name, st in sorted(self.jobs.items()):
+            if st["completed"] is None:
+                v.append("job %s never completed (progress %d/%d)"
+                         % (name, st["progress"], st["duration"]))
+            if st["hard_kills"] == 0 and st["lost"] != 0:
+                v.append("job %s lost %d steps without any hard kill — "
+                         "a MOVE must preserve all work"
+                         % (name, st["lost"]))
+        if self.mode == "migrate":
+            v.extend(self._check_migration_invariants())
+        return v
+
+    def _check_migration_invariants(self) -> List[str]:
+        v: List[str] = []
+        fb = self.feedback
+        counts = fb.migration_counts() if fb is not None else {}
+        commits = sum(n for k, n in counts.items()
+                      if k.startswith("commit:"))
+        waves = sum(1 for e in self.plan.events if e.kind == "pool_maint")
+        movers = sum(1 for st in self.jobs.values() if not st["whale"])
+        if counts.get("commit:escape", 0) < waves * movers:
+            v.append("rolling maintenance over %d wave(s) x %d job(s) "
+                     "produced only %d escape commit(s) (%r)"
+                     % (waves, movers, counts.get("commit:escape", 0),
+                        counts))
+        if any(e.kind == "whale_submit" for e in self.plan.events) \
+                and counts.get("commit:defrag", 0) < 1:
+            v.append("a fragmented whale was queued but no defrag MOVE "
+                     "was committed (%r)" % counts)
+        whale = next((st for st in self.jobs.values() if st["whale"]),
+                     None)
+        if whale is not None and whale["completed"] is None:
+            v.append("the whale never ran: defragmentation did not free "
+                     "a contiguous pool")
+        if len(self.blackouts) != commits:
+            v.append("%d MOVE commit(s) but %d measured blackout(s) — "
+                     "a handover was lost or double-counted"
+                     % (commits, len(self.blackouts)))
+        for i, b in enumerate(self.blackouts):
+            if b > BLACKOUT_BOUND:
+                v.append("blackout #%d lasted %d ticks (bound %d): the "
+                         "handover barrier was not a single overlap"
+                         % (i, b, BLACKOUT_BOUND))
+        # budget semantics: the MOVE is budget-free — a scavenger that
+        # was only ever migrated must end with its preemption budget
+        # untouched and at least one budget-free schedPreemption booked
+        for name, st in sorted(self.jobs.items()):
+            if st["whale"] or st["hard_kills"] > 0:
+                continue
+            try:
+                job = self.h.get_job(name)
+            except NotFoundError:
+                continue
+            pr = int(job.status.get("preemptionRestarts") or 0)
+            sp = int(job.status.get("schedPreemptions") or 0)
+            if pr != 0:
+                v.append("job %s spent preemption budget (%d) though "
+                         "every drain was a MOVE — migration must be "
+                         "budget-free" % (name, pr))
+            if st["drained"] > 0 and sp < 1:
+                v.append("job %s MOVEd without booking a budget-free "
+                         "schedPreemption (sp=%d)" % (name, sp))
+        v.extend(self._check_incident_conservation())
+        return v
+
+    def _check_incident_conservation(self) -> List[str]:
+        """Every incident closed; every ``migrate``-cause incident
+        exists; each closed incident's MTTR stage sum equals its ledger
+        badput episode exactly (event plane == time plane)."""
+        out: List[str] = []
+        reg = self.h.job_metrics.incidents
+        ledger = self.h.job_metrics.ledger
+        if reg.open_count():
+            out.append("%d incident chain(s) still open at quiescence"
+                       % reg.open_count())
+        inc_counts = reg.incident_counts()
+        if not inc_counts.get("migrate"):
+            out.append("MOVEs committed but no migrate-cause incident "
+                       "ever closed (%r)" % inc_counts)
+        episodes: Dict[str, List[dict]] = {}
+        for ep in ledger.episode_log():
+            episodes.setdefault(ep["incident"], []).append(ep)
+        for inc in reg.closed_incidents():
+            eps = episodes.get(inc["incident"])
+            if not eps:
+                out.append("incident %s (%s) has no ledger episode — "
+                           "the time plane never saw it"
+                           % (inc["incident"], inc["cause"]))
+                continue
+            ep_s = sum(e["badput_s"] for e in eps)
+            if abs(inc["total_s"] - ep_s) > 1e-6:
+                out.append(
+                    "incident %s (%s) stage sum %.6fs != ledger episode "
+                    "badput %.6fs — event/time plane conservation broken"
+                    % (inc["incident"], inc["cause"], inc["total_s"],
+                       ep_s))
+        return out
+
+    def close(self) -> None:
+        self.h.close()
+
+
+# ---------------------------------------------------------------------------
+# the training-plane bit-identity leg
+# ---------------------------------------------------------------------------
+
+def run_migration_recovery(plan: ChaosPlan
+                           ) -> Tuple[Dict[str, object], List[str]]:
+    """A REAL runner MOVEd mid-run through the artifact tier, against an
+    unmigrated reference replay of the same seed:
+
+    1. **reference**: train straight through in a fresh dir;
+    2. **migrated**: train with a migrate-drain landing at a seeded
+       step — the runner cuts the final checkpoint, publishes it as a
+       state bundle (publish_state); a *destination* run in a SEPARATE
+       checkpoint dir pre-stages it over the store HTTP-tier machinery
+       (fetch_state via ``TPUJOB_MIGRATE_STATE``) and resumes to
+       completion.
+
+    The invariant is the EasyScale bar applied to Singularity's MOVE:
+    the migrated run's final loss equals the reference bit-for-bit —
+    migration is transparent to the loss curve."""
+    from ..artifacts import get_store, reset_for_tests
+    from ..artifacts.server import ArtifactServer
+    from ..runner import DrainMonitor, LaunchConfig, run_training
+    from .recovery import TOTAL_STEPS, linear_batch_source, \
+        tiny_linear_job
+
+    rng = random.Random("migration-recovery:%d" % plan.seed)
+    drain_at = rng.randrange(3, TOTAL_STEPS - 3)
+    facts: Dict[str, object] = {"mig_drain_at": drain_at}
+    violations: List[str] = []
+    make_batch = linear_batch_source()
+    cfg = LaunchConfig(worker_id=0, num_workers=1)
+    root = tempfile.mkdtemp(prefix="chaos-migration-")
+    saved_env = {k: os.environ.get(k) for k in
+                 ("TPUJOB_ARTIFACT_STORE", "TPUJOB_ARTIFACT_URL",
+                  "TPUJOB_MIGRATE_STATE")}
+    # the state bundle streams over the artifact-store HTTP tier only
+    # (local dir tier disabled): the same member-scoped GETs a real
+    # source->destination move would ride
+    srv = ArtifactServer(store_dir=os.path.join(root, "store")).start()
+    try:
+        os.environ["TPUJOB_ARTIFACT_STORE"] = "0"
+        os.environ["TPUJOB_ARTIFACT_URL"] = srv.url
+        os.environ.pop("TPUJOB_MIGRATE_STATE", None)
+        reset_for_tests()
+
+        ref_job = tiny_linear_job(os.path.join(root, "ref"), make_batch)
+        ref = run_training(ref_job, cfg, init_distributed=False)
+
+        dm = DrainMonitor()
+
+        def draining_batch(rng_, step):
+            if step == drain_at:
+                dm.request_migrate({"namespace": "chaos",
+                                    "name": "mover"})
+            return make_batch(rng_, step)
+
+        src_job = tiny_linear_job(os.path.join(root, "src"),
+                                  draining_batch, drain_monitor=dm)
+        src = run_training(src_job, cfg, init_distributed=False)
+        if not src.get("drained") or \
+                src.get("drain_reason") != "migrate":
+            violations.append("migration recovery: the source run did "
+                              "not drain as a MOVE (%r)"
+                              % {k: src.get(k) for k in
+                                 ("drained", "drain_reason")})
+            return facts, violations
+        pub = src.get("migrate_published") or {}
+        step = int(src["drain_step"])
+        facts["mig_drain_step"] = step
+        if pub.get("step") != step:
+            violations.append("migration recovery: the source drained "
+                              "at step %d but published %r"
+                              % (step, pub))
+            return facts, violations
+
+        os.environ["TPUJOB_MIGRATE_STATE"] = "chaos/mover:%d" % step
+        dst_job = tiny_linear_job(os.path.join(root, "dst"), make_batch)
+        dst = run_training(dst_job, cfg, init_distributed=False)
+        if dst.get("migrate_prefetched_step") != step:
+            violations.append(
+                "migration recovery: the destination did not pre-stage "
+                "step %d through the artifact tier (got %r)"
+                % (step, dst.get("migrate_prefetched_step")))
+        facts["mig_resumed_steps"] = int(dst.get("steps") or 0)
+        ref_loss = float(ref["loss"])
+        mig_loss = float(dst["loss"])
+        facts["mig_loss"] = float.hex(mig_loss)
+        facts["mig_ref_loss"] = float.hex(ref_loss)
+        if float.hex(ref_loss) != float.hex(mig_loss):
+            violations.append(
+                "migrated loss %s != unmigrated reference %s — the MOVE "
+                "was not transparent" % (float.hex(mig_loss),
+                                         float.hex(ref_loss)))
+        store = get_store()
+        if store is not None:
+            stats = store.stats()
+            facts["mig_store_publishes"] = int(
+                stats.get("publishes_remote") or 0)
+            facts["mig_store_hits"] = int(
+                stats.get("hits_remote") or 0)
+            if not facts["mig_store_publishes"]:
+                violations.append(
+                    "migration recovery: no state bundle was published "
+                    "through the HTTP tier (%r)"
+                    % {k: v for k, v in sorted(stats.items()) if v})
+    finally:
+        srv.stop()
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        reset_for_tests()
+    return facts, violations
+
+
+def run_migration_scenario(plan: ChaosPlan) -> ChaosReport:
+    """The ``migration_wave`` entry point for chaos.harness.run_scenario:
+    the migrated run (audited), the evict-and-requeue replay of the same
+    seed (the goodput comparison), and the training-plane bit-identity
+    leg."""
+    t0 = time.perf_counter()
+    mig = MigrationFleetRun(plan, mode="migrate")
+    ticks = mig.run()
+    violations = mig.check_invariants()
+    ev = MigrationFleetRun(plan, mode="evict")
+    ev.run()
+    violations.extend("evict replay: %s" % s
+                      for s in ev.cap_violations)
+    for name, st in sorted(ev.jobs.items()):
+        if st["completed"] is None:
+            violations.append("evict replay: job %s never completed"
+                              % name)
+    ratio, evict_ratio = mig.fleet_ratio(), ev.fleet_ratio()
+    if ratio <= evict_ratio:
+        violations.append(
+            "migrated fleet goodput ratio %.4f does not strictly beat "
+            "the evict-and-requeue replay %.4f" % (ratio, evict_ratio))
+    fb = mig.feedback
+    counts = fb.migration_counts() if fb is not None else {}
+    extra: Dict[str, object] = {
+        "fleet_goodput_ratio": round(ratio, 4),
+        "evict_goodput_ratio": round(evict_ratio, 4),
+        "blackout_count": len(mig.blackouts),
+        "blackout_max": max(mig.blackouts) if mig.blackouts else 0,
+        "blackout_sum": sum(mig.blackouts),
+        "evict_blackout_max": max(ev.blackouts) if ev.blackouts else 0,
+        "evict_cold_resumes": ev.cold_charged,
+        "max_allocated_chips": mig.max_allocated,
+    }
+    for k, n in sorted(counts.items()):
+        extra["mig_%s" % k.replace(":", "_")] = n
+    reg = mig.h.job_metrics.incidents
+    for cause, n in sorted(reg.incident_counts().items()):
+        extra["incidents_%s" % cause] = n
+    for stage, s in sorted(reg.stage_totals().items()):
+        extra["mttr_%s" % stage] = round(s, 3)
+    facts, leg_violations = run_migration_recovery(plan)
+    extra.update(facts)
+    violations.extend(leg_violations)
+    jobs = mig.job_states()
+    converged = all(st["completed"] is not None
+                    for st in mig.jobs.values())
+    faults = dict(mig.injector.counts)
+    mig.close()
+    ev.close()
+    return ChaosReport(plan.scenario, plan.seed, converged, ticks, faults,
+                       jobs, violations, time.perf_counter() - t0,
+                       extra=extra)

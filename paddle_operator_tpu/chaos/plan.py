@@ -91,7 +91,14 @@ CONTROL_SCENARIOS = (
 )
 SCENARIOS = CONTROL_SCENARIOS + ("loader_faults", "multi_tenant",
                                  "artifact_poison", "serving_brownout",
-                                 "fleet_week")
+                                 "fleet_week", "migration_wave")
+
+#: migration_wave maintenance shape (mirrored into chaos.migration):
+#: each ``pool_maint`` gives the pool's jobs MIGRATION_NOTICE ticks of
+#: drain notice (the unhealthy-host windows the escape hysteresis
+#: consumes), then holds the pool down for MIGRATION_MAINT ticks
+MIGRATION_NOTICE = 10
+MIGRATION_MAINT = 6
 
 #: control_plane_storm fleet shape: 500+ TpuJobs (the ISSUE-7 scale bar)
 #: churning through the PARALLEL workqueue (drain workers > 1) while api
@@ -153,6 +160,7 @@ def build_plan(scenario: str, seed: int, quick: bool = True) -> ChaosPlan:
         "artifact_poison": _artifact_poison,
         "serving_brownout": _serving_brownout,
         "fleet_week": _fleet_week,
+        "migration_wave": _migration_wave,
     }[scenario]
     events, horizon = builder(rng, quick)
     return ChaosPlan(scenario, seed, events, horizon)
@@ -486,6 +494,49 @@ def _fleet_week(rng: random.Random, quick: bool
             {"code": rng.choice([409, 500, 503]),
              "count": rng.randint(1, 2)}))
     return events, horizon
+
+
+def _migration_wave(rng: random.Random, quick: bool
+                    ) -> Tuple[List[FaultEvent], int]:
+    """Rolling maintenance becomes a MOVE (see chaos.migration): three
+    scavenger jobs land on one pool of a 2-pool fleet; maintenance
+    drains pool 0 and then pool 1 in turn (every job must ESCAPE each
+    wave, arriving warm — budget-free — on the spare pool), a hard
+    preemption sometimes lands mid-wave, a degraded host later forces a
+    single-job escape, and finally a whale needing one CONTIGUOUS pool
+    arrives while the scavengers sit spread across both — only a DEFRAG
+    move can admit it. Apiserver errors run throughout. The same plan
+    replays in evict-and-requeue mode for the goodput invariant, and
+    the training-plane leg proves the migrated loss bit-identical (see
+    chaos.migration.run_migration_recovery)."""
+    events: List[FaultEvent] = []
+    for i, hosts in enumerate((1, 2, 1)):
+        # durations sized so every scavenger is still mid-flight when
+        # the defrag pressure lands (~tick 85 at the latest schedule)
+        events.append(FaultEvent(0, "job_submit", {
+            "name": "mig%d" % i, "hosts": hosts,
+            "duration": rng.randint(85, 95)}))
+    w0 = rng.randint(6, 10)
+    events.append(FaultEvent(w0, "pool_maint", {"pool": 0}))
+    w1 = w0 + rng.randint(20, 24)  # after wave 0's window fully closes
+    events.append(FaultEvent(w1, "pool_maint", {"pool": 1}))
+    if rng.random() < 0.6:
+        # a hard preemption between the waves: its restart budget spend
+        # must stay disjoint from the budget-free MOVE bookings
+        events.append(FaultEvent(
+            w0 + MIGRATION_NOTICE + rng.randint(4, 6), "pod_preempt",
+            {"job": "mig%d" % rng.randrange(3)}))
+    deg_at = w1 + MIGRATION_NOTICE + MIGRATION_MAINT + rng.randint(2, 5)
+    events.append(FaultEvent(deg_at, "host_degrade", {"job": "mig2"}))
+    whale_at = deg_at + rng.randint(10, 14)
+    events.append(FaultEvent(whale_at, "whale_submit", {
+        "name": "whale", "hosts": 4, "duration": rng.randint(5, 7)}))
+    for _ in range(rng.randint(1, 3)):
+        events.append(FaultEvent(
+            rng.randint(1, whale_at), "api_error",
+            {"code": rng.choice([409, 500, 503]),
+             "count": rng.randint(1, 2)}))
+    return events, whale_at + (80 if quick else 160)
 
 
 def _goodput_audit(rng: random.Random, quick: bool

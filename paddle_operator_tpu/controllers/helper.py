@@ -184,6 +184,14 @@ ANNOT_SCHED_EVICT = "batch.tpujob.dev/sched-evict"
 # The job's own worker np, parked while the arbiter runs it shrunk and
 # restored when fleet pressure subsides.
 ANNOT_SCHED_RESTORE_NP = "batch.tpujob.dev/sched-restore-np"
+# Job annotation the arbiter stamps when a drain is a MOVE, not an
+# eviction: value is the JSON migration intent ({"dest": ..., "path":
+# "escape"|"defrag", "fp": <state-bundle fingerprint>}). The reconciler
+# executes the pre-stage against it, books the drain budget-free like a
+# sched-evict, and strips it at handover (or when the destination gang
+# vanishes — a stale MOVE intent must never pin a job in a draining
+# state across an operator restart).
+ANNOT_SCHED_MIGRATE = "batch.tpujob.dev/sched-migrate"
 
 # Pod annotation carrying the encoded incident span context
 # (utils.trace.SpanContext) for pods created while their job's recovery
@@ -212,7 +220,8 @@ def event_lane(etype: str, obj: dict) -> str:
         return LANE_HIGH
     if obj.get("kind") == "Pod" and k8s.pod_phase(obj) == "Failed":
         return LANE_HIGH
-    if ANNOT_SCHED_EVICT in (meta.get("annotations") or {}):
+    ann = meta.get("annotations") or {}
+    if ANNOT_SCHED_EVICT in ann or ANNOT_SCHED_MIGRATE in ann:
         return LANE_HIGH
     return LANE_NORMAL
 

@@ -12,6 +12,7 @@ differences are called out inline.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import zlib
@@ -309,6 +310,9 @@ class TpuJobReconciler:
         # the same graceful-drain path an arbiter eviction rides.
         if (self.arbiter is not None
                 and getattr(self.arbiter, "feedback", None) is not None):
+            gate = self._feedback_migration(job, child_pods)
+            if gate is not None:
+                return gate
             gate = self._feedback_remediation(job, child_pods)
             if gate is not None:
                 return gate
@@ -455,6 +459,7 @@ class TpuJobReconciler:
         but slower; the epoch bump is the fast path for the
         kubelet-reported failure this branch handles.
         """
+        self._migration_upkeep(job, child_pods)
         gate = self._graceful_drain(job, child_pods)
         if gate is not None:
             return gate
@@ -654,6 +659,150 @@ class TpuJobReconciler:
             self.arbiter.evictor(pod, self.arbiter.drain_grace)
         return Result(requeue=True)
 
+    def _dest_alive(self, dest: str) -> bool:
+        """Does the migration destination still exist with schedulable
+        TPU chips? ``dest`` may name a Node or a pool (the GKE nodepool
+        label); empty means "anywhere but the source" and is always
+        satisfiable while the fleet has nodes at all."""
+        try:
+            nodes = self.client.list("Node")
+        except Exception:
+            return True  # a flaky list must not abort a healthy MOVE
+        tpu = [n for n in nodes
+               if int(str(((n.get("status") or {}).get("allocatable")
+                           or {}).get(helper.TPU_RESOURCE, 0)) or 0) > 0]
+        if not dest:
+            return bool(tpu)
+        for node in tpu:
+            meta = node.get("metadata") or {}
+            if meta.get("name") == dest:
+                return True
+            if (meta.get("labels") or {}).get(
+                    helper.GKE_NODEPOOL_TOPOLOGY) == dest:
+                return True
+        return False
+
+    def _feedback_migration(self, job: api.TpuJob,
+                            child_pods: List[dict]) -> Optional[Result]:
+        """Execute a pending MIGRATE decision (sched/feedback.py): the
+        MOVE verb. Same commit discipline as remediation — the intent is
+        stamped on the OBJECT first (:data:`helper.ANNOT_SCHED_MIGRATE`,
+        so a restarted operator re-reads a MOVE in flight and the drain
+        books budget-free), the decision is only consumed once the
+        stamp persisted, and the gang drains through the PR 5 graceful
+        path while the destination pre-stages state + compile. A
+        destination that died between decision and execution aborts
+        CLEANLY here: the decision is dropped (``abort_migration``),
+        nothing was stamped, no budget moved — the feedback loop
+        re-decides from fresh signals."""
+        fb = self.arbiter.feedback
+        if job.phase != api.Phase.RUNNING or job.elastic is None:
+            return None
+        action = fb.pending_migration(job.namespace, job.name)
+        if action is None:
+            return None
+        dest = str(action.get("dest") or "")
+        if not self._dest_alive(dest):
+            fb.abort_migration(job.namespace, job.name, "dest_dead")
+            self.recorder.event(
+                job.obj, "Warning", "SchedFeedbackMigrateAborted",
+                "migration destination %r vanished before the MOVE "
+                "started; decision dropped (no budget spent)" % dest)
+            return None
+        live = [p for p in child_pods
+                if (p["metadata"].get("annotations") or {})
+                .get(api.ANNOT_RESOURCE) == api.RES_WORKER
+                and k8s.pod_phase(p) in ("Pending", "Running")
+                and not p["metadata"].get("deletionTimestamp")]
+        if not live:
+            return None  # mid-incident already; nothing to move
+        # the intent the destination side needs: path + placement, plus
+        # the newest checkpoint step the runner has stamped (the state
+        # pre-stage key — see artifacts/state.py)
+        ckpt = (job.metadata.get("annotations") or {}).get(
+            "batch.tpujob.dev/latest-checkpoint-step")
+        intent = {"path": action.get("path", ""),
+                  "dest": dest,
+                  "src": str(action.get("src") or "")}
+        if ckpt is not None:
+            intent["step"] = str(ckpt)
+        if not self.arbiter.stamp_migrate(job.namespace, job.name,
+                                          intent):
+            return self._requeue_error((job.namespace, job.name))
+        fb.commit_migration(job.namespace, job.name, action)
+        # incident inception: the drain this MOVE commissions opens a
+        # scheduler eviction — arm the migrate cause so its MTTR stages
+        # (prestage/handover/warmup) book under the right label
+        self.obs.incidents.arm(job.namespace, job.name, "migrate")
+        if action.get("path") == "defrag":
+            what = ("defragmentation: consolidating this scavenger onto "
+                    "%s frees a contiguous slice for queued whale %s"
+                    % (dest or "packed capacity",
+                       action.get("whale", "?")))
+        else:
+            what = ("escaping degraded host %s (unhealthy %s consecutive "
+                    "windows)" % (action.get("src", "?"),
+                                  action.get("windows", "?")))
+        self.recorder.event(
+            job.obj, "Normal", "SchedFeedbackMigrate",
+            "%s; MOVE priced below evict-and-requeue (%.1fs vs %.1fs "
+            "badput); %d pod(s) draining while the destination "
+            "pre-stages state + compile (schedPreemptions are "
+            "budget-free)"
+            % (what, float(action.get("migrate_cost_s") or 0.0),
+               float(action.get("evict_cost_s") or 0.0), len(live)))
+        for pod in live:
+            self.arbiter.evictor(pod, self.arbiter.drain_grace)
+        return Result(requeue=True)
+
+    def _migration_upkeep(self, job: api.TpuJob,
+                          child_pods: List[dict]) -> None:
+        """Converge a persisted MOVE intent with reality (runs with or
+        without a feedback controller — the annotation alone is
+        authoritative, so this survives an operator restart):
+
+        * the gang is Running again — the handover landed; strip the
+          marker so the NEXT genuine preemption cannot misbook as a
+          budget-free MOVE;
+        * the destination vanished before handover — the orphaned
+          intent must not pin the job in a draining state: strip it and
+          fall back to the ordinary evict path (the drain, if already
+          booked, was booked budget-free exactly once; the drain-ack
+          dedup prevents any recount)."""
+        raw = (job.metadata.get("annotations") or {}).get(
+            helper.ANNOT_SCHED_MIGRATE)
+        if raw is None:
+            return
+        fb = getattr(self.arbiter, "feedback", None) \
+            if self.arbiter is not None else None
+        if job.phase == api.Phase.RUNNING:
+            alive = [p for p in child_pods
+                     if k8s.pod_phase(p) == "Running"
+                     and not p["metadata"].get("deletionTimestamp")]
+            if alive:
+                self._strip_job_annotation(job,
+                                           helper.ANNOT_SCHED_MIGRATE)
+                self.recorder.event(
+                    job.obj, "Normal", "MigrationComplete",
+                    "MOVE complete: the gang is running at the "
+                    "destination; migration intent cleared")
+            return
+        try:
+            intent = json.loads(raw)
+        except ValueError:
+            intent = {}
+        dest = str(intent.get("dest") or "")
+        if not self._dest_alive(dest):
+            self._strip_job_annotation(job, helper.ANNOT_SCHED_MIGRATE)
+            if fb is not None:
+                fb.abort_migration(job.namespace, job.name,
+                                   "dest_vanished")
+            self.recorder.event(
+                job.obj, "Warning", "MigrationAborted",
+                "migration destination %r vanished before handover; "
+                "falling back to the ordinary evict-resume path (the "
+                "drain stays budget-free; state is untouched)" % dest)
+
     def _adopt_trace_context(self, job: api.TpuJob,
                              child_pods: List[dict]) -> None:
         """Re-adopt an in-flight incident when this process has none
@@ -840,7 +989,16 @@ class TpuJobReconciler:
         # a well-behaved job toward terminal Failed).
         sched_evict = helper.ANNOT_SCHED_EVICT in (
             job.metadata.get("annotations") or {})
-        if not sched_evict and helper.restart_budget_exhausted(job):
+        # A MOVE drains through this same path and is just as voluntary:
+        # it books schedPreemptions, never the restart budget. Unlike
+        # the evict marker, the migrate intent is NOT stripped here — it
+        # must survive until the destination gang is Running (handover
+        # complete; _migration_upkeep strips it) so a restarted operator
+        # keeps executing the MOVE it finds on the object.
+        sched_migrate = helper.ANNOT_SCHED_MIGRATE in (
+            job.metadata.get("annotations") or {})
+        if (not sched_evict and not sched_migrate
+                and helper.restart_budget_exhausted(job)):
             return None
         # Bump BEFORE acking (mirror of the hard-preemption ordering): an
         # acked-but-unbumped incident could never retry its restart
@@ -859,21 +1017,33 @@ class TpuJobReconciler:
             # retry is harmless (workers restart once per poll, however
             # many bumps landed in between)
             return self._requeue_error((job.namespace, job.name))
-        if sched_evict:
+        if sched_evict or sched_migrate:
             self._count_restart_durably(job, "schedPreemptions")
-            self._strip_job_annotation(job, helper.ANNOT_SCHED_EVICT)
+            if sched_evict:
+                self._strip_job_annotation(job, helper.ANNOT_SCHED_EVICT)
+            if sched_migrate:
+                # re-arm across an operator restart: the in-memory arm
+                # from _feedback_migration died with the old process,
+                # but the marker on the object says this drain is a
+                # MOVE — its incident must book cause=migrate
+                self.obs.incidents.arm(job.namespace, job.name,
+                                       "migrate")
             self.obs.observe_sched_eviction(job.namespace, job.name)
             self.obs.observe_drain(job.namespace, job.name,
                                    pods=len(fresh))
             self.recorder.event(
-                job.obj, "Normal", "SchedulerPreempted",
+                job.obj, "Normal",
+                "MigrationDrain" if sched_migrate
+                else "SchedulerPreempted",
                 "%d pod(s) draining for the fleet arbiter (%s)%s; final "
-                "checkpoints cut at the next step boundary; the job "
-                "re-queues for capacity (schedPreemptions %d)"
+                "checkpoints cut at the next step boundary; the job %s "
+                "(schedPreemptions %d)"
                 % (len(fresh),
                    ", ".join(p["metadata"]["name"] for p in fresh),
                    "; membership epoch bumped to %s" % epoch
                    if epoch else "",
+                   "MOVEs to its pre-staged destination" if sched_migrate
+                   else "re-queues for capacity",
                    int(job.status.get("schedPreemptions") or 0)))
             return Result(requeue=True)
         self._count_restart_durably(job, "preemptionRestarts")
@@ -1095,6 +1265,27 @@ class TpuJobReconciler:
                 helper.ANNOT_TRACE_CONTEXT] = enc
             pod["spec"]["containers"][0].setdefault("env", []).append(
                 {"name": "TPUJOB_TRACE_CONTEXT", "value": enc})
+
+        # MOVE handshake (docs/design.md "Live migration"): a pod
+        # created while the job's migration intent is open is the
+        # DESTINATION side — it carries the state-bundle key so the
+        # runner pre-loads the source's final drain checkpoint from the
+        # artifact tier before its ordinary restore (a miss simply
+        # falls back to the last durable checkpoint; never a wrong
+        # restore, see artifacts/state.py).
+        raw = (job.metadata.get("annotations") or {}).get(
+            helper.ANNOT_SCHED_MIGRATE)
+        if raw is not None:
+            try:
+                step = json.loads(raw).get("step")
+            except ValueError:
+                step = None
+            if step is not None:
+                pod["spec"]["containers"][0].setdefault(
+                    "env", []).append(
+                    {"name": "TPUJOB_MIGRATE_STATE",
+                     "value": "%s/%s:%s" % (job.namespace, job.name,
+                                            step)})
 
         k8s.set_controller_reference(job.obj, pod)
         try:

@@ -116,32 +116,43 @@ class EventRecorder:
     def event(self, obj: dict, etype: str, reason: str, message: str) -> None:
         from .objects import new_object, now_iso
 
-        self._seq += 1
         meta = obj.get("metadata", {})
-        name = "%s.%d" % (meta.get("name", "unknown"), self._seq)
-        ev = new_object("v1", "Event", name, meta.get("namespace", "default"))
-        ev.update(
-            {
-                "type": etype,
-                "reason": reason,
-                "message": message,
-                "involvedObject": {
-                    "apiVersion": obj.get("apiVersion", ""),
-                    "kind": obj.get("kind", ""),
-                    "name": meta.get("name", ""),
-                    "namespace": meta.get("namespace", "default"),
-                    "uid": meta.get("uid", ""),
-                },
-                "source": {"component": self._component},
-                "firstTimestamp": now_iso(),
-                "lastTimestamp": now_iso(),
-                "count": 1,
-            }
-        )
-        try:
-            self._client.create(ev)
-        except ApiError:
-            pass  # events are best-effort
+        # The sequence is per-process: a freshly restarted operator's
+        # recorder would otherwise re-mint names a pre-restart recorder
+        # already used and silently drop its first Events per object
+        # (AlreadyExists swallowed as best-effort). Skip past collisions
+        # with a bounded retry — the bump is permanent, so the new
+        # recorder's counter overtakes the old one's after a few events.
+        for _attempt in range(16):
+            self._seq += 1
+            name = "%s.%d" % (meta.get("name", "unknown"), self._seq)
+            ev = new_object("v1", "Event", name,
+                            meta.get("namespace", "default"))
+            ev.update(
+                {
+                    "type": etype,
+                    "reason": reason,
+                    "message": message,
+                    "involvedObject": {
+                        "apiVersion": obj.get("apiVersion", ""),
+                        "kind": obj.get("kind", ""),
+                        "name": meta.get("name", ""),
+                        "namespace": meta.get("namespace", "default"),
+                        "uid": meta.get("uid", ""),
+                    },
+                    "source": {"component": self._component},
+                    "firstTimestamp": now_iso(),
+                    "lastTimestamp": now_iso(),
+                    "count": 1,
+                }
+            )
+            try:
+                self._client.create(ev)
+                return
+            except AlreadyExistsError:
+                continue  # name minted by a pre-restart recorder
+            except ApiError:
+                return  # events are best-effort
 
 
 def _map_http_error(e: "urllib.error.HTTPError") -> ApiError:

@@ -22,7 +22,8 @@ trace files. This registry is the operator-side half of the fix
   cross-process tree from the JSONL files alone;
 * per-incident **MTTR decomposes into named stages**
 
-      detect | drain | ckpt | reschedule | restore | compile | warmup
+      detect | drain | ckpt | prestage | handover | reschedule |
+      restore | compile | warmup
 
   driven by the same phase transitions the status subresource sees
   (stage boundaries share ONE clock read, so the stage sum partitions
@@ -68,6 +69,10 @@ INCIDENT_STAGES = (
     "detect",      # fault observed, incident owned (hard preemptions)
     "drain",       # grace window: pods Terminating, final checkpoints cut
     "ckpt",        # checkpoint save observed inside the incident window
+    "prestage",    # migration: state shards streaming to the destination
+    "handover",    # migration: the blackout barrier (source stopped,
+                   # destination not yet running) — the seconds a MOVE
+                   # actually costs the job
     "reschedule",  # gang gone, waiting for capacity / recreation
     "restore",     # pods back (Starting), state restoring
     "compile",     # runner-reported: step (re)build — trace plane only
@@ -76,7 +81,7 @@ INCIDENT_STAGES = (
 
 #: incident inception causes (the {cause} label)
 INCIDENT_CAUSES = ("drain", "preempt", "evict", "remediate", "regang",
-                   "resize", "crash")
+                   "resize", "crash", "migrate")
 
 #: which freshly-opened causes an ARMED cause label may override: a
 #: resize arm explains the restart it cues (preempt/crash shapes); a
@@ -89,6 +94,10 @@ _ARM_CONSUMES: Dict[str, Tuple[str, ...]] = {
     "resize": ("preempt", "crash"),
     "remediate": ("evict",),
     "regang": ("evict",),
+    # a MIGRATE decision commissions an arbiter drain exactly like
+    # remediate/regang does: the evict-shaped inception it cues reads
+    # `migrate`, while an unrelated graceful drain keeps its own cause
+    "migrate": ("evict",),
 }
 
 #: MTTR stage buckets: harness ticks land in the small ones, real
@@ -168,7 +177,7 @@ class IncidentRegistry:
             ctx = SpanContext(_mint_id(name, cause), cause, key)
             now = self._clock()
             stage = "drain" if cause in ("drain", "evict", "remediate",
-                                         "regang") else "detect"
+                                         "regang", "migrate") else "detect"
             self._open[key] = {"ctx": ctx, "stage": stage, "since": now,
                                "t0": now, "stages": {}}
             emit = {"incident": ctx.incident_id, "cause": cause,

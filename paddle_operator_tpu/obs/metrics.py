@@ -369,7 +369,8 @@ class JobMetrics:
         self.incidents.restore(namespace, name, ctx)
         ledger_cause = {"drain": "drain", "evict": "eviction",
                         "remediate": "eviction",
-                        "regang": "eviction"}.get(ctx.cause, "restore")
+                        "regang": "eviction",
+                        "migrate": "eviction"}.get(ctx.cause, "restore")
         self.ledger.note_incident(namespace, name, ledger_cause,
                                   incident=ctx.incident_id)
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import json
 import logging
 import math
 import os
@@ -63,18 +64,53 @@ class DrainMonitor:
     losing zero steps instead of up to ``checkpoint_every``.
     """
 
-    def __init__(self, drain_file: str = "", signals: Tuple = ()):
+    def __init__(self, drain_file: str = "", signals: Tuple = (),
+                 migrate_file: str = ""):
         self._file = drain_file
         self._signals = tuple(signals)
         self._event = threading.Event()
         self._installed: list = []
+        # live-migration handshake: a drain can be a MOVE — same final
+        # checkpoint, but the runner additionally publishes the step as
+        # a state bundle so the destination pre-stages it through the
+        # artifact tier (docs/design.md "Live migration"). Armed by a
+        # migrate file carrying the JSON intent
+        # (``TPUJOB_MIGRATE_FILE`` — what the operator's drain notice
+        # writes) or a programmatic :meth:`request_migrate`.
+        self._migrate_file = migrate_file
+        self._migrate: Optional[dict] = None
 
     def request(self) -> None:
         self._event.set()
 
+    def request_migrate(self, intent: Optional[dict] = None) -> None:
+        """Arm the drain as a MOVE: the intent (``namespace``/``name``
+        at minimum) tells the exit path where to publish state. The
+        intent must be set BEFORE the event so the drain branch always
+        observes it (Event.set is the release barrier)."""
+        self._migrate = dict(intent or {})
+        self._event.set()
+
     def requested(self) -> bool:
         return self._event.is_set() or bool(
-            self._file and os.path.exists(self._file))
+            self._file and os.path.exists(self._file)) or bool(
+            self._migrate_file and os.path.exists(self._migrate_file))
+
+    def migrate_intent(self) -> Optional[dict]:
+        """The MOVE intent when this drain is a migration, else None
+        (an ordinary preemption drain). A torn/garbage migrate file
+        degrades to an empty intent — the drain still exits clean; only
+        the state publish is skipped for want of a job key."""
+        if self._migrate is not None:
+            return dict(self._migrate)
+        if self._migrate_file and os.path.exists(self._migrate_file):
+            try:
+                with open(self._migrate_file) as fh:
+                    out = json.load(fh)
+                return dict(out) if isinstance(out, dict) else {}
+            except (OSError, ValueError):
+                return {}
+        return None
 
     def install(self) -> "DrainMonitor":
         """Install signal handlers (main thread only — CPython restricts
@@ -303,7 +339,9 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
     if drain is None:
         drain_file = job.drain_file or os.environ.get(
             "TPUJOB_DRAIN_FILE", "")
-        drain = DrainMonitor(drain_file, job.drain_signals)
+        drain = DrainMonitor(drain_file, job.drain_signals,
+                             migrate_file=os.environ.get(
+                                 "TPUJOB_MIGRATE_FILE", ""))
 
     # -- worker-side observability --------------------------------------
     metrics_srv = None
@@ -802,6 +840,36 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
                         trc.event("drain_exit", step=step, epoch=epoch)
                         result["drained"] = True
                         result["drain_step"] = step
+                        mig = drain.migrate_intent()
+                        if mig is not None:
+                            # MOVE, not eviction: pre-stage the final
+                            # cut through the artifact tier so the
+                            # destination restores it without a
+                            # filesystem round-trip. Publish failure
+                            # only degrades to the ordinary durable
+                            # checkpoint — the drain exit stays clean.
+                            result["drain_reason"] = "migrate"
+                            mns = str(mig.get("namespace", ""))
+                            mname = str(mig.get("name", ""))
+                            if (mns and mname and job.checkpoint_dir
+                                    and jax.process_count() == 1
+                                    and cfg.worker_id == 0):
+                                from .artifacts import get_store
+                                from .artifacts.state import publish_state
+                                store = get_store()
+                                if store is not None:
+                                    t_pub0 = time.perf_counter()
+                                    fp = publish_state(
+                                        store, mns, mname, step,
+                                        job.checkpoint_dir)
+                                    if fp is not None:
+                                        incident_stage(
+                                            "prestage",
+                                            time.perf_counter() - t_pub0)
+                                        trc.event("migrate_publish",
+                                                  step=step, fp=fp)
+                                        result["migrate_published"] = {
+                                            "fp": fp, "step": step}
                         result["state"] = state
                         result["steps"] = step
                         if metrics:
@@ -838,6 +906,42 @@ def run_training(job: TrainJob, cfg: Optional[LaunchConfig] = None,
         if metrics:
             result["loss"] = float(metrics["loss"])
         return True
+
+    # -- migration pre-stage (destination side): a pod launched to
+    # receive a MOVE carries TPUJOB_MIGRATE_STATE="ns/name:step" — pull
+    # the pre-staged state bundle into the checkpoint dir BEFORE the
+    # first cycle so restore_latest finds the source's final cut. Any
+    # miss or poisoned shard degrades to the ordinary durable
+    # checkpoint (never a wrong restore — fetch_state is all-or-nothing).
+    mig_state = os.environ.get("TPUJOB_MIGRATE_STATE", "")
+    if mig_state and job.checkpoint_dir:
+        try:
+            mjob, _, mstep_s = mig_state.rpartition(":")
+            mns, _, mname = mjob.partition("/")
+            mstep = int(mstep_s)
+        except ValueError:
+            log.warning("ignoring unparseable TPUJOB_MIGRATE_STATE=%r",
+                        mig_state)
+        else:
+            from .artifacts import get_store
+            from .artifacts.state import fetch_state, state_fingerprint
+            store = get_store()
+            if store is not None and mns and mname:
+                t_pre0 = time.perf_counter()
+                got = fetch_state(store,
+                                  state_fingerprint(mns, mname, mstep),
+                                  job.checkpoint_dir, mstep)
+                if got is not None:
+                    incident_stage("prestage",
+                                   time.perf_counter() - t_pre0)
+                    tracer().event("migrate_prestage", step=mstep,
+                                   job="%s/%s" % (mns, mname))
+                    result["migrate_prefetched_step"] = mstep
+                else:
+                    log.warning(
+                        "migration pre-stage miss for %s step %d; "
+                        "falling back to durable checkpoint",
+                        mjob, mstep)
 
     # installed HERE, immediately inside the try whose finally uninstalls:
     # process-global signal handlers must never outlive a setup failure

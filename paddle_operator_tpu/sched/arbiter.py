@@ -37,6 +37,7 @@ operator restart loses nothing.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from collections import deque
@@ -45,7 +46,8 @@ from typing import (Any, Callable, Deque, Dict, List, Optional, Set,
                     Tuple)
 
 from ..api import types as api
-from ..controllers.helper import ANNOT_SCHED_EVICT, ANNOT_SCHED_RESTORE_NP
+from ..controllers.helper import (ANNOT_SCHED_EVICT, ANNOT_SCHED_MIGRATE,
+                                  ANNOT_SCHED_RESTORE_NP)
 from ..k8s.errors import ApiError, ConflictError, NotFoundError
 from ..k8s.runtime import escape_label_value
 from ..utils.trace import tracer
@@ -64,6 +66,10 @@ ANNOT_CKPT_STEP = "batch.tpujob.dev/latest-checkpoint-step"
 ANNOT_PROGRESS_STEP = "batch.tpujob.dev/progress-step"
 
 ADMIT, SHRINK, QUEUE, EVICT = "admit", "shrink", "queue", "evict"
+#: the MOVE verb (Singularity): drain the source like an eviction but
+#: with a destination already warming — the reconciler executes it off
+#: ANNOT_SCHED_MIGRATE, budget-free like a sched-evict
+MIGRATE = "migrate"
 
 #: indirection so tests can fake the clock without patching time itself
 _monotonic = time.monotonic
@@ -189,6 +195,7 @@ class FleetArbiter:
         self._passes = 0
         self._preempts: Dict[str, int] = {}
         self._shrinks: Dict[str, int] = {}
+        self._migrates: Dict[str, int] = {}
         #: bounded, deterministic audit trail of preempt/shrink decisions
         #: (the chaos invariants replay it): a configurable ring —
         #: oldest entries drop first, so 10k-job churn cannot grow it
@@ -261,6 +268,7 @@ class FleetArbiter:
             passes = self._passes
             preempts = dict(self._preempts)
             shrinks = dict(self._shrinks)
+            migrates = dict(self._migrates)
         lines = [
             "# HELP tpujob_sched_passes_total Fleet scheduling passes "
             "executed.",
@@ -322,6 +330,16 @@ class FleetArbiter:
                 lines.append(
                     'tpujob_sched_shrink_decisions_total{job="%s"} %d'
                     % (esc(job), shrinks[job]))
+        if migrates:
+            lines += [
+                "# HELP tpujob_sched_migrate_decisions_total Scheduler "
+                "MOVE (live-migration) intents stamped, by job.",
+                "# TYPE tpujob_sched_migrate_decisions_total counter",
+            ]
+            for job in sorted(migrates):
+                lines.append(
+                    'tpujob_sched_migrate_decisions_total{job="%s"} %d'
+                    % (esc(job), migrates[job]))
         if self.feedback is not None:
             block = self.feedback.metrics_block()
             if block:
@@ -370,6 +388,7 @@ class FleetArbiter:
         with self._lock:
             self._preempts.pop(jkey, None)
             self._shrinks.pop(jkey, None)
+            self._migrates.pop(jkey, None)
             self._written_np.pop((namespace, name), None)
         if self.feedback is not None:
             self.feedback.forget_job(namespace, name)
@@ -391,7 +410,8 @@ class FleetArbiter:
         the own-write np ledger (churn-boundedness checks)."""
         with self._lock:
             keys = {tuple(k.split("/", 1))
-                    for k in set(self._preempts) | set(self._shrinks)}
+                    for k in (set(self._preempts) | set(self._shrinks)
+                              | set(self._migrates))}
             return len(keys | set(self._written_np))
 
     def stamp_evict(self, namespace: str, name: str) -> bool:
@@ -400,6 +420,63 @@ class FleetArbiter:
         before draining so the incident books budget-FREE
         (status.schedPreemptions), exactly like an arbiter eviction."""
         return self._stamp_evict_annotation((namespace, name))
+
+    def stamp_migrate(self, namespace: str, name: str,
+                      intent: Dict[str, Any]) -> bool:
+        """Persist a MOVE intent (:data:`ANNOT_SCHED_MIGRATE`, JSON) on
+        the job before its gang is drained. Same contract as
+        :meth:`stamp_evict`: the marker must be on the OBJECT before the
+        first pod dies, so the drain books budget-free and an operator
+        restarted mid-migration re-reads the intent instead of
+        misclassifying the drain as an involuntary preemption. True when
+        the marker is persisted (or an identical one already was)."""
+        key = (namespace, name)
+        payload = json.dumps(intent, sort_keys=True)
+        for _attempt in range(3):
+            try:
+                obj = self.client.get(api.KIND, *key)
+            except NotFoundError:
+                return False
+            annots = obj["metadata"].setdefault("annotations", {})
+            if annots.get(ANNOT_SCHED_MIGRATE) == payload:
+                return True
+            annots[ANNOT_SCHED_MIGRATE] = payload
+            try:
+                self.client.update(obj)
+            except ConflictError:
+                continue
+            jkey = "%s/%s" % key
+            with self._lock:
+                self._migrates[jkey] = self._migrates.get(jkey, 0) + 1
+                self._log({"action": MIGRATE, "job": jkey,
+                           "path": intent.get("path", ""),
+                           "dest": intent.get("dest", "")})
+            tracer().event("sched_migrate", job=jkey,
+                           path=intent.get("path", ""),
+                           dest=intent.get("dest", ""))
+            return True
+        return False
+
+    def clear_migrate(self, namespace: str, name: str) -> bool:
+        """Strip the MOVE intent (handover complete, or the migration
+        aborted back to the evict path). True when the annotation is
+        gone — including when it never was there."""
+        key = (namespace, name)
+        for _attempt in range(3):
+            try:
+                obj = self.client.get(api.KIND, *key)
+            except NotFoundError:
+                return True
+            annots = obj["metadata"].get("annotations") or {}
+            if ANNOT_SCHED_MIGRATE not in annots:
+                return True
+            del annots[ANNOT_SCHED_MIGRATE]
+            try:
+                self.client.update(obj)
+                return True
+            except ConflictError:
+                continue
+        return False
 
     def _jobs(self) -> List[api.TpuJob]:
         return [api.TpuJob(o) for o in self.client.list(api.KIND)]

@@ -46,11 +46,15 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..api import types as api
+from ..obs.exposition import format_float
 from ..obs.ledger import RECOVERY_CAUSES
 from ..utils.trace import tracer
 
 #: the decision taxonomy exported as tpujob_sched_feedback_total{action=}
-FEEDBACK_ACTIONS = ("victim", "regang", "remediate", "boost")
+FEEDBACK_ACTIONS = ("victim", "regang", "remediate", "boost", "migrate")
+
+#: the two migration decision paths (tpujob_migration_decisions_total{path=})
+MIGRATION_PATHS = ("escape", "defrag")
 
 #: knob defaults (docs/user-guide.md "Feedback loop")
 STRAGGLER_K = 2.0        #: p50 > k x gang median counts as a flagged window
@@ -58,6 +62,14 @@ STRAGGLER_WINDOWS = 3    #: M consecutive flagged windows before a re-gang
 BOOST_CAP = 1            #: bounded priority boost for budget-burning jobs
 BURN_THRESHOLD = 1.0     #: both burn windows must exceed this to boost
 BOOST_REARM = 0.5        #: boost drops once fast burn < rearm * threshold
+MIGRATE_WINDOWS = 2      #: consecutive bad-host windows before an escape
+MIGRATE_COST_S = 2.0     #: modeled cost of one MOVE (prestage overlap +
+                         #: blackout barrier) the price gate compares
+                         #: against the evict-and-requeue prediction
+
+#: blackout-barrier buckets: harness ticks in the small ones, a real
+#: handover (source stop -> destination first step) in the seconds range
+BLACKOUT_BUCKETS = (0.25, 1.0, 2.0, 5.0, 15.0, 60.0)
 
 _JobKey = Tuple[str, str]
 
@@ -142,7 +154,10 @@ class FeedbackController:
                  boost_cap: int = BOOST_CAP,
                  burn_threshold: float = BURN_THRESHOLD,
                  boost_rearm: float = BOOST_REARM,
-                 slo_objective: str = "goodput_ratio") -> None:
+                 slo_objective: str = "goodput_ratio",
+                 migrate_enabled: bool = True,
+                 migrate_windows: int = MIGRATE_WINDOWS,
+                 migrate_cost_s: float = MIGRATE_COST_S) -> None:
         self.ledger = ledger
         #: the SloEvaluator (settable after construction: the manager
         #: builds the arbiter before it parses --slo-spec)
@@ -176,6 +191,21 @@ class FeedbackController:
         # actually happened, not what was pending); tests and the chaos
         # model key healing on these
         self._commits: Dict[str, Dict[str, int]] = {}
+        # -- transparent live migration (Singularity's MOVE primitive) --
+        self.migrate_enabled = bool(migrate_enabled)
+        self.migrate_windows = max(1, int(migrate_windows))
+        self.migrate_cost_s = float(migrate_cost_s)
+        # (ns, name) -> pending MIGRATE intent awaiting a reconcile pass
+        self._mig_pending: Dict[_JobKey, Dict[str, Any]] = {}
+        # (ns, name) -> host -> consecutive bad-host windows (escape
+        # hysteresis: one flagged window must not move a whole gang)
+        self._mig_streaks: Dict[_JobKey, Dict[str, int]] = {}
+        # {"decision:<path>", "commit:<path>", "abort:<reason>"} counters
+        self._mig_counts: Dict[str, int] = {}
+        # blackout-barrier histogram (seconds the MOVE actually cost)
+        self._blackout_hist: List[int] = [0] * (len(BLACKOUT_BUCKETS) + 1)
+        self._blackout_sum = 0.0
+        self._blackout_count = 0
 
     @classmethod
     def from_env(cls, ledger: Any = None, slo: Any = None
@@ -193,7 +223,13 @@ class FeedbackController:
                    straggler_k=_f("TPUJOB_STRAGGLER_K", STRAGGLER_K),
                    straggler_windows=int(_f("TPUJOB_STRAGGLER_WINDOWS",
                                             STRAGGLER_WINDOWS)),
-                   boost_cap=int(_f("TPUJOB_SCHED_BOOST_CAP", BOOST_CAP)))
+                   boost_cap=int(_f("TPUJOB_SCHED_BOOST_CAP", BOOST_CAP)),
+                   migrate_enabled=os.environ.get(
+                       "TPUJOB_SCHED_MIGRATE", "1") not in ("0", "false"),
+                   migrate_windows=int(_f("TPUJOB_MIGRATE_WINDOWS",
+                                          MIGRATE_WINDOWS)),
+                   migrate_cost_s=_f("TPUJOB_MIGRATE_COST_S",
+                                     MIGRATE_COST_S))
 
     # -- victim selection (arbiter planning) -----------------------------
 
@@ -344,6 +380,169 @@ class FeedbackController:
                 attrs[k] = action[k]
         tracer().event("sched_feedback", **attrs)
 
+    # -- transparent live migration (MOVE) --------------------------------
+
+    def _price_migration(self, namespace: str,
+                         name: str, staleness: int) -> Tuple[bool, float]:
+        """The decision gate: migrate only when the predictor prices an
+        evict-and-requeue of this job ABOVE the modeled cost of one MOVE
+        (prestage overlaps the source, so the MOVE's price is ~the
+        blackout barrier). Never raises; with no signal the gate stays
+        closed and the ordinary evict/shrink path handles the job."""
+        try:
+            evict_cost = float(self.predictor.predict(
+                namespace, name, staleness)["cost_s"])
+        except Exception:
+            evict_cost = float(max(0, int(staleness)))
+        return evict_cost > self.migrate_cost_s, evict_cost
+
+    def observe_host_health(self, namespace: str, name: str, host: str,
+                            unhealthy: bool, staleness: int = 0) -> bool:
+        """One health window for one of the job's hosts (straggler that
+        re-ganging did not cure, degraded backend pinned to the host, or
+        a maintenance drain notice). ``migrate_windows`` CONSECUTIVE
+        unhealthy windows arm an **escape** migration off that host —
+        instead of shrinking or evicting — when the price gate passes;
+        a healthy window resets the streak and cancels a pending escape
+        from that host (the gang healed on its own). Returns True when
+        an escape was armed by this observation."""
+        if not self.migrate_enabled:
+            return False
+        key = (namespace, name)
+        with self._lock:
+            streaks = self._mig_streaks.setdefault(key, {})
+            if not unhealthy:
+                streaks.pop(host, None)
+                pending = self._mig_pending.get(key)
+                if pending is not None and pending.get("src") == host \
+                        and pending.get("path") == "escape":
+                    del self._mig_pending[key]
+                if not streaks:
+                    self._mig_streaks.pop(key, None)
+                return False
+            n = streaks.get(host, 0) + 1
+            streaks[host] = n
+            if n < self.migrate_windows or key in self._mig_pending:
+                return False
+        priced, evict_cost = self._price_migration(namespace, name,
+                                                   staleness)
+        if not priced:
+            return False
+        with self._lock:
+            streaks = self._mig_streaks.get(key)
+            if streaks is not None:
+                streaks[host] = 0
+            if key in self._mig_pending:
+                return False
+            self._mig_pending[key] = {
+                "action": "migrate", "path": "escape", "src": host,
+                "windows": self.migrate_windows,
+                "evict_cost_s": round(evict_cost, 6),
+                "migrate_cost_s": round(self.migrate_cost_s, 6),
+            }
+            self._mig_counts["decision:escape"] = \
+                self._mig_counts.get("decision:escape", 0) + 1
+        self._notify(namespace, name)
+        return True
+
+    def suggest_defrag(self, namespace: str, name: str, dest: str,
+                       whale: str, staleness: int = 0) -> bool:
+        """The arbiter found a queued whale that a contiguous slice
+        would admit, and this scavenger job is one whose MOVE to
+        ``dest`` frees part of that slice: arm a **defrag** migration
+        when the price gate passes. Returns True when armed."""
+        if not self.migrate_enabled:
+            return False
+        key = (namespace, name)
+        with self._lock:
+            if key in self._mig_pending:
+                return False
+        priced, evict_cost = self._price_migration(namespace, name,
+                                                   staleness)
+        if not priced:
+            return False
+        with self._lock:
+            if key in self._mig_pending:
+                return False
+            self._mig_pending[key] = {
+                "action": "migrate", "path": "defrag", "dest": dest,
+                "whale": whale,
+                "evict_cost_s": round(evict_cost, 6),
+                "migrate_cost_s": round(self.migrate_cost_s, 6),
+            }
+            self._mig_counts["decision:defrag"] = \
+                self._mig_counts.get("decision:defrag", 0) + 1
+        self._notify(namespace, name)
+        return True
+
+    def pending_migration(self, namespace: str,
+                          name: str) -> Optional[Dict[str, Any]]:
+        """Peek the pending MIGRATE intent for this job (a copy); the
+        reconciler confirms with :meth:`commit_migration` once the drain
+        is really underway, or :meth:`abort_migration` when the
+        destination died first."""
+        with self._lock:
+            act = self._mig_pending.get((namespace, name))
+            return None if act is None else dict(act)
+
+    def commit_migration(self, namespace: str, name: str,
+                         action: Dict[str, Any]) -> None:
+        """The reconciler stamped the migration intent and the source is
+        draining: consume the pending decision, count it, and mirror the
+        decision + its pricing inputs to trace."""
+        key = (namespace, name)
+        jkey = "%s/%s" % (namespace, name)
+        path = action.get("path", "escape")
+        with self._lock:
+            self._mig_pending.pop(key, None)
+            self._counts["migrate"] = self._counts.get("migrate", 0) + 1
+            self._mig_counts["commit:%s" % path] = \
+                self._mig_counts.get("commit:%s" % path, 0) + 1
+            per = self._commits.setdefault(jkey, {})
+            per["migrate"] = per.get("migrate", 0) + 1
+        attrs: Dict[str, Any] = {"action": "migrate", "job": jkey,
+                                 "path": path}
+        for k in ("src", "dest", "whale", "evict_cost_s",
+                  "migrate_cost_s"):
+            if k in action:
+                attrs[k] = action[k]
+        tracer().event("sched_feedback", **attrs)
+
+    def abort_migration(self, namespace: str, name: str,
+                        reason: str) -> None:
+        """A mid-flight migration could not complete (destination dead
+        or wedged, poisoned state bundle, source hard-preempted): drop
+        the intent so the ordinary evict path takes over cleanly —
+        counted by reason, never double-spending a restart budget."""
+        key = (namespace, name)
+        jkey = "%s/%s" % (namespace, name)
+        with self._lock:
+            self._mig_pending.pop(key, None)
+            self._mig_counts["abort:%s" % reason] = \
+                self._mig_counts.get("abort:%s" % reason, 0) + 1
+        tracer().event("sched_feedback", action="migrate_abort",
+                       job=jkey, reason=reason)
+
+    def record_blackout(self, seconds: float) -> None:
+        """One measured blackout barrier (source stopped -> destination
+        running): the headline cost of a MOVE, exported as the
+        ``tpujob_migration_blackout_seconds`` histogram."""
+        s = max(0.0, float(seconds))
+        with self._lock:
+            for i, le in enumerate(BLACKOUT_BUCKETS):
+                if s <= le:
+                    self._blackout_hist[i] += 1
+            self._blackout_hist[-1] += 1  # +Inf
+            self._blackout_sum += s
+            self._blackout_count += 1
+
+    def migration_counts(self) -> Dict[str, int]:
+        """Migration decisions/commits/aborts (``decision:<path>`` /
+        ``commit:<path>`` / ``abort:<reason>``) — the chaos fingerprint
+        and tests read this; exposition is :meth:`metrics_block`."""
+        with self._lock:
+            return dict(self._mig_counts)
+
     # -- SLO-burn-driven priority boost -----------------------------------
 
     def priority_boost(self, job: api.TpuJob) -> int:
@@ -404,6 +603,8 @@ class FeedbackController:
             self._remediated.discard(jkey)
             self._boosted.pop(jkey, None)
             self._commits.pop(jkey, None)
+            self._mig_pending.pop(key, None)
+            self._mig_streaks.pop(key, None)
 
     def counts(self) -> Dict[str, int]:
         """Decisions applied so far, by action (the chaos invariants and
@@ -420,7 +621,8 @@ class FeedbackController:
     def job_count(self) -> int:
         """Jobs with live feedback state (churn-boundedness checks)."""
         with self._lock:
-            keys = set(self._streaks) | set(self._pending)
+            keys = (set(self._streaks) | set(self._pending)
+                    | set(self._mig_pending) | set(self._mig_streaks))
             jkeys = (set(self._boosted) | set(self._remediated)
                      | set(self._commits))
             return len(keys | {tuple(k.split("/", 1)) for k in jkeys})
@@ -430,16 +632,76 @@ class FeedbackController:
         arbiter's provider block."""
         with self._lock:
             counts = dict(self._counts)
-        if not counts:
-            return ""
-        lines = [
-            "# HELP tpujob_sched_feedback_total Feedback-loop decisions "
-            "applied (the observe->decide loop), by action.",
-            "# TYPE tpujob_sched_feedback_total counter",
-        ]
-        for action in FEEDBACK_ACTIONS:
-            if action in counts:
+            mig = dict(self._mig_counts)
+            blackout = list(self._blackout_hist)
+            blackout_sum = self._blackout_sum
+            blackout_count = self._blackout_count
+        lines: List[str] = []
+        if counts:
+            lines.append(
+                "# HELP tpujob_sched_feedback_total Feedback-loop "
+                "decisions applied (the observe->decide loop), by action.")
+            lines.append("# TYPE tpujob_sched_feedback_total counter")
+            for action in FEEDBACK_ACTIONS:
+                if action in counts:
+                    lines.append(
+                        'tpujob_sched_feedback_total{action="%s"} %d'
+                        % (action, counts[action]))
+        decisions = {p: mig.get("decision:%s" % p, 0)
+                     for p in MIGRATION_PATHS
+                     if "decision:%s" % p in mig}
+        commits = {p: mig.get("commit:%s" % p, 0)
+                   for p in MIGRATION_PATHS if "commit:%s" % p in mig}
+        aborts = {k.split(":", 1)[1]: v for k, v in sorted(mig.items())
+                  if k.startswith("abort:")}
+        if decisions:
+            lines.append(
+                "# HELP tpujob_migration_decisions_total MIGRATE "
+                "decisions armed by the feedback loop, by path "
+                "(escape | defrag).")
+            lines.append("# TYPE tpujob_migration_decisions_total counter")
+            for path in MIGRATION_PATHS:
+                if path in decisions:
+                    lines.append(
+                        'tpujob_migration_decisions_total{path="%s"} %d'
+                        % (path, decisions[path]))
+        if commits:
+            lines.append(
+                "# HELP tpujob_migration_commits_total MIGRATE "
+                "decisions the reconciler actually executed (source "
+                "draining with the intent stamped), by path.")
+            lines.append("# TYPE tpujob_migration_commits_total counter")
+            for path in MIGRATION_PATHS:
+                if path in commits:
+                    lines.append(
+                        'tpujob_migration_commits_total{path="%s"} %d'
+                        % (path, commits[path]))
+        if aborts:
+            lines.append(
+                "# HELP tpujob_migration_aborts_total Mid-flight "
+                "migrations that fell back to the ordinary evict path, "
+                "by reason.")
+            lines.append("# TYPE tpujob_migration_aborts_total counter")
+            for reason in sorted(aborts):
                 lines.append(
-                    'tpujob_sched_feedback_total{action="%s"} %d'
-                    % (action, counts[action]))
+                    'tpujob_migration_aborts_total{reason="%s"} %d'
+                    % (reason, aborts[reason]))
+        if blackout_count:
+            lines.append(
+                "# HELP tpujob_migration_blackout_seconds The measured "
+                "blackout barrier per MOVE (source stopped -> "
+                "destination running).")
+            lines.append(
+                "# TYPE tpujob_migration_blackout_seconds histogram")
+            for i, le in enumerate(BLACKOUT_BUCKETS):
+                lines.append(
+                    'tpujob_migration_blackout_seconds_bucket{le="%s"} %d'
+                    % (format_float(le), blackout[i]))
+            lines.append(
+                'tpujob_migration_blackout_seconds_bucket{le="+Inf"} %d'
+                % blackout[-1])
+            lines.append("tpujob_migration_blackout_seconds_sum %.6f"
+                         % blackout_sum)
+            lines.append("tpujob_migration_blackout_seconds_count %d"
+                         % blackout_count)
         return "\n".join(lines)

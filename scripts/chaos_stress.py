@@ -33,9 +33,11 @@ import logging
 from paddle_operator_tpu.chaos import SCENARIOS, run_scenario
 
 #: scenarios whose single run is itself fleet-scale (hundreds of jobs,
-#: or — fleet_week — a multi-thousand-tick compressed week): swept at
-#: --heavy-seeds instead of --seeds
-HEAVY_SCENARIOS = ("control_plane_storm", "fleet_week")
+#: or — fleet_week — a multi-thousand-tick compressed week; or —
+#: migration_wave — a migrate fleet PLUS its evict-and-requeue replay
+#: PLUS a real training handover per seed): swept at --heavy-seeds
+#: instead of --seeds
+HEAVY_SCENARIOS = ("control_plane_storm", "fleet_week", "migration_wave")
 
 
 def main(argv=None) -> int:

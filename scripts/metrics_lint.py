@@ -123,6 +123,25 @@ def selftest_text() -> str:
     h.job_metrics.observe_drain("default", 'evil"name\\x', pods=2)
     h.job_metrics.observe_phase("default", 'evil"name\\x', "Restarting")
     h.job_metrics.observe_phase("default", 'evil"name\\x', "Running")
+    # the live-migration plane (ISSUE 20): an escape armed (two
+    # unhealthy windows), stamped on the object (the arbiter's MOVE
+    # decision counter), committed, aborted on a second job, and a
+    # measured handover blackout — every tpujob_migration_* family a
+    # production scrape can carry
+    fb = h.arbiter.feedback
+    fb.observe_host_health("default", "lint-tpu", "n0", True,
+                           staleness=30)
+    fb.observe_host_health("default", "lint-tpu", "n0", True,
+                           staleness=30)
+    pend = fb.pending_migration("default", "lint-tpu")
+    assert pend is not None, "the escape decision never armed"
+    assert h.arbiter.stamp_migrate("default", "lint-tpu", {
+        "path": "escape", "dest": "", "src": "n0"}), \
+        "migrate intent stamp failed"
+    fb.commit_migration("default", "lint-tpu", pend)
+    fb.abort_migration("default", "lint-low2", "dest_dead")
+    fb.record_blackout(0.5)
+    h.arbiter.clear_migrate("default", "lint-tpu")
     text = h.manager.metrics_text()
     # the coverage this selftest claims must actually be in the text —
     # a scenario drift that stops exercising these emitters should fail
@@ -150,8 +169,18 @@ def selftest_text() -> str:
                 "tpujob_sched_feedback_total",
                 # the causal-incident plane (ISSUE 14)
                 "tpujob_incidents_total",
-                "tpujob_incident_recovery_seconds"):
+                "tpujob_incident_recovery_seconds",
+                # the live-migration plane (ISSUE 20)
+                "tpujob_migration_decisions_total",
+                "tpujob_migration_commits_total",
+                "tpujob_migration_aborts_total",
+                "tpujob_migration_blackout_seconds",
+                "tpujob_sched_migrate_decisions_total"):
         assert "# TYPE %s" % fam in text, "selftest lost %s" % fam
+    assert 'tpujob_migration_commits_total{path="escape"} 1' in text, \
+        "the MOVE commit never counted"
+    assert 'tpujob_migration_aborts_total{reason="dest_dead"} 1' \
+        in text, "the MOVE abort never counted"
     assert 'tpujob_incidents_total{cause="drain"}' in text, \
         "the drain incident never closed into the counter"
     assert 'tenant="evil' in text, "adversarial tenant label missing"
@@ -318,8 +347,18 @@ def selftest_artifact_text():
             code, _ = store._http("PUT", "/v1/artifact?fp=%s" % fp,
                                   body=b"garbage not a bundle")
             assert code == 400, "server accepted a poisoned publish"
-            client_text = artifacts.metrics_text()
             server_text = srv.metrics_text()
+            # transient-failure retries (ISSUE 20): kill the remote tier
+            # and fetch against it — the bounded retry must count per
+            # tier before the degrade-to-miss posture kicks in
+            store.http_retries = 2
+            store.retry_backoff_s = 0.001
+            srv.stop()
+            try:
+                store.fetch("cd" * 16)
+            except OSError:
+                pass  # the last failure propagates like an unretried call
+            client_text = artifacts.metrics_text()
     finally:
         for k, v in saved.items():
             if v is None:
@@ -339,6 +378,9 @@ def selftest_artifact_text():
         in client_text, "the poisoned reject never counted"
     assert 'tpujob_artifact_hits_total{tier="remote"} 1' in client_text, \
         "the remote tier never served the post-poison fetch"
+    assert "# TYPE tpujob_artifact_fetch_retries_total" in client_text
+    assert 'tpujob_artifact_fetch_retries_total{tier="remote"} 2' \
+        in client_text, "transient HTTP retries never counted"
     assert "# TYPE tpujob_artifact_server_requests_total" in server_text
     assert 'op="publish_rejected"} 1' in server_text, \
         "the server accepted (or failed to count) a poisoned publish"

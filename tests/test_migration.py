@@ -1,0 +1,543 @@
+"""Transparent live migration (the MOVE verb): state bundles over the
+artifact tier, feedback escape/defrag decisions, the reconciler's
+budget-free MOVE execution, and — most importantly — every abort path:
+a dead destination before the MOVE, a destination vanishing
+mid-migration (operator-restart-safe), a poisoned state bundle rejected
+at the destination (never a wrong restore), and a source hard-preempted
+mid-handover (no restart-budget double spend, ledger conserved).
+"""
+
+import json
+import os
+
+import pytest
+
+from paddle_operator_tpu.api import types as api
+from paddle_operator_tpu.artifacts import reset_for_tests
+from paddle_operator_tpu.artifacts.store import get_store
+from paddle_operator_tpu.artifacts.state import (
+    MANIFEST_MEMBER, STEP_DIR_FMT, fetch_state, pack_state_dir,
+    publish_state, state_fingerprint,
+)
+from paddle_operator_tpu.controllers import helper
+from paddle_operator_tpu.obs import parse_exposition
+from paddle_operator_tpu.sched import (
+    FeedbackController, FleetArbiter, make_tpu_node,
+)
+from paddle_operator_tpu.testing import OperatorHarness
+
+CHIPS = 8
+
+
+# ---------------------------------------------------------------------------
+# state bundles: the artifact tier carrying checkpoints
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def dir_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUJOB_ARTIFACT_STORE", str(tmp_path / "store"))
+    monkeypatch.delenv("TPUJOB_ARTIFACT_URL", raising=False)
+    reset_for_tests()
+    yield get_store()
+    reset_for_tests()
+
+
+def _write_step(ckpt_dir, step, payload=b"weights", extra=()):
+    step_dir = os.path.join(ckpt_dir, STEP_DIR_FMT % step)
+    os.makedirs(step_dir, exist_ok=True)
+    with open(os.path.join(step_dir, "state.npz"), "wb") as fh:
+        fh.write(payload)
+    with open(os.path.join(step_dir, "manifest.json"), "w") as fh:
+        json.dump({"step": step, "committed": True}, fh)
+    for name, data in extra:
+        with open(os.path.join(step_dir, name), "wb") as fh:
+            fh.write(data)
+    return step_dir
+
+
+class TestStateBundles:
+    def test_fingerprint_is_pure_hex_and_keyed_by_identity(self):
+        fp = state_fingerprint("ns", "job", 7)
+        assert len(fp) == 40 and int(fp, 16) >= 0
+        # a KEY, not a content hash: distinct per job and per step
+        assert fp != state_fingerprint("ns", "job", 8)
+        assert fp != state_fingerprint("ns", "other", 7)
+        assert fp == state_fingerprint("ns", "job", 7)
+
+    def test_publish_fetch_round_trip(self, dir_store, tmp_path):
+        src = str(tmp_path / "src")
+        _write_step(src, 12, extra=[("shard_1.npz", b"more")])
+        fp = publish_state(dir_store, "ns", "mover", 12, src)
+        assert fp == state_fingerprint("ns", "mover", 12)
+        dst = str(tmp_path / "dst")
+        got = fetch_state(dir_store, fp, dst, 12)
+        assert got == os.path.join(dst, STEP_DIR_FMT % 12)
+        assert sorted(os.listdir(got)) == [
+            "manifest.json", "shard_1.npz", "state.npz"]
+        with open(os.path.join(got, "state.npz"), "rb") as fh:
+            assert fh.read() == b"weights"
+        # idempotent re-fetch: the assembled dir is returned as-is
+        assert fetch_state(dir_store, fp, dst, 12) == got
+
+    def test_missing_step_dir_publishes_nothing(self, dir_store,
+                                                tmp_path):
+        assert publish_state(dir_store, "ns", "mover", 5,
+                             str(tmp_path / "empty")) is None
+
+    def test_unknown_fingerprint_fetches_nothing(self, dir_store,
+                                                 tmp_path):
+        fp = state_fingerprint("ns", "never-published", 3)
+        dst = str(tmp_path / "dst")
+        assert fetch_state(dir_store, fp, dst, 3) is None
+        assert not os.path.exists(os.path.join(dst, STEP_DIR_FMT % 3))
+
+    def test_poisoned_bundle_is_rejected_never_half_restored(
+            self, dir_store, tmp_path):
+        """Flipped bytes in the published bundle: the destination's
+        member fetch fails CRC verification and the WHOLE assembly is
+        discarded — the restore path can never observe a wrong or
+        partial step directory."""
+        src = str(tmp_path / "src")
+        _write_step(src, 8)
+        fp = publish_state(dir_store, "ns", "mover", 8, src)
+        bundle = os.path.join(os.environ["TPUJOB_ARTIFACT_STORE"],
+                              [f for f in os.listdir(
+                                  os.environ["TPUJOB_ARTIFACT_STORE"])
+                               if f.startswith(fp)][0])
+        blob = bytearray(open(bundle, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(bundle, "wb") as fh:
+            fh.write(bytes(blob))
+        dst = str(tmp_path / "dst")
+        assert fetch_state(dir_store, fp, dst, 8) is None
+        final = os.path.join(dst, STEP_DIR_FMT % 8)
+        assert not os.path.exists(final)
+        # no half-assembled tmp dir left behind either
+        leftovers = os.listdir(dst) if os.path.isdir(dst) else []
+        assert leftovers == []
+
+    def test_listing_naming_outside_step_dir_is_rejected(
+            self, dir_store, tmp_path):
+        """A malicious/corrupt shard listing must not write outside the
+        destination step directory."""
+        fp = state_fingerprint("ns", "mover", 2)
+        dir_store.publish(fp, {
+            MANIFEST_MEMBER: json.dumps(
+                {"files": ["../escape"], "bytes": 1}).encode(),
+            "../escape": b"x"})
+        assert fetch_state(dir_store, fp, str(tmp_path / "dst"), 2) \
+            is None
+
+    def test_pack_skips_empty_and_lists_members(self, tmp_path):
+        assert pack_state_dir(str(tmp_path / "nope")) is None
+        step_dir = _write_step(str(tmp_path / "c"), 4)
+        members = pack_state_dir(step_dir)
+        listing = json.loads(members[MANIFEST_MEMBER])
+        assert sorted(listing["files"]) == ["manifest.json", "state.npz"]
+
+
+# ---------------------------------------------------------------------------
+# the decision surface (pure FeedbackController)
+# ---------------------------------------------------------------------------
+
+class TestMigrationDecisions:
+    def test_escape_needs_consecutive_windows(self):
+        fb = FeedbackController(migrate_windows=2)
+        assert not fb.observe_host_health("d", "j", "host-a", True,
+                                          staleness=30)
+        # a healthy window in between resets the streak
+        assert not fb.observe_host_health("d", "j", "host-a", False)
+        assert not fb.observe_host_health("d", "j", "host-a", True,
+                                          staleness=30)
+        assert fb.observe_host_health("d", "j", "host-a", True,
+                                      staleness=30)
+        pend = fb.pending_migration("d", "j")
+        assert pend["path"] == "escape" and pend["src"] == "host-a"
+        assert fb.migration_counts() == {"decision:escape": 1}
+
+    def test_healthy_window_cancels_pending_escape(self):
+        fb = FeedbackController(migrate_windows=1)
+        assert fb.observe_host_health("d", "j", "host-a", True,
+                                      staleness=30)
+        assert fb.pending_migration("d", "j") is not None
+        # the gang healed on its own before the reconciler acted
+        fb.observe_host_health("d", "j", "host-a", False)
+        assert fb.pending_migration("d", "j") is None
+
+    def test_price_gate_blocks_unpriced_migration(self):
+        """staleness 0 prices evict-and-requeue at ~0s — below the
+        modeled MOVE cost, so the gate must stay closed."""
+        fb = FeedbackController(migrate_windows=1)
+        assert not fb.observe_host_health("d", "j", "host-a", True,
+                                          staleness=0)
+        assert fb.pending_migration("d", "j") is None
+        assert not fb.suggest_defrag("d", "j", "pool-1", "whale",
+                                     staleness=0)
+
+    def test_migrate_disabled_is_inert(self):
+        fb = FeedbackController(migrate_enabled=False)
+        assert not fb.observe_host_health("d", "j", "h", True,
+                                          staleness=99)
+        assert not fb.suggest_defrag("d", "j", "pool-1", "w",
+                                     staleness=99)
+        assert fb.pending_migration("d", "j") is None
+
+    def test_defrag_and_counters_and_exposition(self):
+        fb = FeedbackController()
+        assert fb.suggest_defrag("d", "j", "pool-1", "whale",
+                                 staleness=30)
+        pend = fb.pending_migration("d", "j")
+        assert pend["dest"] == "pool-1" and pend["whale"] == "whale"
+        fb.commit_migration("d", "j", pend)
+        assert fb.pending_migration("d", "j") is None
+        fb.abort_migration("d", "j2", "dest_dead")
+        fb.record_blackout(1.5)
+        fb.record_blackout(0.2)
+        counts = fb.migration_counts()
+        assert counts["decision:defrag"] == 1
+        assert counts["commit:defrag"] == 1
+        assert counts["abort:dest_dead"] == 1
+        assert fb.commits("d", "j")["migrate"] == 1
+        block = fb.metrics_block()
+        assert parse_exposition(block) == []  # strict exposition
+        assert 'tpujob_migration_decisions_total{path="defrag"} 1' \
+            in block
+        assert 'tpujob_migration_commits_total{path="defrag"} 1' \
+            in block
+        assert 'tpujob_migration_aborts_total{reason="dest_dead"} 1' \
+            in block
+        assert "tpujob_migration_blackout_seconds_count 2" in block
+
+
+# ---------------------------------------------------------------------------
+# MOVE execution + abort paths through the real reconciler
+# ---------------------------------------------------------------------------
+
+def tpu_job(name, hosts, cls="tpu-standard", min_hosts=1):
+    tmpl = {"containers": [{"name": "main", "image": "img"}],
+            "priorityClassName": cls}
+    worker = {"replicas": hosts, "template": {"spec": tmpl},
+              "requests": min_hosts}
+    return api.new_tpujob(name, spec={
+        "device": "tpu", "tpu": {"accelerator": "v5e"},
+        "worker": worker, "elastic": 1})
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class MigHarness:
+    """OperatorHarness + 2-pool Node fleet + arbiter WITH the feedback
+    migration surface (mirrors test_feedback.FeedbackHarness). Metrics
+    run on a tick clock so incident stage sums and ledger episodes can
+    be compared EXACTLY, like the chaos proof does."""
+
+    def __init__(self, **fb_kwargs):
+        self.ckpt = {}
+        self.evictions = []
+        self.fb_kwargs = fb_kwargs
+        self.feedback = None
+        self.clock = FakeClock()
+        self.h = OperatorHarness(arbiter_factory=self._factory,
+                                 metrics_clock=self.clock)
+        for p in range(2):
+            for n in range(4):
+                self.h.client.create(make_tpu_node(
+                    "n%d-%d" % (p, n), "pool-%d" % p, CHIPS))
+
+    def _factory(self, client, job_metrics):
+        self.feedback = FeedbackController(ledger=job_metrics.ledger,
+                                           **self.fb_kwargs)
+        return FleetArbiter(client, evictor=self._evict,
+                            job_metrics=job_metrics, drain_grace=2,
+                            ckpt_info=self._info,
+                            feedback=self.feedback)
+
+    def _info(self, job):
+        return self.ckpt.get(job.name)
+
+    def _evict(self, pod, grace):
+        name = pod["metadata"]["name"]
+        self.evictions.append(name)
+        self.h.sim.preempt(name, reason="Preempted", grace_seconds=grace)
+        owner = name.rsplit("-", 2)[0]
+        if owner in self.ckpt:
+            self.ckpt[owner]["step"] = self.ckpt[owner]["progress"]
+
+    def converge(self, ticks=60):
+        """OperatorHarness.converge with the metrics clock advancing one
+        second per tick (the chaos-harness cadence)."""
+        stable = 0
+        for tick in range(ticks):
+            rv_before = self.h.client._rv
+            self.h.manager.drain()
+            sim_changed = self.h.sim.step()
+            self.clock.advance(1.0)
+            if self.h.client._rv == rv_before and not sim_changed:
+                stable += 1
+                if stable >= 2:
+                    return tick + 1
+            else:
+                stable = 0
+        return ticks
+
+    def job(self, name):
+        return self.h.get_job(name)
+
+    def annotations(self, name):
+        return self.job(name).metadata.get("annotations") or {}
+
+    def worker_pods(self, name):
+        obj = self.h.client.get(api.KIND, "default", name)
+        return sorted((p for p in self.h.client.list_owned("Pod", obj)
+                       if (p["metadata"].get("annotations") or {})
+                       .get(api.ANNOT_RESOURCE) == api.RES_WORKER),
+                      key=lambda p: p["metadata"]["name"])
+
+    def events(self, reason):
+        return [e for e in self.h.client.all_objects("Event")
+                if e.get("reason") == reason]
+
+    def budgets(self, name):
+        job = self.job(name)
+        return (int(job.status.get("schedPreemptions") or 0),
+                int(job.status.get("preemptionRestarts") or 0))
+
+    def kill_pool(self, pool):
+        for node in list(self.h.client.all_objects("Node")):
+            labels = node["metadata"].get("labels") or {}
+            if labels.get(helper.GKE_NODEPOOL_TOPOLOGY) == pool:
+                self.h.client.delete(
+                    "Node", node["metadata"].get("namespace") or "",
+                    node["metadata"]["name"])
+
+    def assert_conserved(self):
+        """Every incident closed, and each closed incident's MTTR stage
+        sum equals its ledger badput episode exactly."""
+        reg = self.h.job_metrics.incidents
+        assert reg.open_count() == 0
+        episodes = {}
+        for ep in self.h.job_metrics.ledger.episode_log():
+            episodes.setdefault(ep["incident"], []).append(ep)
+        closed = reg.closed_incidents()
+        for inc in closed:
+            eps = episodes.get(inc["incident"])
+            assert eps, "incident %s has no ledger episode" % inc
+            assert abs(inc["total_s"]
+                       - sum(e["badput_s"] for e in eps)) <= 1e-6
+        return closed
+
+    def close(self):
+        self.h.close()
+
+
+def test_escape_move_is_budget_free_and_conserved():
+    """The happy path end-to-end: two unhealthy windows arm an escape,
+    the reconciler stamps + drains, the gang re-ups, the annotation is
+    stripped, the booking is budget-free, and the migrate incident's
+    stage sum equals its ledger episode."""
+    f = MigHarness()
+    f.ckpt["esc"] = {"progress": 7, "step": 4}
+    f.h.create_job(tpu_job("esc", 2, min_hosts=2))
+    f.converge()
+    assert f.job("esc").phase == api.Phase.RUNNING
+    fb = f.feedback
+    assert not fb.observe_host_health("default", "esc", "n0-0", True,
+                                      staleness=30)
+    assert fb.observe_host_health("default", "esc", "n0-0", True,
+                                  staleness=30)
+    f.converge()
+    assert f.job("esc").phase == api.Phase.RUNNING
+    # the whole gang drained exactly once, gracefully
+    assert sorted(f.evictions) == ["esc-worker-0", "esc-worker-1"]
+    sp, pr = f.budgets("esc")
+    assert sp == 1 and pr == 0
+    # intent stamped then stripped on handover
+    assert helper.ANNOT_SCHED_MIGRATE not in f.annotations("esc")
+    assert f.events("SchedFeedbackMigrate")
+    assert f.events("MigrationComplete")
+    assert fb.migration_counts()["commit:escape"] == 1
+    # the drain checkpoint covered all progress: nothing lost
+    assert f.ckpt["esc"]["step"] == f.ckpt["esc"]["progress"]
+    closed = f.assert_conserved()
+    assert any(i["cause"] == "migrate" for i in closed)
+    f.close()
+
+
+def test_dead_destination_aborts_before_the_move_starts():
+    """Abort path 1: the defrag destination died between decision and
+    execution — the decision is dropped cleanly (nothing stamped, no
+    drain, no budget), and the job keeps running untouched."""
+    f = MigHarness()
+    f.h.create_job(tpu_job("mv", 1))
+    f.converge()
+    fb = f.feedback
+    assert fb.suggest_defrag("default", "mv", "pool-gone", "whale",
+                             staleness=30)
+    f.converge()
+    assert f.evictions == []
+    assert f.job("mv").phase == api.Phase.RUNNING
+    assert helper.ANNOT_SCHED_MIGRATE not in f.annotations("mv")
+    assert f.budgets("mv") == (0, 0)
+    assert fb.pending_migration("default", "mv") is None
+    assert fb.migration_counts()["abort:dest_dead"] == 1
+    assert f.events("SchedFeedbackMigrateAborted")
+    f.assert_conserved()
+    f.close()
+
+
+def test_destination_vanishing_mid_migration_falls_back_cleanly():
+    """Abort path 2: the MOVE committed and the source is draining when
+    the destination pool dies. The persisted intent must not pin the
+    job mid-drain: the annotation is stripped, the abort is counted,
+    and the job recovers through the ordinary path with the drain
+    still booked budget-free exactly once."""
+    f = MigHarness()
+    f.h.create_job(tpu_job("mv", 1))
+    f.converge()
+    fb = f.feedback
+    assert fb.suggest_defrag("default", "mv", "pool-1", "whale",
+                             staleness=30)
+    # one reconcile pass: stamp + commit + drain begins (grace window)
+    f.h.manager.drain()
+    assert helper.ANNOT_SCHED_MIGRATE in f.annotations("mv")
+    assert f.evictions == ["mv-worker-0"]
+    # the destination pool dies before handover
+    f.kill_pool("pool-1")
+    f.converge()
+    job = f.job("mv")
+    assert job.phase == api.Phase.RUNNING
+    assert helper.ANNOT_SCHED_MIGRATE not in f.annotations("mv")
+    assert fb.migration_counts()["abort:dest_vanished"] == 1
+    assert f.events("MigrationAborted")
+    sp, pr = f.budgets("mv")
+    assert sp == 1 and pr == 0  # booked once, never recounted
+    f.assert_conserved()
+    f.close()
+
+
+def test_stale_migration_annotation_stripped_after_operator_restart():
+    """The operator dies mid-MOVE and the destination vanishes while it
+    is down: the REBUILT reconciler (fresh feedback state — the pending
+    decision died with the old process) must read the persisted intent,
+    see the dead destination, and strip the stale annotation rather
+    than leave the job pinned as migrating."""
+    f = MigHarness()
+    f.h.create_job(tpu_job("mv", 1))
+    f.converge()
+    assert f.feedback.suggest_defrag("default", "mv", "pool-1", "whale",
+                                     staleness=30)
+    f.h.manager.drain()
+    assert helper.ANNOT_SCHED_MIGRATE in f.annotations("mv")
+    old_fb = f.feedback
+    f.h.restart_operator()
+    assert f.feedback is not old_fb  # genuinely rebuilt
+    f.kill_pool("pool-1")
+    f.converge()
+    job = f.job("mv")
+    assert job.phase == api.Phase.RUNNING
+    assert helper.ANNOT_SCHED_MIGRATE not in f.annotations("mv")
+    assert f.events("MigrationAborted")
+    sp, pr = f.budgets("mv")
+    assert sp == 1 and pr == 0
+    f.close()
+
+
+def test_source_hard_preempted_mid_handover_never_double_spends():
+    """Abort path 3: a hard maintenance kill lands on the source gang
+    while it is already draining for a MOVE. The drain-ack dedup must
+    keep the booking at exactly one budget-free schedPreemption — the
+    hard kill must not ALSO spend the preemption budget — and the
+    incident/ledger planes stay conserved."""
+    f = MigHarness()
+    f.ckpt["esc"] = {"progress": 6, "step": 4}
+    f.h.create_job(tpu_job("esc", 1))
+    f.converge()
+    fb = f.feedback
+    fb.observe_host_health("default", "esc", "n0-0", True, staleness=30)
+    assert fb.observe_host_health("default", "esc", "n0-0", True,
+                                  staleness=30)
+    f.h.manager.drain()
+    assert f.evictions == ["esc-worker-0"]
+    assert helper.ANNOT_SCHED_MIGRATE in f.annotations("esc")
+    # the hard kill lands mid-handover: SIGKILL, no grace — overriding
+    # the in-flight graceful drain
+    f.h.sim.preempt("esc-worker-0", reason="Preempted")
+    for _ in range(10):  # deliver the kill, then let the name heal
+        f.h.manager.drain()
+        f.h.sim.step()
+        f.clock.advance(1.0)
+        pods = {p["metadata"]["name"] for p in f.h.pods()}
+        if "esc-worker-0" not in pods:
+            break
+    f.h.sim.clear("esc-worker-0")  # one kill; the replacement lives
+    f.converge()
+    job = f.job("esc")
+    assert job.phase == api.Phase.RUNNING
+    sp, pr = f.budgets("esc")
+    assert sp == 1 and pr == 0
+    assert helper.ANNOT_SCHED_MIGRATE not in f.annotations("esc")
+    f.assert_conserved()
+    f.close()
+
+
+# ---------------------------------------------------------------------------
+# the destination runner: poisoned state bundle -> never a wrong restore
+# ---------------------------------------------------------------------------
+
+def test_runner_rejects_poisoned_state_bundle(tmp_path, monkeypatch):
+    """The destination pre-stage path: a poisoned bundle under the
+    job's state fingerprint must be REJECTED (CRC verification), the
+    runner falls back to its (absent) durable checkpoint, and the run
+    trains from scratch to the exact same loss an untouched run
+    produces — a wrong restore is impossible by construction."""
+    from paddle_operator_tpu.chaos.recovery import (
+        linear_batch_source, tiny_linear_job,
+    )
+    from paddle_operator_tpu.runner import LaunchConfig, run_training
+
+    store_dir = tmp_path / "store"
+    monkeypatch.setenv("TPUJOB_ARTIFACT_STORE", str(store_dir))
+    monkeypatch.delenv("TPUJOB_ARTIFACT_URL", raising=False)
+    reset_for_tests()
+    try:
+        make_batch = linear_batch_source()
+        cfg = LaunchConfig(worker_id=0, num_workers=1)
+        ref = run_training(
+            tiny_linear_job(str(tmp_path / "ref"), make_batch), cfg,
+            init_distributed=False)
+
+        # an attacker/corruption publishes garbage under the exact
+        # fingerprint the destination will ask for
+        fp = state_fingerprint("chaos", "mover", 7)
+        get_store().publish(fp, {
+            MANIFEST_MEMBER: json.dumps(
+                {"files": ["state.npz"], "bytes": 4}).encode(),
+            "state.npz": b"junk"})
+        bundles = [f for f in os.listdir(str(store_dir))
+                   if f.startswith(fp)]
+        blob = bytearray(
+            open(os.path.join(str(store_dir), bundles[0]), "rb").read())
+        blob[-3] ^= 0xFF
+        with open(os.path.join(str(store_dir), bundles[0]), "wb") as fh:
+            fh.write(bytes(blob))
+
+        monkeypatch.setenv("TPUJOB_MIGRATE_STATE", "chaos/mover:7")
+        dst = run_training(
+            tiny_linear_job(str(tmp_path / "dst"), make_batch), cfg,
+            init_distributed=False)
+        # the poisoned bundle was rejected: no prefetch recorded, and
+        # the loss is bit-identical to the untouched reference
+        assert dst.get("migrate_prefetched_step") is None
+        assert float.hex(float(dst["loss"])) == \
+            float.hex(float(ref["loss"]))
+    finally:
+        reset_for_tests()
